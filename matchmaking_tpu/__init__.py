@@ -1,0 +1,29 @@
+"""matchmaking_tpu — a TPU-native matchmaking framework.
+
+A ground-up rebuild of the capabilities of
+``OpenMatchmaking/microservice-matchmaking`` (Elixir/OTP + RabbitMQ), designed
+TPU-first:
+
+- the live player pool is a structure-of-arrays resident in device HBM
+  (``core.pool``), sharded over a ``jax.sharding.Mesh`` axis for multi-chip;
+- matching is one batched, jitted score → mask → top-k → conflict-free-pairing
+  kernel per request window (``engine.kernels``), instead of the reference's
+  per-request sequential ETS scan (reference: ``Matchmaking.Search.Worker`` —
+  see SURVEY.md §3 Entry 2; reference tree unavailable, SURVEY.md §0);
+- the AMQP request/response contract, middleware pipeline, and the pluggable
+  ``Engine.search/2`` seam are preserved (``service.contract``,
+  ``service.middleware``, ``engine.interface``) so a user of the reference
+  finds the same surface here.
+
+NOTE on citations: the reference mount ``/root/reference`` contained zero
+files when this framework was written (SURVEY.md §0), so docstrings cite
+SURVEY.md sections (the reconstructed blueprint) instead of reference
+file:line pointers.
+"""
+
+from matchmaking_tpu.config import Config
+from matchmaking_tpu.engine.interface import Engine, make_engine
+
+__version__ = "0.1.0"
+
+__all__ = ["Config", "Engine", "make_engine", "__version__"]

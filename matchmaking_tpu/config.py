@@ -1,0 +1,182 @@
+"""Typed configuration for the whole framework.
+
+Replaces the reference's Mix config (``config/*.exs`` + env vars: broker URL,
+queue names, pool size, default ``rating_threshold`` — SURVEY.md §2 C10, §5
+"Config/flag system"). One frozen dataclass tree, loadable from JSON or
+environment variables, passed explicitly (no global mutable config).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Mapping, Sequence
+
+
+@dataclass(frozen=True)
+class QueueConfig:
+    """Per-matchmaking-queue knobs (the reference partitions work across AMQP
+    queues per game-mode/region — SURVEY.md §2 "Queue sharding")."""
+
+    name: str = "matchmaking.queue.default"
+    #: Game mode this queue serves. ``None`` → mode taken from each request.
+    game_mode: str | None = None
+    #: Players per team. 1 → 1v1; 5 → 5v5 team-balanced (BASELINE config #3).
+    team_size: int = 1
+    #: Default max |rating_a - rating_b| for a valid match (reference knob
+    #: ``rating_threshold`` — BASELINE.json north_star).
+    rating_threshold: float = 100.0
+    #: Threshold widening: effective threshold grows by this many rating
+    #: points per second waited (0 disables; SURVEY.md §2 C9 notes widening is
+    #: typical but unverified in the reference, so it is config-gated).
+    widen_per_sec: float = 0.0
+    #: Cap on the widened threshold.
+    max_threshold: float = 400.0
+    #: Use Glicko-2 rating-deviation-weighted scoring (BASELINE config #4).
+    #: Applies to 1v1 distance; team queues use plain rating spread.
+    glicko2: bool = False
+    #: Require role coverage for team formation (BASELINE config #5).
+    role_slots: tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class EngineConfig:
+    """Engine selection + device-pool geometry."""
+
+    #: ``"cpu"`` → NumPy oracle with the reference's sequential-scan
+    #: semantics; ``"tpu"`` → batched JAX engine. The seam mirrors the
+    #: reference's ``Matchmaking.Engine`` behaviour (SURVEY.md §2 C6).
+    backend: str = "cpu"
+    #: Fixed device-pool capacity P (static shape; slots are recycled).
+    pool_capacity: int = 131_072
+    #: Candidates kept per request before conflict resolution.
+    top_k: int = 8
+    #: Request-window batch buckets (padded to the smallest bucket ≥ batch) —
+    #: static shapes keep XLA from recompiling in the hot path (p99 killer,
+    #: SURVEY.md §7 "Hard parts").
+    batch_buckets: tuple[int, ...] = (16, 64, 256, 1024)
+    #: Pool-shard mesh axis size (1 → single device). Multi-chip: pool slots
+    #: are sharded over axis ``"pool"`` and merged with XLA collectives.
+    mesh_pool_axis: int = 1
+    #: Use ring (ppermute) top-k merge instead of all_gather when sharded.
+    ring_merge: bool = False
+    #: Score tile size over the pool dimension (blockwise scoring keeps the
+    #: B×P score matrix out of HBM at P=100k; SURVEY.md §7 "Hard parts").
+    pool_block: int = 8192
+
+
+@dataclass(frozen=True)
+class BrokerConfig:
+    """In-process AMQP-semantic broker knobs (SURVEY.md §2 "Distributed
+    communication backend": real RabbitMQ is not available in this
+    environment, so an in-process broker implements identical semantics
+    behind the same interface)."""
+
+    url: str = "inproc://matchmaking"
+    request_queue: str = "matchmaking.search"
+    #: Per-consumer unacked-message cap (AMQP basic.qos prefetch).
+    prefetch: int = 2048
+    #: Redelivery attempts for nacked/dropped deliveries (at-least-once).
+    max_redelivery: int = 3
+    # Fault-injection hooks (SURVEY.md §5 "Failure detection").
+    drop_prob: float = 0.0
+    dup_prob: float = 0.0
+    delay_ms: float = 0.0
+
+
+@dataclass(frozen=True)
+class BatcherConfig:
+    """Request windowing: collect a batch per queue, dispatch one kernel."""
+
+    max_batch: int = 1024
+    max_wait_ms: float = 5.0
+
+
+@dataclass(frozen=True)
+class AuthConfig:
+    """Auth middleware. The reference checks each request's token against the
+    platform's ``microservice-auth`` over AMQP RPC (SURVEY.md §2 C5); here the
+    verifier is pluggable: ``"none"`` (off), ``"static"`` (shared-secret
+    prefix), or ``"rpc"`` (round-trip over the broker to an auth queue)."""
+
+    mode: str = "none"
+    static_secret: str = "open-matchmaking"
+    rpc_queue: str = "auth.token.verify"
+    rpc_timeout_ms: float = 250.0
+
+
+@dataclass(frozen=True)
+class Config:
+    queues: tuple[QueueConfig, ...] = (QueueConfig(),)
+    engine: EngineConfig = field(default_factory=EngineConfig)
+    broker: BrokerConfig = field(default_factory=BrokerConfig)
+    batcher: BatcherConfig = field(default_factory=BatcherConfig)
+    auth: AuthConfig = field(default_factory=AuthConfig)
+    #: Number of concurrent search workers draining batches (the reference's
+    #: GenServer pool size analog — SURVEY.md §2 C7).
+    workers: int = 2
+    seed: int = 0
+
+    # ---- loading -----------------------------------------------------------
+
+    @staticmethod
+    def from_dict(d: Mapping[str, Any]) -> "Config":
+        kw: dict[str, Any] = {}
+        if "queues" in d:
+            kw["queues"] = tuple(
+                QueueConfig(**{**q, "role_slots": tuple(q.get("role_slots", ()))})
+                for q in d["queues"]
+            )
+        for name, cls in (
+            ("engine", EngineConfig),
+            ("broker", BrokerConfig),
+            ("batcher", BatcherConfig),
+            ("auth", AuthConfig),
+        ):
+            if name in d:
+                sub = dict(d[name])
+                for f in dataclasses.fields(cls):
+                    if f.name in sub and isinstance(sub[f.name], list):
+                        sub[f.name] = tuple(sub[f.name])
+                kw[name] = cls(**sub)
+        for scalar in ("workers", "seed"):
+            if scalar in d:
+                kw[scalar] = d[scalar]
+        return Config(**kw)
+
+    @staticmethod
+    def from_json(path: str) -> "Config":
+        with open(path) as f:
+            return Config.from_dict(json.load(f))
+
+    @staticmethod
+    def from_env(prefix: str = "MM_") -> "Config":
+        """Env-var overrides of the flat scalar knobs (reference parity for
+        12-factor config; nested keys use ``MM_ENGINE_BACKEND`` style)."""
+        cfg = Config()
+        env = {k[len(prefix):].lower(): v for k, v in os.environ.items() if k.startswith(prefix)}
+        if not env:
+            return cfg
+        d: dict[str, Any] = {}
+        for key, raw in env.items():
+            try:
+                val: Any = json.loads(raw)
+            except (ValueError, json.JSONDecodeError):
+                val = raw
+            if key in ("workers", "seed"):
+                d[key] = val
+                continue
+            parts = key.split("_", 1)
+            if len(parts) != 2:
+                continue
+            section, name = parts
+            d.setdefault(section, {})[name] = val
+        return Config.from_dict(d)
+
+    def queue(self, name: str) -> QueueConfig:
+        for q in self.queues:
+            if q.name == name:
+                return q
+        raise KeyError(f"unknown queue {name!r}")

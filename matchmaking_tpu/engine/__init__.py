@@ -1,0 +1,2 @@
+"""Matching engines: the pluggable ``Engine.search`` seam, a CPU oracle with
+the reference's sequential-scan semantics, and the batched TPU engine."""

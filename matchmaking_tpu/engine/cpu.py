@@ -1,0 +1,192 @@
+"""CPU engine — the reference-semantics oracle.
+
+Re-implements the reference's hot path faithfully: each request is processed
+*sequentially* against the waiting pool, scanning for the nearest-rating
+candidate within the (mutual) threshold; on a hit both players leave the
+pool, on a miss the requester joins it (SURVEY.md §3 Entry 2: the
+``Search.Worker`` sequential ETS scan). This is both the ``engine: "cpu"``
+backend and the golden oracle the TPU engine is tested against.
+
+Deliberately simple and allocation-light NumPy; still O(requests × pool) —
+the wall that caps the reference at ~2k concurrent players (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from matchmaking_tpu.config import Config, QueueConfig
+from matchmaking_tpu.engine import scoring
+from matchmaking_tpu.engine.interface import Engine, Match, SearchOutcome
+from matchmaking_tpu.service.contract import ANY, SearchRequest, new_match_id
+
+
+class CpuEngine(Engine):
+    def __init__(self, cfg: Config, queue: QueueConfig):
+        super().__init__(cfg, queue)
+        # Waiting pool: insertion-ordered parallel lists (the ETS table analog).
+        self._entries: list[SearchRequest] = []
+        self._by_id: dict[str, int] = {}  # player id -> index in _entries
+
+    # ---- Engine API -------------------------------------------------------
+
+    def search(self, requests: Sequence[SearchRequest], now: float) -> SearchOutcome:
+        out = SearchOutcome()
+        for req in requests:
+            if req.id in self._by_id:
+                continue  # duplicate enqueue is a no-op (idempotent redelivery)
+            if req.party_size > 1 and not self.queue.role_slots:
+                # Parties are only servable on role-slot team queues
+                # (BASELINE config #5); anywhere else they would sit in the
+                # pool forever, so reject loudly instead.
+                out.rejected.append((req, "party_not_supported"))
+                continue
+            if self.queue.team_size == 1:
+                self._search_1v1(req, now, out)
+            else:
+                self._search_team(req, now, out)
+        return out
+
+    def remove(self, player_id: str) -> SearchRequest | None:
+        idx = self._by_id.get(player_id)
+        if idx is None:
+            return None
+        return self._evict(idx)
+
+    def pool_size(self) -> int:
+        return len(self._entries)
+
+    def waiting(self) -> list[SearchRequest]:
+        return list(self._entries)
+
+    def restore(self, requests: Sequence[SearchRequest], now: float) -> None:
+        for req in requests:
+            if req.id not in self._by_id:
+                self._insert(req)
+
+    # ---- internals --------------------------------------------------------
+
+    def _insert(self, req: SearchRequest) -> None:
+        self._by_id[req.id] = len(self._entries)
+        self._entries.append(req)
+
+    def _evict(self, idx: int) -> SearchRequest:
+        """Remove entry idx; swap-with-last keeps removal O(1). Note: this
+        changes scan order versus a strict FIFO table, but tie-breaking is by
+        nearest distance first, earliest-index second, and oracle tests pin
+        exact-tie cases explicitly."""
+        req = self._entries[idx]
+        last = self._entries.pop()
+        del self._by_id[req.id]
+        if idx < len(self._entries):
+            self._entries[idx] = last
+            self._by_id[last.id] = idx
+        return req
+
+    def _compatible(self, a: SearchRequest, b: SearchRequest) -> bool:
+        return scoring.region_mode_compatible(a.region, a.game_mode, b.region, b.game_mode)
+
+    def _search_1v1(self, req: SearchRequest, now: float, out: SearchOutcome) -> None:
+        thr_req = self.effective_threshold(req, now)
+        best_idx, best_dist = -1, np.inf
+        for idx, cand in enumerate(self._entries):
+            if not self._compatible(req, cand):
+                continue
+            d = scoring.distance(
+                req.rating, cand.rating, req.rating_deviation, cand.rating_deviation,
+                glicko2=self.queue.glicko2,
+            )
+            limit = scoring.mutual_threshold(thr_req, self.effective_threshold(cand, now))
+            if d <= limit and d < best_dist:
+                best_idx, best_dist = idx, d
+        if best_idx >= 0:
+            cand = self._evict(best_idx)
+            q = scoring.quality(
+                best_dist, self.effective_threshold(req, now), self.effective_threshold(cand, now)
+            )
+            out.matches.append(
+                Match(match_id=new_match_id(), teams=((req,), (cand,)), quality=q)
+            )
+        else:
+            self._insert(req)
+            out.queued.append(req)
+
+    def _search_team(self, req: SearchRequest, now: float, out: SearchOutcome) -> None:
+        """Team queues (BASELINE configs #3/#5): insert, then try to form a
+        full match among compatible waiting players.
+
+        Oracle semantics for 5v5 team-balanced: among waiting players
+        compatible with the newest request, take the contiguous
+        rating-sorted window of 2×team_size with minimal rating spread; it
+        forms a match iff spread ≤ the queue threshold and the snake-split
+        team-sum difference ≤ threshold. Quality = 1 − spread/threshold.
+        Role/party queues additionally require role-slot coverage per team
+        (config #5; implemented in ``roles.py`` helpers).
+        """
+        self._insert(req)
+        need = 2 * self.queue.team_size
+        if self.queue.role_slots:
+            from matchmaking_tpu.engine.roles import try_party_match
+
+            # Parties occupy multiple slots; delegate to the role/party oracle.
+            cands = [e for e in self._entries if self._compatible(req, e)]
+            formed = try_party_match(cands, self.queue, now, self)
+            if formed is not None:
+                teams, qual = formed
+                for r in (r for team in teams for r in team):
+                    self._evict(self._by_id[r.id])
+                out.matches.append(Match(new_match_id(), teams, qual))
+            if req.id in self._by_id:
+                out.queued.append(req)
+            return
+        cand_idx = [
+            i for i, e in enumerate(self._entries)
+            if self._compatible(req, e) and e.party_size == 1
+        ]
+        if len(cand_idx) < need:
+            out.queued.append(req)
+            return
+        # Per-player effective thresholds (honors per-request overrides and
+        # widening; a window is valid only if its spread fits EVERY member's
+        # threshold). Note: glicko2 weighting applies to 1v1 distance only —
+        # team spread is plain rating range (documented in config.py).
+        ratings = np.array([self._entries[i].rating for i in cand_idx])
+        thrs = np.array([
+            self.effective_threshold(self._entries[i], now) for i in cand_idx
+        ])
+        order = np.argsort(ratings, kind="stable")
+        sorted_ratings = ratings[order]
+        sorted_thrs = thrs[order]
+        n_win = len(sorted_ratings) - need + 1
+        spreads = sorted_ratings[need - 1:] - sorted_ratings[:n_win]
+        win_thr = np.array([sorted_thrs[w:w + need].min() for w in range(n_win)])
+        valid = spreads <= win_thr
+        if not valid.any():
+            out.queued.append(req)
+            return
+        # Tightest valid window wins.
+        w = int(np.argmin(np.where(valid, spreads, np.inf)))
+        spread = float(spreads[w])
+        thr = float(win_thr[w])
+        window = [cand_idx[int(order[w + j])] for j in range(need)]
+        players = [self._entries[i] for i in window]
+        # Snake split by descending rating: A B B A A B B A ... balances sums.
+        players.sort(key=lambda r: -r.rating)
+        team_a, team_b = [], []
+        for j, p in enumerate(players):
+            (team_a if (j % 4 in (0, 3)) else team_b).append(p)
+        sum_a = sum(p.rating for p in team_a)
+        sum_b = sum(p.rating for p in team_b)
+        if abs(sum_a - sum_b) > thr:
+            out.queued.append(req)
+            return
+        for p in players:
+            self._evict(self._by_id[p.id])
+        qual = max(0.0, 1.0 - spread / thr) if thr > 0 else 0.0
+        out.matches.append(Match(new_match_id(), (tuple(team_a), tuple(team_b)), qual))
+        # The newest request may or may not be part of the window; if it
+        # still waits, report it queued.
+        if req.id in self._by_id:
+            out.queued.append(req)

@@ -1,0 +1,122 @@
+"""The ``Engine`` seam.
+
+Mirrors the reference's ``Matchmaking.Engine`` behaviour — an interface with a
+``search/2`` callback that the rest of the service depends on, so engines are
+swappable (``engine: :cpu | :tpu``); this is exactly the seam the north-star
+asks to preserve (SURVEY.md §2 C6, BASELINE.json ``north_star``).
+
+One engine instance serves one matchmaking queue (the reference partitions
+work across AMQP queues per game-mode/region — SURVEY.md §2 "Queue
+sharding"); multi-queue deployments run one engine per queue.
+
+Semantics contract (both backends):
+
+- ``search(requests, now)`` processes a window of new requests against the
+  engine's waiting pool and returns which players matched (including players
+  already waiting in the pool) and which new requests were queued.
+- A matched player leaves the pool before the next window; no player is ever
+  in two matches (the invariant checker in tests enforces this —
+  SURVEY.md §5 "Race detection").
+- Unmatched requests join the pool and may match in any later window.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Sequence
+
+from matchmaking_tpu.config import Config, QueueConfig
+from matchmaking_tpu.service.contract import MatchResult, SearchRequest
+
+
+@dataclass(frozen=True)
+class Match:
+    """One formed match: teams of original requests (a request may be a
+    multi-member party; its members always land on the same team)."""
+
+    match_id: str
+    teams: tuple[tuple[SearchRequest, ...], ...]
+    quality: float = 1.0
+
+    def result(self) -> MatchResult:
+        return MatchResult(
+            match_id=self.match_id,
+            players=tuple(pid for team in self.teams for req in team for pid in req.all_ids()),
+            teams=tuple(
+                tuple(pid for req in team for pid in req.all_ids()) for team in self.teams
+            ),
+            quality=self.quality,
+        )
+
+    def requests(self) -> tuple[SearchRequest, ...]:
+        return tuple(req for team in self.teams for req in team)
+
+
+@dataclass
+class SearchOutcome:
+    matches: list[Match] = field(default_factory=list)
+    #: New requests inserted into the waiting pool this window.
+    queued: list[SearchRequest] = field(default_factory=list)
+    #: Requests evicted by timeout this window (if the engine enforces one).
+    timed_out: list[SearchRequest] = field(default_factory=list)
+    #: Requests the engine cannot serve on this queue (reason code, e.g. a
+    #: party sent to a queue with no role slots). The service maps these to
+    #: error responses.
+    rejected: list[tuple[SearchRequest, str]] = field(default_factory=list)
+
+
+class Engine(abc.ABC):
+    """Pluggable matching engine for a single queue."""
+
+    def __init__(self, cfg: Config, queue: QueueConfig):
+        self.cfg = cfg
+        self.queue = queue
+
+    @abc.abstractmethod
+    def search(self, requests: Sequence[SearchRequest], now: float) -> SearchOutcome:
+        """Match a window of new requests against the waiting pool."""
+
+    @abc.abstractmethod
+    def remove(self, player_id: str) -> SearchRequest | None:
+        """Cancel: evict a waiting player (returns their request, or None)."""
+
+    @abc.abstractmethod
+    def pool_size(self) -> int:
+        """Number of requests currently waiting."""
+
+    # ---- checkpoint / recovery (SURVEY.md §5) -----------------------------
+    # The host-side request log is the authoritative pool state; device state
+    # is a pure function of it, so checkpoint = serialize waiting requests.
+
+    @abc.abstractmethod
+    def waiting(self) -> list[SearchRequest]:
+        """Snapshot of the waiting pool (checkpoint payload)."""
+
+    @abc.abstractmethod
+    def restore(self, requests: Sequence[SearchRequest], now: float) -> None:
+        """Rebuild pool state from a checkpoint: re-admit WITHOUT matching
+        (matching a restored pair here would drop the Match on the floor —
+        the service isn't listening for outcomes during recovery)."""
+
+    def effective_threshold(self, req: SearchRequest, now: float) -> float:
+        """Reference knob ``rating_threshold`` + config-gated widening by
+        wait time (SURVEY.md §2 C9)."""
+        base = req.rating_threshold if req.rating_threshold is not None else self.queue.rating_threshold
+        if self.queue.widen_per_sec <= 0.0:
+            return base
+        waited = max(0.0, now - req.enqueued_at)
+        return min(self.queue.max_threshold, base + self.queue.widen_per_sec * waited)
+
+
+def make_engine(cfg: Config, queue: QueueConfig) -> Engine:
+    """Engine factory — the ``engine: :cpu | :tpu`` selection point."""
+    if cfg.engine.backend == "cpu":
+        from matchmaking_tpu.engine.cpu import CpuEngine
+
+        return CpuEngine(cfg, queue)
+    if cfg.engine.backend == "tpu":
+        from matchmaking_tpu.engine.tpu import TpuEngine
+
+        return TpuEngine(cfg, queue)
+    raise ValueError(f"unknown engine backend {cfg.engine.backend!r}")

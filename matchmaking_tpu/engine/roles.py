@@ -1,0 +1,122 @@
+"""Role-queue party matchmaking (BASELINE config #5) — host-side oracle.
+
+Parties of 1–3 players queue as a unit and must land on the same team; each
+team must fill the queue's role slots (e.g. tank/healer/dps/dps/dps) from its
+members' declared roles. This turns matching into small constrained
+assignment; per SURVEY.md §7 it stays greedy/heuristic and config-gated so it
+cannot block the 1v1 north star.
+
+Algorithm (greedy, deterministic):
+1. Sort waiting party units by rating (unit rating = mean over members).
+2. Slide a window over the sorted units; within each window (spread ≤
+   threshold) try to pack units into two teams of exactly ``team_size``
+   members each (first-fit decreasing by party size — parties are atomic).
+3. A packing is valid iff each team admits a perfect member→role-slot
+   assignment (backtracking over ≤ team_size! tiny cases).
+4. First valid window wins; quality = 1 − spread/threshold.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from matchmaking_tpu.config import QueueConfig
+from matchmaking_tpu.service.contract import PartyMember, SearchRequest
+
+
+def unit_rating(req: SearchRequest) -> float:
+    total = req.rating + sum(m.rating for m in req.party)
+    return total / req.party_size
+
+
+def _members(req: SearchRequest) -> list[PartyMember]:
+    lead = PartyMember(req.id, req.rating, req.rating_deviation, req.roles)
+    return [lead, *req.party]
+
+
+def _roles_cover(team: Sequence[SearchRequest], slots: tuple[str, ...]) -> bool:
+    """Perfect assignment members → role slots via backtracking."""
+    members = [m for req in team for m in _members(req)]
+    if len(members) != len(slots):
+        return False
+    # Most-constrained-first: fewest eligible members per slot.
+    elig = [
+        [i for i, m in enumerate(members) if (not m.roles) or slot in m.roles]
+        for slot in slots
+    ]
+    order = sorted(range(len(slots)), key=lambda s: len(elig[s]))
+    used = [False] * len(members)
+
+    def assign(k: int) -> bool:
+        if k == len(order):
+            return True
+        for i in elig[order[k]]:
+            if not used[i]:
+                used[i] = True
+                if assign(k + 1):
+                    return True
+                used[i] = False
+        return False
+
+    return assign(0)
+
+
+def _pack_two_teams(units: Sequence[SearchRequest], team_size: int,
+                    slots: tuple[str, ...]):
+    """First-fit-decreasing pack of atomic party units into two exact teams
+    with role coverage. Returns (team_a, team_b) or None."""
+    order = sorted(units, key=lambda u: (-u.party_size, unit_rating(u)))
+    team_a: list[SearchRequest] = []
+    team_b: list[SearchRequest] = []
+    size_a = size_b = 0
+    for u in order:
+        if size_a + u.party_size <= team_size:
+            team_a.append(u)
+            size_a += u.party_size
+        elif size_b + u.party_size <= team_size:
+            team_b.append(u)
+            size_b += u.party_size
+    if size_a != team_size or size_b != team_size:
+        return None
+    if slots and not (_roles_cover(team_a, slots) and _roles_cover(team_b, slots)):
+        # One swap-repair pass: try exchanging equal-size units across teams.
+        for i, ua in enumerate(team_a):
+            for j, ub in enumerate(team_b):
+                if ua.party_size != ub.party_size:
+                    continue
+                team_a[i], team_b[j] = ub, ua
+                if _roles_cover(team_a, slots) and _roles_cover(team_b, slots):
+                    return tuple(team_a), tuple(team_b)
+                team_a[i], team_b[j] = ua, ub
+        return None
+    return tuple(team_a), tuple(team_b)
+
+
+def try_party_match(units: Sequence[SearchRequest], queue: QueueConfig,
+                    now: float, engine) -> tuple[tuple[tuple[SearchRequest, ...], ...], float] | None:
+    """Try to form one match from waiting party units. Returns (teams,
+    quality) or None. ``engine`` provides ``effective_threshold``."""
+    need = 2 * queue.team_size
+    total = sum(u.party_size for u in units)
+    if total < need:
+        return None
+    su = sorted(units, key=unit_rating)
+    n = len(su)
+    for lo in range(n):
+        members = 0
+        for hi in range(lo, n):
+            members += su[hi].party_size
+            if members < need:
+                continue
+            window = su[lo:hi + 1]
+            spread = unit_rating(window[-1]) - unit_rating(window[0])
+            # Window must fit every member unit's effective threshold
+            # (honors per-request overrides + widening).
+            thr = min(engine.effective_threshold(u, now) for u in window)
+            if spread > thr:
+                break
+            packed = _pack_two_teams(window, queue.team_size, queue.role_slots)
+            if packed is not None:
+                qual = max(0.0, 1.0 - spread / thr) if thr > 0 else 0.0
+                return packed, qual
+    return None

@@ -1,0 +1,63 @@
+"""Scoring semantics — the single source of truth shared by the CPU oracle
+and the TPU kernels (oracle-equivalence tests in ``tests/`` hold the two
+implementations to these exact definitions).
+
+The reference scores candidates by ELO distance against a
+``rating_threshold`` (BASELINE.json north_star; SURVEY.md §2 C9). The
+BASELINE configs add Glicko-2 rating-deviation weighting (#4): a high
+combined deviation makes a given rating gap *less* certain, so the effective
+distance shrinks by the Glicko g-function and uncertain players match more
+freely.
+
+All functions here are scalar/NumPy-broadcastable pure math, also valid
+inside jit (no Python control flow on data).
+"""
+
+from __future__ import annotations
+
+import math
+
+# Glicko-2 g-function constant (q = ln 10 / 400, from the Glicko papers).
+_Q = math.log(10.0) / 400.0
+_G_COEFF = 3.0 * _Q * _Q / (math.pi * math.pi)
+
+
+def glicko_g(rd_a, rd_b):
+    """g(sqrt(rd_a^2 + rd_b^2)) — shrinks distances under uncertainty.
+
+    Returns a factor in (0, 1]; 1.0 when both deviations are 0.
+    """
+    rd2 = rd_a * rd_a + rd_b * rd_b
+    return 1.0 / (1.0 + _G_COEFF * rd2) ** 0.5
+
+
+def distance(rating_a, rating_b, rd_a=0.0, rd_b=0.0, *, glicko2: bool = False):
+    """Effective rating distance between two players.
+
+    Plain mode: |Δ|. Glicko-2 mode: g·|Δ| (uncertainty-discounted).
+    """
+    delta = abs(rating_a - rating_b)
+    if glicko2:
+        return glicko_g(rd_a, rd_b) * delta
+    return delta
+
+
+def mutual_threshold(thr_a, thr_b):
+    """A pair is valid only if the distance fits BOTH players' thresholds."""
+    return min(thr_a, thr_b)
+
+
+def quality(dist, thr_a, thr_b):
+    """Match quality in [0, 1]: 1 at zero distance, 0 at the mutual limit."""
+    limit = mutual_threshold(thr_a, thr_b)
+    if limit <= 0.0:
+        return 0.0
+    return max(0.0, 1.0 - dist / limit)
+
+
+def region_mode_compatible(region_a: str, mode_a: str, region_b: str, mode_b: str,
+                           *, any_token: str = "*") -> bool:
+    """Hard filters (BASELINE config #2): wildcard-or-equal on both axes."""
+    region_ok = region_a == any_token or region_b == any_token or region_a == region_b
+    mode_ok = mode_a == any_token or mode_b == any_token or mode_a == mode_b
+    return region_ok and mode_ok

@@ -1,0 +1,1 @@
+"""Service layer: wire contract, broker, middleware pipeline, batcher, app."""

@@ -1,0 +1,268 @@
+"""The request/response wire contract.
+
+The reference speaks JSON over RabbitMQ: a search request lands on the
+matchmaking queue; the response is published to the per-request reply queue
+named by the delivery's ``reply_to`` property, tagged with its
+``correlation_id`` (SURVEY.md §2 C4; reconstructed — the reference tree was
+unavailable, SURVEY.md §0, so every wire-format decision lives in this one
+module so it can be corrected in one place).
+
+Request payload (JSON object):
+
+    {
+      "id":               str   — player id (opaque; UUID in practice)
+      "rating":           num   — ELO-style rating
+      "rating_deviation": num?  — Glicko-2 RD (default 350.0)
+      "game_mode":        str?  — hard filter (BASELINE config #2)
+      "region":           str?  — hard filter (BASELINE config #2)
+      "rating_threshold": num?  — per-request override of the queue default
+      "roles":            [str]? — roles this player can fill (config #5)
+      "party":            [player]? — 2–3 member party, same schema, the top-
+                                      level player is the party leader (#5)
+      "event-name":       str?  — routing hint, "matchmaking.search"
+    }
+
+Response payload:
+
+    {
+      "status": "matched" | "queued" | "timeout" | "error",
+      "player_id": str,
+      "match": {                        # only when status == "matched"
+        "match_id": str,
+        "players": [str, ...],          # all matched player ids
+        "teams": [[str,...],[str,...]], # team split (size 1 teams for 1v1)
+        "quality": num,                 # 0..1 match quality score
+      },
+      "error": {"code": str, "reason": str},   # only when status == "error"
+      "latency_ms": num,
+    }
+"""
+
+from __future__ import annotations
+
+import json
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Mapping, Sequence
+
+DEFAULT_RD = 350.0  # Glicko-2 deviation for an unrated player
+
+# Wildcards: requests that omit region/mode match anything.
+ANY = "*"
+
+
+class ContractError(ValueError):
+    """Malformed payload. Carries a machine-readable code for the error
+    response (the reference's middleware rejects invalid payloads before the
+    engine — SURVEY.md §2 C5)."""
+
+    def __init__(self, code: str, reason: str):
+        super().__init__(reason)
+        self.code = code
+        self.reason = reason
+
+
+@dataclass(frozen=True)
+class PartyMember:
+    id: str
+    rating: float
+    rating_deviation: float = DEFAULT_RD
+    roles: tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class SearchRequest:
+    """One decoded, validated search request (post-middleware)."""
+
+    id: str
+    rating: float
+    rating_deviation: float = DEFAULT_RD
+    game_mode: str = ANY
+    region: str = ANY
+    rating_threshold: float | None = None
+    roles: tuple[str, ...] = ()
+    party: tuple[PartyMember, ...] = ()
+    # transport metadata (AMQP properties, not part of the JSON body)
+    reply_to: str = ""
+    correlation_id: str = ""
+    queue: str = ""
+    enqueued_at: float = 0.0
+
+    @property
+    def party_size(self) -> int:
+        return 1 + len(self.party)
+
+    def all_ids(self) -> tuple[str, ...]:
+        return (self.id,) + tuple(m.id for m in self.party)
+
+
+@dataclass(frozen=True)
+class MatchResult:
+    match_id: str
+    players: tuple[str, ...]
+    teams: tuple[tuple[str, ...], ...]
+    quality: float = 1.0
+
+
+@dataclass(frozen=True)
+class SearchResponse:
+    status: str  # matched | queued | timeout | error
+    player_id: str
+    match: MatchResult | None = None
+    error_code: str = ""
+    error_reason: str = ""
+    latency_ms: float = 0.0
+
+
+# ---- decode ---------------------------------------------------------------
+
+
+def _require(payload: Mapping[str, Any], key: str, types: tuple[type, ...]) -> Any:
+    if key not in payload:
+        raise ContractError("missing_field", f"missing required field {key!r}")
+    val = payload[key]
+    if not isinstance(val, types) or isinstance(val, bool):
+        raise ContractError("bad_type", f"field {key!r} has wrong type")
+    return val
+
+
+def _roles(obj: Mapping[str, Any]) -> tuple[str, ...]:
+    raw = obj.get("roles", ())
+    if isinstance(raw, (str, bytes)) or not isinstance(raw, Sequence):
+        raise ContractError("bad_type", "roles must be an array of strings")
+    if not all(isinstance(r, str) for r in raw):
+        raise ContractError("bad_type", "roles must be an array of strings")
+    return tuple(raw)
+
+
+def _member(obj: Any) -> PartyMember:
+    if not isinstance(obj, Mapping):
+        raise ContractError("bad_type", "party member must be an object")
+    return PartyMember(
+        id=str(_require(obj, "id", (str,))),
+        rating=float(_require(obj, "rating", (int, float))),
+        rating_deviation=float(obj.get("rating_deviation", DEFAULT_RD)),
+        roles=_roles(obj),
+    )
+
+
+def decode_request(body: bytes | str, *, reply_to: str = "",
+                   correlation_id: str = "", queue: str = "",
+                   enqueued_at: float = 0.0) -> SearchRequest:
+    """bytes → validated SearchRequest. Raises ContractError."""
+    try:
+        payload = json.loads(body)
+    except (ValueError, TypeError) as e:
+        raise ContractError("bad_json", f"payload is not valid JSON: {e}") from e
+    if not isinstance(payload, Mapping):
+        raise ContractError("bad_json", "payload must be a JSON object")
+
+    pid = str(_require(payload, "id", (str,)))
+    rating = float(_require(payload, "rating", (int, float)))
+    if not (-1e5 < rating < 1e5):
+        raise ContractError("bad_rating", f"rating {rating} out of range")
+    rd = float(payload.get("rating_deviation", DEFAULT_RD))
+    if rd < 0:
+        raise ContractError("bad_rating", "rating_deviation must be >= 0")
+    thr = payload.get("rating_threshold")
+    if thr is not None:
+        thr = float(thr)
+        if thr <= 0:
+            raise ContractError("bad_threshold", "rating_threshold must be > 0")
+    party_raw = payload.get("party", ())
+    if not isinstance(party_raw, Sequence) or isinstance(party_raw, (str, bytes)):
+        raise ContractError("bad_type", "party must be an array")
+    party = tuple(_member(m) for m in party_raw)
+    if len(party) > 4:
+        raise ContractError("party_too_large", "party may have at most 5 members")
+    ids = [pid] + [m.id for m in party]
+    if len(set(ids)) != len(ids):
+        raise ContractError("duplicate_player", "duplicate player id in party")
+
+    return SearchRequest(
+        id=pid,
+        rating=rating,
+        rating_deviation=rd,
+        game_mode=str(payload.get("game_mode", ANY) or ANY),
+        region=str(payload.get("region", ANY) or ANY),
+        rating_threshold=thr,
+        roles=_roles(payload),
+        party=party,
+        reply_to=reply_to,
+        correlation_id=correlation_id,
+        queue=queue,
+        enqueued_at=enqueued_at,
+    )
+
+
+# ---- encode ---------------------------------------------------------------
+
+
+def encode_request(req: SearchRequest) -> bytes:
+    """SearchRequest → JSON body (client side / tests / bench)."""
+    payload: dict[str, Any] = {
+        "event-name": "matchmaking.search",
+        "id": req.id,
+        "rating": req.rating,
+    }
+    if req.rating_deviation != DEFAULT_RD:
+        payload["rating_deviation"] = req.rating_deviation
+    if req.game_mode != ANY:
+        payload["game_mode"] = req.game_mode
+    if req.region != ANY:
+        payload["region"] = req.region
+    if req.rating_threshold is not None:
+        payload["rating_threshold"] = req.rating_threshold
+    if req.roles:
+        payload["roles"] = list(req.roles)
+    if req.party:
+        payload["party"] = [
+            {"id": m.id, "rating": m.rating,
+             "rating_deviation": m.rating_deviation, "roles": list(m.roles)}
+            for m in req.party
+        ]
+    return json.dumps(payload, separators=(",", ":")).encode()
+
+
+def encode_response(resp: SearchResponse) -> bytes:
+    payload: dict[str, Any] = {
+        "status": resp.status,
+        "player_id": resp.player_id,
+        "latency_ms": round(resp.latency_ms, 3),
+    }
+    if resp.match is not None:
+        payload["match"] = {
+            "match_id": resp.match.match_id,
+            "players": list(resp.match.players),
+            "teams": [list(t) for t in resp.match.teams],
+            "quality": round(resp.match.quality, 6),
+        }
+    if resp.status == "error":
+        payload["error"] = {"code": resp.error_code, "reason": resp.error_reason}
+    return json.dumps(payload, separators=(",", ":")).encode()
+
+
+def decode_response(body: bytes | str) -> SearchResponse:
+    payload = json.loads(body)
+    match = None
+    if "match" in payload:
+        m = payload["match"]
+        match = MatchResult(
+            match_id=m["match_id"],
+            players=tuple(m["players"]),
+            teams=tuple(tuple(t) for t in m["teams"]),
+            quality=float(m.get("quality", 1.0)),
+        )
+    err = payload.get("error", {})
+    return SearchResponse(
+        status=payload["status"],
+        player_id=payload["player_id"],
+        match=match,
+        error_code=err.get("code", ""),
+        error_reason=err.get("reason", ""),
+        latency_ms=float(payload.get("latency_ms", 0.0)),
+    )
+
+
+def new_match_id() -> str:
+    return uuid.uuid4().hex

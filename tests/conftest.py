@@ -1,0 +1,22 @@
+"""Test harness config.
+
+Forces JAX onto the host CPU backend with 8 virtual devices BEFORE jax is
+imported anywhere, so sharding/collective code paths (mesh axis ``pool``) are
+exercised without TPU hardware (SURVEY.md §4 "For the rebuild"). Bench runs
+(bench.py) use the real TPU; tests use this virtual mesh.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(1234)

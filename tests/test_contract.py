@@ -1,0 +1,140 @@
+"""Wire-contract tests: encode/decode round-trips + validation rejects
+(SURVEY.md §4: property-test contract encode/decode round-trips)."""
+
+import json
+
+import pytest
+
+from matchmaking_tpu.service import contract
+from matchmaking_tpu.service.contract import (
+    ANY,
+    ContractError,
+    MatchResult,
+    PartyMember,
+    SearchRequest,
+    SearchResponse,
+    decode_request,
+    decode_response,
+    encode_request,
+    encode_response,
+)
+
+
+def test_minimal_request_roundtrip():
+    req = SearchRequest(id="p1", rating=1500.0)
+    got = decode_request(encode_request(req))
+    assert got.id == "p1"
+    assert got.rating == 1500.0
+    assert got.region == ANY and got.game_mode == ANY
+    assert got.rating_threshold is None
+    assert got.party_size == 1
+
+
+def test_full_request_roundtrip():
+    req = SearchRequest(
+        id="lead", rating=1800.5, rating_deviation=120.0, game_mode="ranked",
+        region="eu", rating_threshold=42.0, roles=("tank", "dps"),
+        party=(PartyMember("m2", 1750.0, 90.0, ("healer",)),
+               PartyMember("m3", 1820.0)),
+    )
+    got = decode_request(encode_request(req))
+    assert got.rating_deviation == 120.0
+    assert got.game_mode == "ranked" and got.region == "eu"
+    assert got.rating_threshold == 42.0
+    assert got.roles == ("tank", "dps")
+    assert got.party_size == 3
+    assert got.party[0].roles == ("healer",)
+    assert got.all_ids() == ("lead", "m2", "m3")
+
+
+def test_request_transport_metadata_not_in_body():
+    req = SearchRequest(id="p", rating=1.0, reply_to="q.reply", correlation_id="c1")
+    body = json.loads(encode_request(req))
+    assert "reply_to" not in body and "correlation_id" not in body
+
+
+@pytest.mark.parametrize("body,code", [
+    (b"not json", "bad_json"),
+    (b"[1,2]", "bad_json"),
+    (b"{}", "missing_field"),
+    (b'{"id": "p"}', "missing_field"),
+    (b'{"id": 7, "rating": 1}', "bad_type"),
+    (b'{"id": "p", "rating": "high"}', "bad_type"),
+    (b'{"id": "p", "rating": true}', "bad_type"),
+    (b'{"id": "p", "rating": 1e9}', "bad_rating"),
+    (b'{"id": "p", "rating": 1, "rating_deviation": -1}', "bad_rating"),
+    (b'{"id": "p", "rating": 1, "rating_threshold": 0}', "bad_threshold"),
+    (b'{"id": "p", "rating": 1, "party": "x"}', "bad_type"),
+    (b'{"id": "p", "rating": 1, "party": [{"id":"p","rating":1}]}', "duplicate_player"),
+], ids=lambda v: v if isinstance(v, str) else "body")
+def test_decode_rejects(body, code):
+    with pytest.raises(ContractError) as ei:
+        decode_request(body)
+    assert ei.value.code == code
+
+
+def test_party_too_large():
+    party = [{"id": f"m{i}", "rating": 1} for i in range(5)]
+    body = json.dumps({"id": "p", "rating": 1, "party": party}).encode()
+    with pytest.raises(ContractError) as ei:
+        decode_request(body)
+    assert ei.value.code == "party_too_large"
+
+
+def test_response_roundtrip_matched():
+    resp = SearchResponse(
+        status="matched", player_id="p1",
+        match=MatchResult("m-1", ("p1", "p2"), (("p1",), ("p2",)), 0.875),
+        latency_ms=12.5,
+    )
+    got = decode_response(encode_response(resp))
+    assert got.status == "matched"
+    assert got.match.players == ("p1", "p2")
+    assert got.match.teams == (("p1",), ("p2",))
+    assert got.match.quality == 0.875
+    assert got.latency_ms == 12.5
+
+
+def test_response_roundtrip_error():
+    resp = SearchResponse(status="error", player_id="p", error_code="bad_json",
+                          error_reason="nope")
+    got = decode_response(encode_response(resp))
+    assert got.status == "error" and got.error_code == "bad_json"
+    assert got.match is None
+
+
+def test_fuzz_roundtrip(rng):
+    for _ in range(200):
+        req = SearchRequest(
+            id=f"p{rng.integers(1e9)}",
+            rating=float(rng.uniform(-5000, 5000)),
+            rating_deviation=float(rng.uniform(0, 500)),
+            game_mode=rng.choice(["*", "ranked", "casual"]),
+            region=rng.choice(["*", "eu", "na", "apac"]),
+            rating_threshold=float(rng.uniform(1, 500)) if rng.random() < 0.5 else None,
+        )
+        got = decode_request(encode_request(req))
+        assert got.id == req.id
+        assert got.rating == pytest.approx(req.rating)
+        assert got.region == req.region and got.game_mode == req.game_mode
+        assert (got.rating_threshold is None) == (req.rating_threshold is None)
+
+
+def test_roles_validation():
+    with pytest.raises(ContractError) as ei:
+        decode_request(b'{"id":"p","rating":1,"roles":"tank"}')
+    assert ei.value.code == "bad_type"
+    with pytest.raises(ContractError):
+        decode_request(b'{"id":"p","rating":1,"roles":5}')
+    with pytest.raises(ContractError):
+        decode_request(b'{"id":"p","rating":1,"roles":[1,2]}')
+    got = decode_request(b'{"id":"p","rating":1,"roles":["tank","dps"]}')
+    assert got.roles == ("tank", "dps")
+
+
+def test_config_from_env_top_level_scalars(monkeypatch):
+    from matchmaking_tpu.config import Config
+    monkeypatch.setenv("MM_WORKERS", "4")
+    monkeypatch.setenv("MM_SEED", "7")
+    cfg = Config.from_env()
+    assert cfg.workers == 4 and cfg.seed == 7

@@ -1,0 +1,248 @@
+"""CPU oracle engine: reference sequential-scan semantics (SURVEY.md §3
+Entry 2) plus the BASELINE config variants it anchors."""
+
+import numpy as np
+import pytest
+
+from matchmaking_tpu.config import Config, EngineConfig, QueueConfig
+from matchmaking_tpu.engine.cpu import CpuEngine
+from matchmaking_tpu.engine.scoring import glicko_g
+from matchmaking_tpu.service.contract import PartyMember, SearchRequest
+
+
+def make_engine(**queue_kw):
+    cfg = Config(engine=EngineConfig(backend="cpu"))
+    return CpuEngine(cfg, QueueConfig(**queue_kw))
+
+
+def req(pid, rating, **kw):
+    return SearchRequest(id=pid, rating=rating, **kw)
+
+
+def test_first_request_queues():
+    eng = make_engine()
+    out = eng.search([req("a", 1500)], now=0.0)
+    assert not out.matches and [r.id for r in out.queued] == ["a"]
+    assert eng.pool_size() == 1
+
+
+def test_pair_within_threshold_matches():
+    eng = make_engine(rating_threshold=100)
+    eng.search([req("a", 1500)], now=0.0)
+    out = eng.search([req("b", 1550)], now=0.0)
+    assert len(out.matches) == 1
+    m = out.matches[0]
+    assert sorted(p for t in m.teams for r in t for p in r.all_ids()) == ["a", "b"]
+    assert eng.pool_size() == 0
+    assert m.quality == pytest.approx(0.5)
+
+
+def test_outside_threshold_queues():
+    eng = make_engine(rating_threshold=100)
+    eng.search([req("a", 1500)], now=0.0)
+    out = eng.search([req("b", 1601)], now=0.0)
+    assert not out.matches and eng.pool_size() == 2
+
+
+def test_nearest_candidate_wins():
+    eng = make_engine(rating_threshold=100)
+    # far(1590) and near(1420) are 170 apart → can't match each other, but
+    # both are within 100 of q(1500); the nearer (Δ80 vs Δ90) must win.
+    eng.search([req("far", 1590), req("near", 1420)], now=0.0)
+    assert eng.pool_size() == 2
+    out = eng.search([req("q", 1500)], now=0.0)
+    ids = {p for t in out.matches[0].teams for r in t for p in r.all_ids()}
+    assert ids == {"q", "near"}
+    assert eng.pool_size() == 1  # "far" still waiting
+
+
+def test_mutual_threshold():
+    # Candidate's tighter per-request threshold must also hold.
+    eng = make_engine(rating_threshold=100)
+    eng.search([req("strict", 1500, rating_threshold=10.0)], now=0.0)
+    out = eng.search([req("q", 1550)], now=0.0)
+    assert not out.matches  # Δ=50 fits q's 100 but not strict's 10
+    out = eng.search([req("q2", 1505)], now=0.0)
+    assert len(out.matches) == 1
+
+
+def test_sequential_order_within_window():
+    # Reference semantics: requests processed one at a time, in order —
+    # two compatible requests in ONE window match each other.
+    eng = make_engine(rating_threshold=100)
+    out = eng.search([req("a", 1500), req("b", 1520)], now=0.0)
+    assert len(out.matches) == 1 and eng.pool_size() == 0
+
+
+def test_duplicate_enqueue_is_noop():
+    eng = make_engine()
+    eng.search([req("a", 1500)], now=0.0)
+    out = eng.search([req("a", 1500)], now=0.0)
+    assert not out.matches and not out.queued and eng.pool_size() == 1
+
+
+def test_remove_cancels_waiting_player():
+    eng = make_engine()
+    eng.search([req("a", 1500)], now=0.0)
+    got = eng.remove("a")
+    assert got is not None and got.id == "a" and eng.pool_size() == 0
+    assert eng.remove("a") is None
+
+
+def test_region_mode_hard_filters():
+    # BASELINE config #2.
+    eng = make_engine(rating_threshold=100)
+    eng.search([req("eu", 1500, region="eu", game_mode="ranked")], now=0.0)
+    out = eng.search([req("na", 1500, region="na", game_mode="ranked")], now=0.0)
+    assert not out.matches
+    out = eng.search([req("eu2", 1500, region="eu", game_mode="casual")], now=0.0)
+    assert not out.matches
+    out = eng.search([req("eu3", 1500, region="eu", game_mode="ranked")], now=0.0)
+    assert len(out.matches) == 1
+    # Wildcard region matches anything.
+    out = eng.search([req("any", 1500)], now=0.0)
+    assert len(out.matches) == 1  # pairs with remaining na or eu2
+
+
+def test_threshold_widening_over_wait():
+    # Config-gated (SURVEY.md §2 C9): +10 rating points per second waited.
+    eng = make_engine(rating_threshold=50, widen_per_sec=10.0, max_threshold=400)
+    eng.search([req("a", 1500, enqueued_at=0.0)], now=0.0)
+    out = eng.search([req("b", 1580, enqueued_at=10.0)], now=10.0)
+    # Δ=80 > 50 base, but a has waited 10s → threshold 150; b's is 50... mutual fails.
+    assert not out.matches
+    out = eng.search([req("c", 1580, enqueued_at=0.0)], now=10.0)
+    # c also "waited" 10s → both thresholds 150 ≥ 80 → match with a.
+    assert len(out.matches) == 1
+
+
+def test_glicko2_uncertain_players_match_wider():
+    # BASELINE config #4: g-weighted distance lets high-RD pairs match.
+    eng = make_engine(rating_threshold=100, glicko2=True)
+    delta = 140.0
+    g = glicko_g(350.0, 350.0)
+    assert g * delta < 100.0 < delta  # the case this test pins
+    eng.search([req("a", 1500, rating_deviation=350.0)], now=0.0)
+    out = eng.search([req("b", 1500 + delta, rating_deviation=350.0)], now=0.0)
+    assert len(out.matches) == 1
+    # Certain players (rd=0) at the same Δ do NOT match.
+    eng2 = make_engine(rating_threshold=100, glicko2=True)
+    eng2.search([req("c", 1500, rating_deviation=0.0)], now=0.0)
+    out = eng2.search([req("d", 1500 + delta, rating_deviation=0.0)], now=0.0)
+    assert not out.matches
+
+
+def test_checkpoint_restore_roundtrip():
+    # SURVEY.md §5: waiting pool is the checkpoint payload.
+    eng = make_engine(rating_threshold=10)
+    eng.search([req("a", 1000), req("b", 2000), req("c", 3000)], now=0.0)
+    snap = eng.waiting()
+    eng2 = make_engine(rating_threshold=10)
+    eng2.restore(snap, now=1.0)
+    assert eng2.pool_size() == 3
+    out = eng2.search([req("q", 2001)], now=1.0)
+    ids = {p for t in out.matches[0].teams for r in t for p in r.all_ids()}
+    assert ids == {"q", "b"}
+
+
+# ---- 5v5 team-balanced (BASELINE config #3) -------------------------------
+
+
+def test_5v5_forms_balanced_teams(rng):
+    eng = make_engine(team_size=5, rating_threshold=200)
+    ratings = [1500 + i * 10 for i in range(9)]
+    out = None
+    for i, r in enumerate(ratings):
+        out = eng.search([req(f"p{i}", r)], now=0.0)
+        assert not out.matches
+    out = eng.search([req("p9", 1590)], now=0.0)
+    assert len(out.matches) == 1
+    m = out.matches[0]
+    assert len(m.teams) == 2 and all(len(t) == 5 for t in m.teams)
+    sum_a = sum(r.rating for r in m.teams[0])
+    sum_b = sum(r.rating for r in m.teams[1])
+    assert abs(sum_a - sum_b) <= 200
+    assert eng.pool_size() == 0
+    assert 0.0 <= m.quality <= 1.0
+
+
+def test_5v5_wide_spread_does_not_match():
+    eng = make_engine(team_size=5, rating_threshold=50)
+    for i in range(10):
+        out = eng.search([req(f"p{i}", 1000 + i * 100)], now=0.0)  # spread 900
+    assert not out.matches and eng.pool_size() == 10
+
+
+def test_5v5_takes_tightest_window():
+    eng = make_engine(team_size=5, rating_threshold=100)
+    # 10 tight players + 2 outliers; the formed match must use the tight ten.
+    for i in range(10):
+        eng.search([req(f"t{i}", 1500 + i)], now=0.0)
+    # pool drained by the 10th insert
+    assert eng.pool_size() == 0
+
+
+# ---- role-queue party matchmaking (BASELINE config #5) --------------------
+
+
+def test_party_role_queue_match():
+    slots = ("tank", "healer", "dps")
+    eng = make_engine(team_size=3, rating_threshold=100, role_slots=slots)
+    # Two 2-player parties (tank+healer) and two solo dps.
+    p1 = SearchRequest(id="a1", rating=1500, roles=("tank",),
+                       party=(PartyMember("a2", 1510, roles=("healer",)),))
+    p2 = SearchRequest(id="b1", rating=1505, roles=("tank",),
+                       party=(PartyMember("b2", 1495, roles=("healer",)),))
+    eng.search([p1], now=0.0)
+    eng.search([p2], now=0.0)
+    eng.search([SearchRequest(id="d1", rating=1500, roles=("dps",))], now=0.0)
+    out = eng.search([SearchRequest(id="d2", rating=1502, roles=("dps",))], now=0.0)
+    assert len(out.matches) == 1
+    m = out.matches[0]
+    team_ids = [set(p for r in t for p in r.all_ids()) for t in m.teams]
+    # Parties stay together.
+    for t in team_ids:
+        assert ({"a1", "a2"} <= t) or ({"b1", "b2"} <= t)
+    assert all(len(t) == 3 for t in team_ids)
+    assert eng.pool_size() == 0
+
+
+def test_party_without_role_coverage_waits():
+    slots = ("tank", "healer", "dps")
+    eng = make_engine(team_size=3, rating_threshold=100, role_slots=slots)
+    # Six dps-only players cannot cover tank/healer slots.
+    out = None
+    for i in range(6):
+        out = eng.search([SearchRequest(id=f"d{i}", rating=1500, roles=("dps",))], now=0.0)
+    assert not out.matches and eng.pool_size() == 6
+
+
+def test_party_rejected_on_non_role_queue():
+    # A party can only be served by a role-slot team queue (config #5);
+    # elsewhere it must be rejected, not silently stranded in the pool.
+    for kw in (dict(), dict(team_size=5)):
+        eng = make_engine(**kw)
+        party_req = SearchRequest(id="lead", rating=1500,
+                                  party=(PartyMember("m2", 1510),))
+        out = eng.search([party_req], now=0.0)
+        assert not out.matches and not out.queued
+        assert [(r.id, code) for r, code in out.rejected] == [("lead", "party_not_supported")]
+        assert eng.pool_size() == 0
+
+
+def test_team_queue_honors_per_request_threshold():
+    # A strict player's threshold must bound the whole window.
+    eng = make_engine(team_size=2, rating_threshold=500)
+    eng.search([req("strict", 1500, rating_threshold=5.0)], now=0.0)
+    eng.search([req("a", 1540), req("b", 1560)], now=0.0)
+    out = eng.search([req("c", 1580)], now=0.0)
+    # Window containing strict (spread 80 > 5) is invalid; but a,b,c,strict →
+    # tightest valid window must EXCLUDE strict only if a 4-window exists
+    # without it; with 4 players only one window exists → no match.
+    assert not out.matches and eng.pool_size() == 4
+    out = eng.search([req("d", 1520)], now=0.0)
+    # Now a,b,c,d (spread 60 ≤ 500 and all thresholds 500) can form a match
+    # excluding strict.
+    assert len(out.matches) == 1
+    ids = {p for t in out.matches[0].teams for r in t for p in r.all_ids()}
+    assert "strict" not in ids
