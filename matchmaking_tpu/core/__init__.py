@@ -1,0 +1,1 @@
+"""Core state: the device-resident player pool and its host-side mirror."""

@@ -1,0 +1,190 @@
+"""The player pool: a structure-of-arrays resident in device HBM.
+
+This is the TPU-native replacement for the reference's ETS table (SURVEY.md
+§2 C8): where the reference keeps queued players as rows in an in-memory BEAM
+table scanned per request, we keep them as fixed-capacity parallel arrays in
+HBM so a whole request window scores against every waiting player in one
+vectorized kernel.
+
+Design (SURVEY.md §7 step 1):
+
+- **Fixed capacity P, static shapes.** Slots are recycled through a host-side
+  free list; XLA never sees a dynamic pool size (recompile-free hot path).
+- **Single-writer slot allocator on the host** (SURVEY.md §5 "Race
+  detection"): all admissions/evictions flow through one `PlayerPool` object;
+  the device arrays are updated only by the jitted step functions it calls.
+- **Authoritative host mirror.** The host keeps every waiting request (slot →
+  SearchRequest). Device state is a pure function of the mirror, which makes
+  the mirror the checkpoint: on sidecar death, re-admit the mirror
+  (SURVEY.md §5 "Checkpoint/resume").
+- **String interning.** Wire-level region/game-mode strings are interned to
+  int32 codes (0 = wildcard) so filter masks are integer compares on device.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Mapping, Sequence
+
+import numpy as np
+
+from matchmaking_tpu.service.contract import ANY, SearchRequest
+
+# Field definitions for the device SoA. Kept in one place so the kernels, the
+# pool, and the sharded engine agree on array layout.
+POOL_FIELDS: tuple[tuple[str, np.dtype], ...] = (
+    ("rating", np.float32),
+    ("rd", np.float32),          # Glicko-2 rating deviation
+    ("region", np.int32),        # interned; 0 = ANY
+    ("mode", np.int32),          # interned; 0 = ANY
+    ("threshold", np.float32),   # base rating_threshold for this player
+    ("enqueue_t", np.float32),   # seconds; widening input
+    ("active", np.bool_),
+)
+
+
+class Interner:
+    """str → dense int32 codes; code 0 is reserved for the ANY wildcard."""
+
+    def __init__(self) -> None:
+        self._codes: dict[str, int] = {ANY: 0}
+        self._names: list[str] = [ANY]
+
+    def code(self, name: str) -> int:
+        c = self._codes.get(name)
+        if c is None:
+            c = len(self._names)
+            self._codes[name] = c
+            self._names.append(name)
+        return c
+
+    def name(self, code: int) -> str:
+        return self._names[code]
+
+
+class PoolFullError(RuntimeError):
+    pass
+
+
+@dataclass
+class BatchArrays:
+    """A padded request window, ready for the device (host numpy; the engine
+    moves it with the step call). ``valid`` masks padding lanes."""
+
+    slot: np.ndarray      # i32[B] — pre-allocated pool slot per request
+    rating: np.ndarray    # f32[B]
+    rd: np.ndarray        # f32[B]
+    region: np.ndarray    # i32[B]
+    mode: np.ndarray      # i32[B]
+    threshold: np.ndarray # f32[B]
+    enqueue_t: np.ndarray # f32[B]
+    valid: np.ndarray     # bool[B]
+
+
+class PlayerPool:
+    """Host-side owner of the pool: slot allocator + authoritative mirror.
+
+    The device arrays themselves live with the engine (they are jitted-step
+    carry state); this class owns which slot means which player.
+    """
+
+    def __init__(self, capacity: int, default_threshold: float):
+        self.capacity = int(capacity)
+        self.default_threshold = float(default_threshold)
+        self._free = list(range(self.capacity - 1, -1, -1))  # pop() → slot 0 first
+        self._requests: dict[int, SearchRequest] = {}        # slot → request
+        self._slot_of: dict[str, int] = {}                   # player id → slot
+        self.regions = Interner()
+        self.modes = Interner()
+
+    # ---- introspection ----------------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self._requests)
+
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def __contains__(self, player_id: str) -> bool:
+        return player_id in self._slot_of
+
+    def slot_of(self, player_id: str) -> int | None:
+        return self._slot_of.get(player_id)
+
+    def request_at(self, slot: int) -> SearchRequest:
+        return self._requests[slot]
+
+    def waiting(self) -> list[SearchRequest]:
+        """Checkpoint payload: every waiting request (insertion-time data)."""
+        return list(self._requests.values())
+
+    # ---- mutation (single writer) -----------------------------------------
+
+    def allocate(self, requests: Sequence[SearchRequest]) -> list[int]:
+        """Assign slots to new requests and record them in the mirror."""
+        if len(requests) > len(self._free):
+            raise PoolFullError(
+                f"pool exhausted: {len(requests)} requested, {len(self._free)} free "
+                f"(capacity {self.capacity})"
+            )
+        slots = []
+        for req in requests:
+            if req.id in self._slot_of:
+                raise ValueError(f"player {req.id!r} already in pool")
+            slot = self._free.pop()
+            self._requests[slot] = req
+            self._slot_of[req.id] = slot
+            slots.append(slot)
+        return slots
+
+    def release(self, slots: Sequence[int]) -> None:
+        """Evict slots (matched / cancelled / timed out) from the mirror."""
+        for slot in slots:
+            req = self._requests.pop(slot, None)
+            if req is None:
+                continue
+            del self._slot_of[req.id]
+            self._free.append(slot)
+
+    # ---- array building ---------------------------------------------------
+
+    def effective_base_threshold(self, req: SearchRequest) -> float:
+        return req.rating_threshold if req.rating_threshold is not None else self.default_threshold
+
+    def batch_arrays(self, requests: Sequence[SearchRequest], slots: Sequence[int],
+                     bucket: int, t_offset: float = 0.0) -> BatchArrays:
+        """Pack a window into padded arrays of size ``bucket``. Padding lanes
+        get slot = capacity (the scatter sentinel the kernels drop).
+
+        ``t_offset`` rebases wall-clock timestamps: device times are float32,
+        whose spacing at epoch magnitude (~1.7e9 s) is 128 s — far too coarse
+        for threshold widening. The engine subtracts its start time so device
+        times stay small (sub-millisecond spacing for a week-long process).
+        """
+        b = len(requests)
+        assert b <= bucket
+        arr = BatchArrays(
+            slot=np.full(bucket, self.capacity, np.int32),
+            rating=np.zeros(bucket, np.float32),
+            rd=np.zeros(bucket, np.float32),
+            region=np.zeros(bucket, np.int32),
+            mode=np.zeros(bucket, np.int32),
+            threshold=np.zeros(bucket, np.float32),
+            enqueue_t=np.zeros(bucket, np.float32),
+            valid=np.zeros(bucket, np.bool_),
+        )
+        for i, (req, slot) in enumerate(zip(requests, slots)):
+            arr.slot[i] = slot
+            arr.rating[i] = req.rating
+            arr.rd[i] = req.rating_deviation
+            arr.region[i] = self.regions.code(req.region)
+            arr.mode[i] = self.modes.code(req.game_mode)
+            arr.threshold[i] = self.effective_base_threshold(req)
+            arr.enqueue_t[i] = req.enqueued_at - t_offset
+            arr.valid[i] = True
+        return arr
+
+    @staticmethod
+    def empty_device_arrays(capacity: int) -> dict[str, np.ndarray]:
+        """Initial HBM pool state (all slots inactive)."""
+        return {name: np.zeros(capacity, dtype) for name, dtype in POOL_FIELDS}

@@ -88,6 +88,25 @@ class CpuEngine(Engine):
     def _compatible(self, a: SearchRequest, b: SearchRequest) -> bool:
         return scoring.region_mode_compatible(a.region, a.game_mode, b.region, b.game_mode)
 
+    def _compat_groups(self, entries: list[SearchRequest]):
+        """Partition candidates into pairwise region/mode-compatible groups.
+
+        Pairwise compatibility with wildcards is not transitive (eu—*—na), so
+        team formation cannot use "compatible with the newest request" alone.
+        Each group is keyed by a concrete (region, mode) present in the pool;
+        a member must equal the key or be a wildcard on each axis, which
+        makes every pair inside a group mutually compatible. Wildcard players
+        appear in several groups; whichever group matches first wins (keys in
+        sorted order for determinism).
+        """
+        keys = sorted({(e.region, e.game_mode) for e in entries})
+        for key_r, key_m in keys:
+            members = [
+                e for e in entries
+                if e.region in (key_r, ANY) and e.game_mode in (key_m, ANY)
+            ]
+            yield (key_r, key_m), members
+
     def _search_1v1(self, req: SearchRequest, now: float, out: SearchOutcome) -> None:
         thr_req = self.effective_threshold(req, now)
         best_idx, best_dist = -1, np.inf
@@ -126,36 +145,49 @@ class CpuEngine(Engine):
         (config #5; implemented in ``roles.py`` helpers).
         """
         self._insert(req)
-        need = 2 * self.queue.team_size
         if self.queue.role_slots:
             from matchmaking_tpu.engine.roles import try_party_match
 
-            # Parties occupy multiple slots; delegate to the role/party oracle.
-            cands = [e for e in self._entries if self._compatible(req, e)]
-            formed = try_party_match(cands, self.queue, now, self)
-            if formed is not None:
-                teams, qual = formed
-                for r in (r for team in teams for r in team):
-                    self._evict(self._by_id[r.id])
-                out.matches.append(Match(new_match_id(), teams, qual))
-            if req.id in self._by_id:
-                out.queued.append(req)
-            return
-        cand_idx = [
-            i for i, e in enumerate(self._entries)
-            if self._compatible(req, e) and e.party_size == 1
-        ]
-        if len(cand_idx) < need:
+            # Parties occupy multiple slots; delegate to the role/party
+            # oracle, one pairwise-compatible group at a time.
+            for _, members in self._compat_groups(list(self._entries)):
+                formed = try_party_match(members, self.queue, now, self)
+                if formed is not None:
+                    teams, qual = formed
+                    for r in (r for team in teams for r in team):
+                        self._evict(self._by_id[r.id])
+                    out.matches.append(Match(new_match_id(), teams, qual))
+                    break
+        else:
+            solos = [e for e in self._entries if e.party_size == 1]
+            for _, members in self._compat_groups(solos):
+                formed = self._try_team_window(members, now)
+                if formed is not None:
+                    teams, spread, thr = formed
+                    for p in (p for t in teams for p in t):
+                        self._evict(self._by_id[p.id])
+                    qual = max(0.0, 1.0 - spread / thr) if thr > 0 else 0.0
+                    out.matches.append(Match(new_match_id(), teams, qual))
+                    break
+        # The newest request may or may not be in the formed match; if it
+        # still waits, report it queued.
+        if req.id in self._by_id:
             out.queued.append(req)
-            return
-        # Per-player effective thresholds (honors per-request overrides and
-        # widening; a window is valid only if its spread fits EVERY member's
-        # threshold). Note: glicko2 weighting applies to 1v1 distance only —
-        # team spread is plain rating range (documented in config.py).
-        ratings = np.array([self._entries[i].rating for i in cand_idx])
-        thrs = np.array([
-            self.effective_threshold(self._entries[i], now) for i in cand_idx
-        ])
+
+    def _try_team_window(self, members: list[SearchRequest], now: float):
+        """Tightest valid 2×team_size rating window among ``members`` →
+        (teams, spread, thr) or None.
+
+        Per-player effective thresholds (honors per-request overrides and
+        widening; a window is valid only if its spread fits EVERY member's
+        threshold). Note: glicko2 weighting applies to 1v1 distance only —
+        team spread is plain rating range (documented in config.py).
+        """
+        need = 2 * self.queue.team_size
+        if len(members) < need:
+            return None
+        ratings = np.array([e.rating for e in members])
+        thrs = np.array([self.effective_threshold(e, now) for e in members])
         order = np.argsort(ratings, kind="stable")
         sorted_ratings = ratings[order]
         sorted_thrs = thrs[order]
@@ -164,29 +196,17 @@ class CpuEngine(Engine):
         win_thr = np.array([sorted_thrs[w:w + need].min() for w in range(n_win)])
         valid = spreads <= win_thr
         if not valid.any():
-            out.queued.append(req)
-            return
+            return None
         # Tightest valid window wins.
         w = int(np.argmin(np.where(valid, spreads, np.inf)))
         spread = float(spreads[w])
         thr = float(win_thr[w])
-        window = [cand_idx[int(order[w + j])] for j in range(need)]
-        players = [self._entries[i] for i in window]
+        players = [members[int(order[w + j])] for j in range(need)]
         # Snake split by descending rating: A B B A A B B A ... balances sums.
         players.sort(key=lambda r: -r.rating)
         team_a, team_b = [], []
         for j, p in enumerate(players):
             (team_a if (j % 4 in (0, 3)) else team_b).append(p)
-        sum_a = sum(p.rating for p in team_a)
-        sum_b = sum(p.rating for p in team_b)
-        if abs(sum_a - sum_b) > thr:
-            out.queued.append(req)
-            return
-        for p in players:
-            self._evict(self._by_id[p.id])
-        qual = max(0.0, 1.0 - spread / thr) if thr > 0 else 0.0
-        out.matches.append(Match(new_match_id(), (tuple(team_a), tuple(team_b)), qual))
-        # The newest request may or may not be part of the window; if it
-        # still waits, report it queued.
-        if req.id in self._by_id:
-            out.queued.append(req)
+        if abs(sum(p.rating for p in team_a) - sum(p.rating for p in team_b)) > thr:
+            return None
+        return (tuple(team_a), tuple(team_b)), spread, thr

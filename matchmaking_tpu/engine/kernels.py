@@ -1,0 +1,249 @@
+"""Jitted matching kernels — the TPU replacement for the reference hot loop.
+
+The reference scans the ETS pool sequentially per request (SURVEY.md §3
+Entry 2, the O(requests × pool) wall). Here one jitted step processes a whole
+request window against the whole pool:
+
+    admit (scatter) → blockwise score+mask → streaming top-k
+    → greedy conflict-free pairing → evict matched (scatter)
+
+TPU-first design notes (SURVEY.md §7 step 2):
+
+- **Static shapes everywhere**: pool capacity P, window bucket B, top-k K and
+  pool block size are compile-time constants; XLA compiles each (B, queue
+  config) pair once and the hot path never recompiles.
+- **Blockwise scoring** (`lax.scan` over pool blocks with a running top-k):
+  the full B×P score matrix at P=128k, B=1k would be 512 MB of HBM traffic —
+  streaming blocks keeps the working set at B×block and lets XLA fuse the
+  distance, masks, and top-k per block.
+- **No data-dependent Python control flow**: the pairing loop is a
+  `lax.fori_loop` with a fixed trip count; invalid lanes ride along masked.
+- **Scatter with sentinel-drop**: padding lanes carry slot index P (out of
+  bounds) and are dropped by `mode="drop"` scatters instead of branching.
+
+Everything here is pure: (pool arrays, batch arrays, now) → (new pool
+arrays, match arrays). Purity makes the device side race-free by
+construction (SURVEY.md §5 "Race detection") and lets the sharded engine
+reuse the same building blocks under `shard_map`.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from matchmaking_tpu.engine import scoring
+
+_NEG_INF = jnp.float32(-jnp.inf)
+
+
+def _effective_threshold(thr, enqueue_t, now, widen_per_sec: float, max_threshold: float):
+    """Config-gated threshold widening by wait time (SURVEY.md §2 C9)."""
+    if widen_per_sec <= 0.0:
+        return thr
+    waited = jnp.maximum(0.0, now - enqueue_t)
+    return jnp.minimum(jnp.float32(max_threshold), thr + jnp.float32(widen_per_sec) * waited)
+
+
+# scoring.py is the semantic source of truth; its functions are plain
+# broadcastable math, valid on jnp arrays inside jit (the glicko2 flag is a
+# static Python bool, so tracing stays branch-free).
+_pair_distance = scoring.distance
+
+
+class KernelSet:
+    """Compiled step functions for one (pool geometry × queue config).
+
+    Parameters are static (baked into the compiled executables); per-call
+    data is only arrays + the ``now`` scalar.
+    """
+
+    def __init__(self, *, capacity: int, top_k: int, pool_block: int,
+                 glicko2: bool, widen_per_sec: float, max_threshold: float,
+                 evict_bucket: int = 64):
+        if capacity % pool_block != 0:
+            # Round the block down to a divisor to keep the scan uniform.
+            while capacity % pool_block != 0:
+                pool_block //= 2
+        self.capacity = capacity
+        self.top_k = min(top_k, pool_block)  # lax.top_k needs k ≤ block
+        self.pool_block = pool_block
+        self.n_blocks = capacity // pool_block
+        self.glicko2 = glicko2
+        self.widen_per_sec = widen_per_sec
+        self.max_threshold = max_threshold
+        self.evict_bucket = evict_bucket
+
+        self.admit = jax.jit(self._admit, donate_argnums=0)
+        self.evict = jax.jit(self._evict, donate_argnums=0)
+        self.search_step = jax.jit(self._search_step, donate_argnums=0)
+
+    # ---- admission / eviction --------------------------------------------
+
+    def _admit(self, pool: dict[str, Any], batch: dict[str, Any]) -> dict[str, Any]:
+        """Scatter a padded window into the pool (padding slot == P drops)."""
+        slot = batch["slot"]
+        out = dict(pool)
+        for name in ("rating", "rd", "region", "mode", "threshold", "enqueue_t"):
+            out[name] = pool[name].at[slot].set(batch[name], mode="drop")
+        out["active"] = pool["active"].at[slot].set(batch["valid"], mode="drop")
+        return out
+
+    def _evict(self, pool: dict[str, Any], slots: jnp.ndarray) -> dict[str, Any]:
+        out = dict(pool)
+        out["active"] = pool["active"].at[slots].set(False, mode="drop")
+        return out
+
+    # ---- scoring ----------------------------------------------------------
+
+    def _score_block(self, batch: dict[str, Any], q_thr_eff, pool: dict[str, Any],
+                     start, now):
+        """Masked scores of the window vs one pool block: f32[B, block]."""
+        blk = self.pool_block
+        sl = lambda name: lax.dynamic_slice_in_dim(pool[name], start, blk)
+        c_rating, c_rd = sl("rating"), sl("rd")
+        c_region, c_mode = sl("region"), sl("mode")
+        c_thr, c_enq, c_active = sl("threshold"), sl("enqueue_t"), sl("active")
+
+        d = _pair_distance(
+            batch["rating"][:, None], c_rating[None, :],
+            batch["rd"][:, None], c_rd[None, :], glicko2=self.glicko2,
+        )
+        c_thr_eff = _effective_threshold(c_thr, c_enq, now,
+                                         self.widen_per_sec, self.max_threshold)
+        limit = jnp.minimum(q_thr_eff[:, None], c_thr_eff[None, :])
+
+        q_reg, q_mod = batch["region"][:, None], batch["mode"][:, None]
+        c_reg, c_mod = c_region[None, :], c_mode[None, :]
+        region_ok = (q_reg == 0) | (c_reg == 0) | (q_reg == c_reg)
+        mode_ok = (q_mod == 0) | (c_mod == 0) | (q_mod == c_mod)
+
+        global_idx = start + jnp.arange(blk, dtype=jnp.int32)
+        not_self = batch["slot"][:, None] != global_idx[None, :]
+
+        valid = (
+            c_active[None, :] & batch["valid"][:, None]
+            & region_ok & mode_ok & not_self & (d <= limit)
+        )
+        return jnp.where(valid, -d, _NEG_INF)
+
+    def _topk_candidates(self, batch: dict[str, Any], q_thr_eff,
+                         pool: dict[str, Any], now):
+        """Streaming top-k over pool blocks: (vals f32[B,K], idx i32[B,K])."""
+        b = batch["rating"].shape[0]
+        k = self.top_k
+
+        def body(carry, blk_i):
+            best_v, best_i = carry
+            start = blk_i * self.pool_block
+            scores = self._score_block(batch, q_thr_eff, pool, start, now)
+            v, i = lax.top_k(scores, k)
+            gi = i.astype(jnp.int32) + start
+            cat_v = jnp.concatenate([best_v, v], axis=1)
+            cat_i = jnp.concatenate([best_i, gi], axis=1)
+            nv, sel = lax.top_k(cat_v, k)
+            ni = jnp.take_along_axis(cat_i, sel, axis=1)
+            return (nv, ni), None
+
+        init = (
+            jnp.full((b, k), _NEG_INF, jnp.float32),
+            jnp.full((b, k), self.capacity, jnp.int32),
+        )
+        (vals, idxs), _ = lax.scan(body, init, jnp.arange(self.n_blocks, dtype=jnp.int32))
+        return vals, idxs
+
+    # ---- pairing ----------------------------------------------------------
+
+    def greedy_pair(self, vals, idxs, self_slot):
+        """Greedy conflict-free pairing over the B×K candidate lists.
+
+        Repeatedly takes the globally best remaining (request, candidate)
+        edge and retires both endpoints — the batched analog of the
+        reference's "best candidate wins" applied in score order; a NumPy
+        mirror of this exact loop is the oracle in tests.
+
+        Returns (q_slot i32[B], c_slot i32[B], dist f32[B]); unmatched lanes
+        hold the sentinel P.
+        """
+        b, k = vals.shape
+        P = self.capacity
+
+        def body(i, state):
+            row_used, slot_used, out_q, out_c, out_d = state
+            cand_used = slot_used[jnp.clip(idxs, 0, P - 1)] | (idxs >= P)
+            self_used = slot_used[jnp.clip(self_slot, 0, P - 1)] | (self_slot >= P)
+            dead = row_used[:, None] | cand_used | self_used[:, None]
+            masked = jnp.where(dead, _NEG_INF, vals)
+            flat = masked.reshape(-1)
+            a = jnp.argmax(flat)
+            v = flat[a]
+            ok = v > _NEG_INF
+            r = a // k
+            c = idxs.reshape(-1)[a]
+            sq = self_slot[r]
+            out_q = out_q.at[i].set(jnp.where(ok, sq, P))
+            out_c = out_c.at[i].set(jnp.where(ok, c, P))
+            out_d = out_d.at[i].set(jnp.where(ok, -v, jnp.float32(jnp.inf)))
+            row_used = row_used.at[r].set(row_used[r] | ok)
+            slot_used = slot_used.at[jnp.clip(sq, 0, P - 1)].max(ok)
+            slot_used = slot_used.at[jnp.clip(c, 0, P - 1)].max(ok)
+            return row_used, slot_used, out_q, out_c, out_d
+
+        init = (
+            jnp.zeros(b, jnp.bool_),
+            jnp.zeros(P, jnp.bool_),
+            jnp.full(b, P, jnp.int32),
+            jnp.full(b, P, jnp.int32),
+            jnp.full(b, jnp.inf, jnp.float32),
+        )
+        _, _, out_q, out_c, out_d = lax.fori_loop(0, b, body, init)
+        return out_q, out_c, out_d
+
+    # ---- the full step ----------------------------------------------------
+
+    def _search_step(self, pool: dict[str, Any], batch: dict[str, Any], now):
+        """One window: admit → score → top-k → pair → evict matched.
+
+        Returns (pool', q_slot[B], c_slot[B], quality[B]) with sentinel P in
+        unmatched lanes.
+        """
+        pool = self._admit(pool, batch)
+        q_thr_eff = _effective_threshold(
+            batch["threshold"], batch["enqueue_t"], now,
+            self.widen_per_sec, self.max_threshold,
+        )
+        vals, idxs = self._topk_candidates(batch, q_thr_eff, pool, now)
+        out_q, out_c, out_d = self.greedy_pair(vals, idxs, batch["slot"])
+
+        # Evict both sides of every formed pair (sentinel P drops).
+        active = pool["active"].at[out_q].set(False, mode="drop")
+        active = active.at[out_c].set(False, mode="drop")
+        pool = dict(pool, active=active)
+
+        # Quality from the pair's own effective limits: 1 − d / min(thr).
+        P = self.capacity
+        matched = out_q < P
+        gather = lambda arr, idx: arr[jnp.clip(idx, 0, P - 1)]
+        thr_eff_pool = _effective_threshold(
+            pool["threshold"], pool["enqueue_t"], now,
+            self.widen_per_sec, self.max_threshold,
+        )
+        limit = jnp.minimum(gather(thr_eff_pool, out_q), gather(thr_eff_pool, out_c))
+        quality = jnp.where(
+            matched & (limit > 0), jnp.maximum(0.0, 1.0 - out_d / limit), 0.0
+        )
+        return pool, out_q, out_c, quality
+
+
+@functools.lru_cache(maxsize=None)
+def kernel_set(capacity: int, top_k: int, pool_block: int, glicko2: bool,
+               widen_per_sec: float, max_threshold: float) -> KernelSet:
+    """Cached KernelSet per static config (compile once per queue shape)."""
+    return KernelSet(
+        capacity=capacity, top_k=top_k, pool_block=pool_block, glicko2=glicko2,
+        widen_per_sec=widen_per_sec, max_threshold=max_threshold,
+    )

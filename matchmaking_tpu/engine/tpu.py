@@ -1,0 +1,192 @@
+"""The TPU engine: batched window matching over a device-resident pool.
+
+This is the ``engine: "tpu"`` backend behind the ``Engine`` seam — the
+rebuild's answer to the north star (BASELINE.json): instead of a sequential
+per-request pool scan, a window of requests is admitted into the HBM pool and
+matched by one jitted kernel step (see ``engine/kernels.py``).
+
+Host/device split (SURVEY.md §7):
+
+- Host (this class): slot allocation, request mirror (= checkpoint),
+  bucketing windows to static shapes, mapping matched slot pairs back to
+  requests. Single writer — windows per queue are serialized, which is the
+  atomicity story: a matched player leaves the pool before the next window
+  is dispatched (SURVEY.md §7 "Hard parts: atomicity").
+- Device: admission scatter, blockwise score+mask, streaming top-k, greedy
+  conflict-free pairing, eviction scatter — one fused jitted step.
+
+Team/role queues (BASELINE configs #3/#5) currently run the host-side
+algorithms over the authoritative mirror (same semantics as the CPU oracle);
+the 1v1 paths (configs #1/#2/#4) — the north-star hot path — run on device.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from matchmaking_tpu.config import Config, QueueConfig
+from matchmaking_tpu.core.pool import BatchArrays, PlayerPool
+from matchmaking_tpu.engine.interface import Engine, Match, SearchOutcome
+from matchmaking_tpu.engine.kernels import kernel_set
+from matchmaking_tpu.service.contract import SearchRequest, new_match_id
+
+
+class TpuEngine(Engine):
+    def __init__(self, cfg: Config, queue: QueueConfig):
+        super().__init__(cfg, queue)
+        ec = cfg.engine
+        self.pool = PlayerPool(ec.pool_capacity, queue.rating_threshold)
+        self.kernels = kernel_set(
+            capacity=ec.pool_capacity,
+            top_k=ec.top_k,
+            pool_block=min(ec.pool_block, ec.pool_capacity),
+            glicko2=queue.glicko2,
+            widen_per_sec=queue.widen_per_sec,
+            max_threshold=queue.max_threshold,
+        )
+        self.buckets = tuple(sorted(ec.batch_buckets))
+        # Wall-clock rebase: device times are float32 (128 s spacing at epoch
+        # magnitude), so all device-visible times are relative to the first
+        # timestamp this engine sees.
+        self._t0: float | None = None
+        self._dev_pool = jax.device_put(
+            {k: jnp.asarray(v) for k, v in PlayerPool.empty_device_arrays(ec.pool_capacity).items()}
+        )
+        # Team/role queues: host-side matching over the mirror (same oracle
+        # semantics as CpuEngine); device kernels cover the 1v1 hot path.
+        self._team_delegate = None
+        if queue.team_size > 1:
+            from matchmaking_tpu.engine.cpu import CpuEngine
+
+            self._team_delegate = CpuEngine(cfg, queue)
+
+    # ---- Engine API -------------------------------------------------------
+
+    def search(self, requests: Sequence[SearchRequest], now: float) -> SearchOutcome:
+        if self._team_delegate is not None:
+            return self._team_delegate.search(requests, now)
+
+        out = SearchOutcome()
+        fresh: list[SearchRequest] = []
+        seen_ids: set[str] = set()
+        for req in requests:
+            if req.party_size > 1:
+                out.rejected.append((req, "party_not_supported"))
+            elif req.id in self.pool or req.id in seen_ids:
+                continue  # idempotent redelivery
+            else:
+                seen_ids.add(req.id)
+                fresh.append(req)
+
+        max_bucket = self.buckets[-1]
+        for start in range(0, len(fresh), max_bucket):
+            self._window(fresh[start:start + max_bucket], now, out)
+        return out
+
+    def remove(self, player_id: str) -> SearchRequest | None:
+        if self._team_delegate is not None:
+            return self._team_delegate.remove(player_id)
+        slot = self.pool.slot_of(player_id)
+        if slot is None:
+            return None
+        req = self.pool.request_at(slot)
+        self.pool.release([slot])
+        ev = np.full(self.kernels.evict_bucket, self.kernels.capacity, np.int32)
+        ev[0] = slot
+        self._dev_pool = self.kernels.evict(self._dev_pool, jnp.asarray(ev))
+        return req
+
+    def pool_size(self) -> int:
+        if self._team_delegate is not None:
+            return self._team_delegate.pool_size()
+        return len(self.pool)
+
+    def waiting(self) -> list[SearchRequest]:
+        if self._team_delegate is not None:
+            return self._team_delegate.waiting()
+        return self.pool.waiting()
+
+    def restore(self, requests: Sequence[SearchRequest], now: float) -> None:
+        """Re-admit a checkpoint without matching (device state is a pure
+        function of the mirror — SURVEY.md §5 checkpoint/resume)."""
+        if self._team_delegate is not None:
+            self._team_delegate.restore(requests, now)
+            return
+        fresh = [r for r in requests if r.id not in self.pool]
+        bucket = self.buckets[-1]
+        for start in range(0, len(fresh), bucket):
+            chunk = fresh[start:start + bucket]
+            slots = self.pool.allocate(chunk)
+            batch = self.pool.batch_arrays(chunk, slots, bucket, self._rel_base(now))
+            self._dev_pool = self.kernels.admit(self._dev_pool, _as_jnp(batch))
+
+    # ---- internals --------------------------------------------------------
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    def _rel_base(self, now: float) -> float:
+        if self._t0 is None:
+            self._t0 = now
+        return self._t0
+
+    def _window(self, window: list[SearchRequest], now: float, out: SearchOutcome) -> None:
+        if not window:
+            return
+        # Admit only what fits; reject the overflow (the reference has no
+        # capacity cap — ETS grows — so partial admission keeps us closest).
+        free = self.pool.free_count()
+        if len(window) > free:
+            for req in window[free:]:
+                out.rejected.append((req, "pool_full"))
+            window = window[:free]
+            if not window:
+                return
+        slots = self.pool.allocate(window)
+        bucket = self._bucket_for(len(window))
+        t0 = self._rel_base(now)
+        batch = self.pool.batch_arrays(window, slots, bucket, t0)
+        self._dev_pool, q_slot, c_slot, quality = self.kernels.search_step(
+            self._dev_pool, _as_jnp(batch), jnp.float32(now - t0)
+        )
+        # One small D2H transfer per window: three B-length arrays.
+        q_slot, c_slot, quality = (np.asarray(q_slot), np.asarray(c_slot),
+                                   np.asarray(quality))
+        P = self.kernels.capacity
+        matched_ids: set[str] = set()
+        for qs, cs, qual in zip(q_slot, c_slot, quality):
+            if qs >= P:
+                continue
+            req_q = self.pool.request_at(int(qs))
+            req_c = self.pool.request_at(int(cs))
+            self.pool.release([int(qs), int(cs)])
+            matched_ids.add(req_q.id)
+            matched_ids.add(req_c.id)
+            out.matches.append(
+                Match(match_id=new_match_id(), teams=((req_q,), (req_c,)),
+                      quality=float(qual))
+            )
+        for req in window:
+            if req.id not in matched_ids:
+                out.queued.append(req)
+
+
+def _as_jnp(batch: BatchArrays) -> dict[str, jnp.ndarray]:
+    return {
+        "slot": jnp.asarray(batch.slot),
+        "rating": jnp.asarray(batch.rating),
+        "rd": jnp.asarray(batch.rd),
+        "region": jnp.asarray(batch.region),
+        "mode": jnp.asarray(batch.mode),
+        "threshold": jnp.asarray(batch.threshold),
+        "enqueue_t": jnp.asarray(batch.enqueue_t),
+        "valid": jnp.asarray(batch.valid),
+    }

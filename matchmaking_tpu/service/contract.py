@@ -126,6 +126,16 @@ def _require(payload: Mapping[str, Any], key: str, types: tuple[type, ...]) -> A
     return val
 
 
+def _opt_num(payload: Mapping[str, Any], key: str, default: float | None) -> float | None:
+    """Optional numeric field: missing → default; non-numeric/bool → bad_type."""
+    if key not in payload:
+        return default
+    val = payload[key]
+    if not isinstance(val, (int, float)) or isinstance(val, bool):
+        raise ContractError("bad_type", f"field {key!r} must be a number")
+    return float(val)
+
+
 def _roles(obj: Mapping[str, Any]) -> tuple[str, ...]:
     raw = obj.get("roles", ())
     if isinstance(raw, (str, bytes)) or not isinstance(raw, Sequence):
@@ -138,10 +148,13 @@ def _roles(obj: Mapping[str, Any]) -> tuple[str, ...]:
 def _member(obj: Any) -> PartyMember:
     if not isinstance(obj, Mapping):
         raise ContractError("bad_type", "party member must be an object")
+    rd = _opt_num(obj, "rating_deviation", DEFAULT_RD)
+    if rd < 0:
+        raise ContractError("bad_rating", "rating_deviation must be >= 0")
     return PartyMember(
         id=str(_require(obj, "id", (str,))),
         rating=float(_require(obj, "rating", (int, float))),
-        rating_deviation=float(obj.get("rating_deviation", DEFAULT_RD)),
+        rating_deviation=rd,
         roles=_roles(obj),
     )
 
@@ -161,14 +174,12 @@ def decode_request(body: bytes | str, *, reply_to: str = "",
     rating = float(_require(payload, "rating", (int, float)))
     if not (-1e5 < rating < 1e5):
         raise ContractError("bad_rating", f"rating {rating} out of range")
-    rd = float(payload.get("rating_deviation", DEFAULT_RD))
+    rd = _opt_num(payload, "rating_deviation", DEFAULT_RD)
     if rd < 0:
         raise ContractError("bad_rating", "rating_deviation must be >= 0")
-    thr = payload.get("rating_threshold")
-    if thr is not None:
-        thr = float(thr)
-        if thr <= 0:
-            raise ContractError("bad_threshold", "rating_threshold must be > 0")
+    thr = _opt_num(payload, "rating_threshold", None)
+    if thr is not None and thr <= 0:
+        raise ContractError("bad_threshold", "rating_threshold must be > 0")
     party_raw = payload.get("party", ())
     if not isinstance(party_raw, Sequence) or isinstance(party_raw, (str, bytes)):
         raise ContractError("bad_type", "party must be an array")

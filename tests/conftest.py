@@ -13,6 +13,14 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
+# The axon sitecustomize hook on this machine force-sets
+# jax_platforms="axon,cpu" at interpreter start, which makes the first
+# backend init dial the TPU relay (extremely slow / unavailable under test).
+# Override it back to cpu-only BEFORE any backend initialization.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np
 import pytest
 

@@ -138,3 +138,13 @@ def test_config_from_env_top_level_scalars(monkeypatch):
     monkeypatch.setenv("MM_SEED", "7")
     cfg = Config.from_env()
     assert cfg.workers == 4 and cfg.seed == 7
+
+
+def test_numeric_fields_reject_non_numbers():
+    for body in (b'{"id":"a","rating":1500,"rating_deviation":"high"}',
+                 b'{"id":"a","rating":1500,"rating_threshold":"low"}',
+                 b'{"id":"a","rating":1500,"rating_deviation":true}',
+                 b'{"id":"a","rating":1,"party":[{"id":"m","rating":1,"rating_deviation":"x"}]}'):
+        with pytest.raises(ContractError) as ei:
+            decode_request(body)
+        assert ei.value.code in ("bad_type", "bad_rating")

@@ -246,3 +246,42 @@ def test_team_queue_honors_per_request_threshold():
     assert len(out.matches) == 1
     ids = {p for t in out.matches[0].teams for r in t for p in r.all_ids()}
     assert "strict" not in ids
+
+
+def test_team_queue_respects_pairwise_region_filters():
+    # Wildcards are not transitive: a(*) must not glue eu and us players
+    # into one match.
+    eng = make_engine(team_size=2, rating_threshold=100)
+    eng.search([req("b", 1500, region="eu")], now=0.0)
+    eng.search([req("c", 1502, region="us")], now=0.0)
+    eng.search([req("d", 1501, region="eu")], now=0.0)
+    out = eng.search([req("a", 1503)], now=0.0)  # wildcard region
+    if out.matches:
+        for team in out.matches[0].teams:
+            regions = {r.region for r in team} - {"*"}
+            assert len(regions) <= 1, f"mixed regions in team: {regions}"
+        all_regions = {r.region for t in out.matches[0].teams for r in t} - {"*"}
+        assert len(all_regions) <= 1
+    # The eu pair + wildcard a can form eu-keyed match of 4: b,d,a + one more
+    # needed... with only 4 players, the eu group is {b,d,a} (3 < 4) and us
+    # group is {c,a} (2 < 4) → no match at all.
+    assert not out.matches
+    assert eng.pool_size() == 4
+    # A second eu player completes the eu group.
+    out = eng.search([req("e", 1499, region="eu")], now=0.0)
+    assert len(out.matches) == 1
+    ids = {p for t in out.matches[0].teams for r in t for p in r.all_ids()}
+    assert "c" not in ids  # the us player must not be pulled in
+
+
+def test_role_queue_respects_pairwise_region_filters():
+    slots = ("dps", "dps")
+    eng = make_engine(team_size=1, rating_threshold=100, role_slots=slots)
+    # team_size=1 with role_slots is degenerate; use team_size=2 instead.
+    eng = make_engine(team_size=2, rating_threshold=100, role_slots=("dps", "dps"))
+    for pid, region in (("b", "eu"), ("c", "us"), ("d", "eu")):
+        eng.search([SearchRequest(id=pid, rating=1500, region=region, roles=("dps",))], now=0.0)
+    out = eng.search([SearchRequest(id="a", rating=1500, roles=("dps",))], now=0.0)
+    if out.matches:
+        all_regions = {r.region for t in out.matches[0].teams for r in t} - {"*"}
+        assert len(all_regions) <= 1

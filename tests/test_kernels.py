@@ -1,0 +1,232 @@
+"""Kernel unit tests on tiny pools: streaming top-k and greedy pairing vs a
+NumPy mirror (SURVEY.md §4: golden tests vs a NumPy oracle)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from matchmaking_tpu.core.pool import PlayerPool
+from matchmaking_tpu.engine import scoring
+from matchmaking_tpu.engine.kernels import KernelSet
+
+
+def np_greedy_pair(vals, idxs, self_slot, P):
+    """NumPy mirror of KernelSet.greedy_pair — the pairing oracle."""
+    vals = vals.copy().astype(np.float64)
+    b, k = vals.shape
+    row_used = np.zeros(b, bool)
+    slot_used = np.zeros(P + 1, bool)
+    pairs = []
+    for _ in range(b):
+        masked = vals.copy()
+        for r in range(b):
+            for j in range(k):
+                if (row_used[r] or idxs[r, j] >= P or slot_used[idxs[r, j]]
+                        or self_slot[r] >= P or slot_used[self_slot[r]]):
+                    masked[r, j] = -np.inf
+        a = int(np.argmax(masked))
+        r, j = divmod(a, k)
+        if masked[r, j] == -np.inf:
+            break
+        c = int(idxs[r, j])
+        pairs.append((int(self_slot[r]), c, -float(masked[r, j])))
+        row_used[r] = True
+        slot_used[self_slot[r]] = True
+        slot_used[c] = True
+    return pairs
+
+
+def make_kernels(capacity=256, top_k=4, pool_block=64, **kw):
+    defaults = dict(glicko2=False, widen_per_sec=0.0, max_threshold=400.0)
+    defaults.update(kw)
+    return KernelSet(capacity=capacity, top_k=top_k, pool_block=pool_block, **defaults)
+
+
+def empty_pool(capacity=256):
+    return {k: jnp.asarray(v) for k, v in PlayerPool.empty_device_arrays(capacity).items()}
+
+
+def make_batch(slots, ratings, bucket, capacity, thresholds=None, regions=None,
+               modes=None, rds=None, enq=None):
+    n = len(slots)
+    batch = {
+        "slot": np.full(bucket, capacity, np.int32),
+        "rating": np.zeros(bucket, np.float32),
+        "rd": np.zeros(bucket, np.float32),
+        "region": np.zeros(bucket, np.int32),
+        "mode": np.zeros(bucket, np.int32),
+        "threshold": np.full(bucket, 100.0, np.float32),
+        "enqueue_t": np.zeros(bucket, np.float32),
+        "valid": np.zeros(bucket, bool),
+    }
+    batch["slot"][:n] = slots
+    batch["rating"][:n] = ratings
+    batch["valid"][:n] = True
+    if thresholds is not None:
+        batch["threshold"][:n] = thresholds
+    if regions is not None:
+        batch["region"][:n] = regions
+    if modes is not None:
+        batch["mode"][:n] = modes
+    if rds is not None:
+        batch["rd"][:n] = rds
+    if enq is not None:
+        batch["enqueue_t"][:n] = enq
+    return {k: jnp.asarray(v) for k, v in batch.items()}
+
+
+def run_step(ks, pool, batch, now=0.0):
+    pool, q, c, qual = ks.search_step(pool, batch, jnp.float32(now))
+    return pool, np.asarray(q), np.asarray(c), np.asarray(qual)
+
+
+def test_single_pair_matches_in_one_window():
+    ks = make_kernels()
+    pool = empty_pool()
+    batch = make_batch([0, 1], [1500.0, 1540.0], bucket=4, capacity=256)
+    pool, q, c, qual = run_step(ks, pool, batch)
+    pairs = {(int(a), int(b)) for a, b in zip(q, c) if a < 256}
+    assert pairs == {(0, 1)} or pairs == {(1, 0)}
+    assert not bool(np.asarray(pool["active"]).any())
+    matched_qual = qual[q < 256]
+    assert matched_qual[0] == pytest.approx(1.0 - 40.0 / 100.0)
+
+
+def test_out_of_threshold_stays_active():
+    ks = make_kernels()
+    pool = empty_pool()
+    batch = make_batch([0, 1], [1500.0, 1700.0], bucket=4, capacity=256)
+    pool, q, c, _ = run_step(ks, pool, batch)
+    assert (q >= 256).all()
+    active = np.asarray(pool["active"])
+    assert active[0] and active[1] and active.sum() == 2
+
+
+def test_cross_window_match_with_waiting_player():
+    ks = make_kernels()
+    pool = empty_pool()
+    batch = make_batch([5], [1500.0], bucket=4, capacity=256)
+    pool, q, c, _ = run_step(ks, pool, batch)
+    assert (q >= 256).all()
+    batch2 = make_batch([9], [1520.0], bucket=4, capacity=256)
+    pool, q, c, _ = run_step(ks, pool, batch2)
+    got = {(int(a), int(b)) for a, b in zip(q, c) if a < 256}
+    assert got == {(9, 5)}
+    assert not bool(np.asarray(pool["active"]).any())
+
+
+def test_region_mode_masks():
+    ks = make_kernels()
+    pool = empty_pool()
+    # slot0: region 1 / mode 1. slot1: region 2 / mode 1 → incompatible.
+    # slot2: region 0 (ANY) → compatible with both.
+    batch = make_batch([0, 1], [1500.0, 1500.0], bucket=4, capacity=256,
+                       regions=[1, 2], modes=[1, 1])
+    pool, q, c, _ = run_step(ks, pool, batch)
+    assert (q >= 256).all()
+    batch2 = make_batch([2], [1500.0], bucket=4, capacity=256, regions=[0], modes=[0])
+    pool, q, c, _ = run_step(ks, pool, batch2)
+    got = [(int(a), int(b)) for a, b in zip(q, c) if a < 256]
+    assert len(got) == 1 and got[0][0] == 2 and got[0][1] in (0, 1)
+
+
+def test_greedy_takes_best_edge_first():
+    ks = make_kernels()
+    pool = empty_pool()
+    # Waiting candidate at 1500; two queries at 1490 (Δ10) and 1440 (Δ60).
+    batch = make_batch([0], [1500.0], bucket=4, capacity=256,
+                       thresholds=[500.0])
+    pool, _, _, _ = run_step(ks, pool, batch)
+    batch2 = make_batch([1, 2], [1490.0, 1440.0], bucket=4, capacity=256,
+                        thresholds=[500.0, 500.0])
+    pool, q, c, _ = run_step(ks, pool, batch2)
+    got = {(int(a), int(b)) for a, b in zip(q, c) if a < 256}
+    # Best edge is (1,0) Δ10; then 2 pairs with... 2's candidates: 0 (used) →
+    # next best is 2-1 but 1 is used as a row AND slot → 2 stays.
+    # Wait: after (1,0), query 2 can still match... both 0 and 1 are retired
+    # slots, so 2 stays active.
+    assert got == {(1, 0)}
+    active = np.asarray(pool["active"])
+    assert active[2] and active.sum() == 1
+
+
+def test_glicko2_device_matches_scoring_formula():
+    ks = make_kernels(glicko2=True)
+    pool = empty_pool()
+    delta = 140.0
+    batch = make_batch([0, 1], [1500.0, 1500.0 + delta], bucket=4, capacity=256,
+                       rds=[350.0, 350.0])
+    pool, q, c, qual = run_step(ks, pool, batch)
+    assert (q < 256).any()  # g·Δ ≈ 82.6 < 100 → matches
+    d = scoring.distance(1500.0, 1500.0 + delta, 350.0, 350.0, glicko2=True)
+    expect_q = scoring.quality(d, 100.0, 100.0)
+    assert qual[q < 256][0] == pytest.approx(expect_q, rel=1e-5)
+    # rd = 0 → plain distance 140 > 100 → no match.
+    pool2 = empty_pool()
+    batch2 = make_batch([0, 1], [1500.0, 1500.0 + delta], bucket=4, capacity=256,
+                        rds=[0.0, 0.0])
+    _, q2, _, _ = run_step(ks, pool2, batch2)
+    assert (q2 >= 256).all()
+
+
+def test_threshold_widening_on_device():
+    ks = make_kernels(widen_per_sec=10.0, max_threshold=400.0)
+    pool = empty_pool()
+    # Δ=150 > base 100, but at now=10 both have waited 10s → thr 200.
+    batch = make_batch([0, 1], [1500.0, 1650.0], bucket=4, capacity=256,
+                       enq=[0.0, 0.0])
+    pool, q, c, _ = run_step(ks, pool, batch, now=10.0)
+    assert (q < 256).any()
+
+
+def test_streaming_topk_spans_blocks(rng):
+    # The best candidate sits in the LAST pool block; streaming top-k must
+    # find it across block boundaries.
+    ks = make_kernels(capacity=256, pool_block=64)
+    # A query whose nearest candidate sits in the last block (slot 240).
+    pool2 = empty_pool()
+    b1 = make_batch([10, 240], [1000.0, 2000.0], bucket=4, capacity=256,
+                    thresholds=[5.0, 5.0])
+    pool2, *_ = run_step(ks, pool2, b1)
+    b2 = make_batch([3], [2001.0], bucket=4, capacity=256, thresholds=[5.0])
+    pool2, q, c, _ = run_step(ks, pool2, b2)
+    got = {(int(a), int(b)) for a, b in zip(q, c) if a < 256}
+    assert got == {(3, 240)}
+
+
+def test_greedy_pair_matches_numpy_oracle(rng):
+    # Random candidate lists → device pairing must equal the NumPy mirror.
+    ks = make_kernels(capacity=64, top_k=4)
+    for trial in range(10):
+        b, k, P = 8, 4, 64
+        vals = rng.uniform(-300, -1, (b, k)).astype(np.float32)
+        vals.sort(axis=1)
+        vals = vals[:, ::-1].copy()  # descending per row like top_k output
+        idxs = rng.integers(0, P, (b, k)).astype(np.int32)
+        self_slot = rng.choice(P, b, replace=False).astype(np.int32)
+        # Drop some lanes to -inf (invalid candidates).
+        kill = rng.random((b, k)) < 0.3
+        vals[kill] = -np.inf
+        q, c, d = ks.greedy_pair(jnp.asarray(vals), jnp.asarray(idxs),
+                                 jnp.asarray(self_slot))
+        got = [(int(a), int(bb), float(dd))
+               for a, bb, dd in zip(np.asarray(q), np.asarray(c), np.asarray(d))
+               if a < P]
+        expect = np_greedy_pair(vals, idxs, self_slot, P)
+        assert [(a, b2) for a, b2, _ in got] == [(a, b2) for a, b2, _ in expect]
+        for (_, _, dg), (_, _, de) in zip(got, expect):
+            assert dg == pytest.approx(de, rel=1e-5)
+
+
+def test_admit_and_evict_roundtrip():
+    ks = make_kernels()
+    pool = empty_pool()
+    batch = make_batch([3, 7], [1500.0, 1700.0], bucket=4, capacity=256)
+    pool = ks.admit(pool, batch)
+    active = np.asarray(pool["active"])
+    assert active[3] and active[7] and active.sum() == 2
+    ev = np.full(ks.evict_bucket, 256, np.int32)
+    ev[0] = 3
+    pool = ks.evict(pool, jnp.asarray(ev))
+    active = np.asarray(pool["active"])
+    assert not active[3] and active[7] and active.sum() == 1

@@ -1,0 +1,277 @@
+"""Oracle equivalence: the TPU engine vs the CPU reference-semantics oracle
+(SURVEY.md §4/§6: "oracle equivalence tests (tpu engine ≡ cpu engine
+semantics on small pools)").
+
+Two layers:
+
+1. **Exact equivalence on contention-free workloads** — when every player
+   has exactly one feasible partner, batched-greedy and sequential-scan must
+   produce identical match sets.
+2. **Invariant equivalence on adversarial random workloads** — under
+   contention the two engines may legally pick different winners (batched
+   greedy is score-ordered, the reference is arrival-ordered), but both must
+   uphold the same invariants: every match valid, no player matched twice or
+   left dangling, pool accounting exact. This is the online invariant
+   checker from SURVEY.md §5 ("no player matched twice / present twice").
+"""
+
+import numpy as np
+import pytest
+
+from matchmaking_tpu.config import Config, EngineConfig, QueueConfig
+from matchmaking_tpu.engine import scoring
+from matchmaking_tpu.engine.cpu import CpuEngine
+from matchmaking_tpu.engine.tpu import TpuEngine
+from matchmaking_tpu.service.contract import SearchRequest
+
+
+def small_cfg(**eng_kw):
+    defaults = dict(pool_capacity=512, top_k=4, batch_buckets=(8, 32),
+                    pool_block=128)
+    defaults.update(eng_kw)
+    return Config(engine=EngineConfig(**defaults))
+
+
+def engines(queue_kw=None, **eng_kw):
+    q = QueueConfig(**(queue_kw or {}))
+    cfg = small_cfg(**eng_kw)
+    return CpuEngine(cfg, q), TpuEngine(cfg, q)
+
+
+def pairs_of(outcome):
+    return {
+        frozenset(p for t in m.teams for r in t for p in r.all_ids())
+        for m in outcome.matches
+    }
+
+
+def eff_thr(req, queue, now):
+    base = req.rating_threshold if req.rating_threshold is not None else queue.rating_threshold
+    if queue.widen_per_sec <= 0:
+        return base
+    return min(queue.max_threshold,
+               base + queue.widen_per_sec * max(0.0, now - req.enqueued_at))
+
+
+def check_invariants(engine, queue, submitted, outcomes):
+    """The invariant checker: validity, no-double-match, exact accounting.
+
+    ``outcomes`` is a list of (outcome, now) pairs — validity is judged
+    against the effective (possibly widened) thresholds at match time.
+    """
+    matched, queued_ids, rejected_ids = set(), set(), set()
+    reqs = {}
+    for out, now in outcomes:
+        for m in out.matches:
+            flat = [r for t in m.teams for r in t]
+            for r in flat:
+                assert r.id not in matched, f"{r.id} matched twice"
+                matched.add(r.id)
+            assert len(flat) == 2  # 1v1 here
+            a, b = flat
+            d = scoring.distance(a.rating, b.rating, a.rating_deviation,
+                                 b.rating_deviation, glicko2=queue.glicko2)
+            limit = scoring.mutual_threshold(eff_thr(a, queue, now),
+                                             eff_thr(b, queue, now))
+            assert d <= limit + 1e-3, (
+                f"invalid match {a.id}-{b.id}: d={d} limit={limit}"
+            )
+            assert scoring.region_mode_compatible(a.region, a.game_mode,
+                                                  b.region, b.game_mode)
+        for r in out.queued:
+            queued_ids.add(r.id)
+        for r, _ in out.rejected:
+            rejected_ids.add(r.id)
+    for r in submitted:
+        reqs[r.id] = r
+        assert (r.id in matched) or (r.id in queued_ids) or (r.id in rejected_ids), (
+            f"{r.id} vanished: neither matched, queued, nor rejected"
+        )
+    # Pool contents == queued minus later matched.
+    waiting_ids = {r.id for r in engine.waiting()}
+    assert waiting_ids == {i for i in queued_ids if i not in matched}
+    assert engine.pool_size() == len(waiting_ids)
+
+
+def test_contention_free_exact_equivalence(rng):
+    # Isolated rating islands: pair i lives at 10000*i ± 5 with threshold 20
+    # → exactly one feasible partner each. Both engines must form identical
+    # pairs, regardless of windowing.
+    n_pairs = 40
+    reqs = []
+    for i in range(n_pairs):
+        base = 10000.0 * (i + 1)
+        reqs.append(SearchRequest(id=f"a{i}", rating=base, rating_threshold=20.0))
+        reqs.append(SearchRequest(id=f"b{i}", rating=base + 5.0, rating_threshold=20.0))
+    order = rng.permutation(len(reqs))
+    shuffled = [reqs[i] for i in order]
+
+    cpu, tpu = engines()
+    expected = {frozenset((f"a{i}", f"b{i}")) for i in range(n_pairs)}
+    cpu_out, tpu_out = [], []
+    # Feed in windows of 7 (deliberately not a bucket size).
+    for s in range(0, len(shuffled), 7):
+        w = shuffled[s:s + 7]
+        cpu_out.append(cpu.search(w, now=float(s)))
+        tpu_out.append(tpu.search(w, now=float(s)))
+    assert set().union(*[pairs_of(o) for o in cpu_out]) == expected
+    assert set().union(*[pairs_of(o) for o in tpu_out]) == expected
+    assert cpu.pool_size() == 0 and tpu.pool_size() == 0
+
+
+@pytest.mark.parametrize("queue_kw", [
+    {},                                            # config #1: plain 1v1 ELO
+    {"glicko2": True},                             # config #4
+    {"widen_per_sec": 5.0, "max_threshold": 300},  # widening
+])
+def test_random_workload_invariants(rng, queue_kw):
+    queue = QueueConfig(rating_threshold=80.0, **queue_kw)
+    cfg = small_cfg()
+    for eng_cls in (CpuEngine, TpuEngine):
+        eng = eng_cls(cfg, queue)
+        rng2 = np.random.default_rng(7)
+        submitted, outcomes = [], []
+        t = 0.0
+        pid = 0
+        for _ in range(12):
+            w = []
+            for _ in range(int(rng2.integers(1, 9))):
+                w.append(SearchRequest(
+                    id=f"p{pid}",
+                    rating=float(rng2.normal(1500, 120)),
+                    rating_deviation=float(rng2.uniform(0, 350)),
+                    rating_threshold=float(rng2.uniform(20, 150)) if rng2.random() < 0.4 else None,
+                    enqueued_at=t,
+                ))
+                pid += 1
+            submitted.extend(w)
+            outcomes.append((eng.search(w, now=t), t))
+            t += 1.0
+        check_invariants(eng, queue, submitted, outcomes)
+
+
+def test_region_filter_workload_invariants(rng):
+    # Config #2: hard filters under contention.
+    queue = QueueConfig(rating_threshold=100.0)
+    cfg = small_cfg()
+    regions = ["eu", "na", "apac", "*"]
+    modes = ["ranked", "casual", "*"]
+    for eng_cls in (CpuEngine, TpuEngine):
+        eng = eng_cls(cfg, queue)
+        rng2 = np.random.default_rng(11)
+        submitted, outcomes = [], []
+        for w_i in range(10):
+            w = [
+                SearchRequest(
+                    id=f"p{w_i}_{j}",
+                    rating=float(rng2.normal(1500, 60)),
+                    region=str(rng2.choice(regions)),
+                    game_mode=str(rng2.choice(modes)),
+                )
+                for j in range(int(rng2.integers(2, 8)))
+            ]
+            submitted.extend(w)
+            outcomes.append((eng.search(w, now=float(w_i)), float(w_i)))
+        check_invariants(eng, queue, submitted, outcomes)
+
+
+def test_matched_counts_comparable_under_contention(rng):
+    # Batched greedy may differ from sequential order, but it should not
+    # match dramatically fewer players on a dense workload.
+    queue = QueueConfig(rating_threshold=100.0)
+    cpu, tpu = engines()
+    rng2 = np.random.default_rng(3)
+    total_cpu = total_tpu = 0
+    for w_i in range(8):
+        w = [SearchRequest(id=f"p{w_i}_{j}", rating=float(rng2.normal(1500, 80)))
+             for j in range(16)]
+        total_cpu += 2 * len(cpu.search(w, now=float(w_i)).matches)
+        total_tpu += 2 * len(tpu.search(w, now=float(w_i)).matches)
+    assert total_tpu >= 0.9 * total_cpu
+    assert total_cpu >= 100  # dense workload: most players should match
+
+
+def test_tpu_duplicate_and_cancel_parity():
+    cpu, tpu = engines()
+    r = SearchRequest(id="a", rating=1500.0)
+    for eng in (cpu, tpu):
+        eng.search([r], now=0.0)
+        out = eng.search([r], now=1.0)  # duplicate → no-op
+        assert not out.matches and not out.queued
+        assert eng.pool_size() == 1
+        got = eng.remove("a")
+        assert got is not None and eng.pool_size() == 0
+        assert eng.remove("a") is None
+    # After cancel, a compatible request must NOT match the ghost.
+    out = tpu.search([SearchRequest(id="b", rating=1501.0)], now=2.0)
+    assert not out.matches and tpu.pool_size() == 1
+
+
+def test_tpu_checkpoint_restore_parity():
+    cpu, tpu = engines()
+    reqs = [SearchRequest(id=f"p{i}", rating=1000.0 * (i + 1), rating_threshold=30.0)
+            for i in range(5)]
+    for eng in (cpu, tpu):
+        eng.search(reqs, now=0.0)
+    snap_c, snap_t = cpu.waiting(), tpu.waiting()
+    assert {r.id for r in snap_c} == {r.id for r in snap_t} == {f"p{i}" for i in range(5)}
+    cfg = small_cfg()
+    fresh = TpuEngine(cfg, QueueConfig())
+    fresh.restore(snap_t, now=10.0)
+    assert fresh.pool_size() == 5
+    out = fresh.search([SearchRequest(id="q", rating=3005.0, rating_threshold=30.0)], now=11.0)
+    assert pairs_of(out) == {frozenset(("q", "p2"))}
+
+
+def test_tpu_pool_full_rejects():
+    cfg = small_cfg(pool_capacity=8, pool_block=8, batch_buckets=(4,))
+    tpu = TpuEngine(cfg, QueueConfig())
+    reqs = [SearchRequest(id=f"p{i}", rating=10000.0 * i) for i in range(8)]
+    for s in range(0, 8, 4):
+        tpu.search(reqs[s:s + 4], now=0.0)
+    assert tpu.pool_size() == 8
+    out = tpu.search([SearchRequest(id="x", rating=5.0)], now=1.0)
+    assert [(r.id, c) for r, c in out.rejected] == [("x", "pool_full")]
+
+
+def test_tpu_team_queue_delegation():
+    # Team/role queues run the host-side oracle behind the same seam.
+    cfg = small_cfg()
+    tpu = TpuEngine(cfg, QueueConfig(team_size=5, rating_threshold=200))
+    out = None
+    for i in range(10):
+        out = tpu.search([SearchRequest(id=f"p{i}", rating=1500.0 + i * 10)], now=0.0)
+    assert len(out.matches) == 1
+    assert all(len(t) == 5 for t in out.matches[0].teams)
+    assert tpu.pool_size() == 0
+
+
+def test_tpu_partial_admission_when_nearly_full():
+    cfg = small_cfg(pool_capacity=8, pool_block=8, batch_buckets=(4,))
+    tpu = TpuEngine(cfg, QueueConfig())
+    far = [SearchRequest(id=f"p{i}", rating=10000.0 * (i + 2)) for i in range(7)]
+    tpu.search(far[:4], now=0.0)
+    tpu.search(far[4:], now=0.0)
+    assert tpu.pool_size() == 7
+    # Window of 3 into 1 free slot: 1 admitted, 2 rejected.
+    w = [SearchRequest(id=f"x{i}", rating=5.0 + i) for i in range(3)]
+    out = tpu.search(w, now=1.0)
+    assert [c for _, c in out.rejected] == ["pool_full", "pool_full"]
+    assert {r.id for r in out.queued} == {"x0"}
+    assert tpu.pool_size() == 8
+
+
+def test_tpu_widening_with_epoch_timestamps():
+    # Wall-clock epoch times (~1.7e9 s): float32 spacing there is 128 s, so
+    # the engine must rebase times or widening is quantized to nothing.
+    import time
+    t_base = 1.7e9
+    queue = QueueConfig(rating_threshold=50.0, widen_per_sec=10.0, max_threshold=400.0)
+    cfg = small_cfg()
+    tpu = TpuEngine(cfg, queue)
+    tpu.search([SearchRequest(id="a", rating=1500.0, enqueued_at=t_base)], now=t_base)
+    # 10 s later: a's threshold is 150; b fresh at Δ=120 with own wait 10 →
+    # b enqueued at t_base too (waited 10s) → both 150 ≥ 120 → match.
+    out = tpu.search([SearchRequest(id="b", rating=1620.0, enqueued_at=t_base)],
+                     now=t_base + 10.0)
+    assert len(out.matches) == 1
