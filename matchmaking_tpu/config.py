@@ -20,7 +20,9 @@ class QueueConfig:
     """Per-matchmaking-queue knobs (the reference partitions work across AMQP
     queues per game-mode/region — SURVEY.md §2 "Queue sharding")."""
 
-    name: str = "matchmaking.queue.default"
+    #: AMQP queue this engine consumes (must equal what clients publish to —
+    #: BrokerConfig.request_queue points at the default one).
+    name: str = "matchmaking.search"
     #: Game mode this queue serves. ``None`` → mode taken from each request.
     game_mode: str | None = None
     #: Players per team. 1 → 1v1; 5 → 5v5 team-balanced (BASELINE config #3).
@@ -39,6 +41,18 @@ class QueueConfig:
     glicko2: bool = False
     #: Require role coverage for team formation (BASELINE config #5).
     role_slots: tuple[str, ...] = ()
+    #: Evict waiting players after this many seconds and answer ``timeout``
+    #: (None → wait forever, durability delegated to the broker like the
+    #: reference's volatile ETS pool — SURVEY.md §5 checkpoint/resume).
+    request_timeout_s: float | None = None
+    #: Publish an immediate ``queued`` ack when a request enters the pool
+    #: (the matched response follows on the same reply queue when found).
+    send_queued_ack: bool = True
+    #: At-least-once dedup horizon: a redelivered/duplicated request whose
+    #: player reached a terminal state (matched/timeout) within this many
+    #: seconds is answered with the cached response instead of re-entering
+    #: the pool (prevents one player landing in two matches).
+    dedup_ttl_s: float = 30.0
 
 
 @dataclass(frozen=True)

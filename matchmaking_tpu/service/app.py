@@ -1,0 +1,282 @@
+"""The matchmaking application: boot, wiring, supervision.
+
+``Matchmaking.Application`` + the supervision tree, rebuilt (SURVEY.md §2 C1,
+§3 Entry 1/4). Boot wires, per configured queue:
+
+    broker consumer → middleware pipeline → batcher → engine → responses
+
+Supervision semantics (the OTP analog, SURVEY.md §5 "Failure detection"):
+
+- a crashing consumer callback requeues its delivery (broker-level);
+- a crashing engine step nacks the whole window (redelivered, idempotent via
+  duplicate-enqueue no-ops) and **revives the engine from the authoritative
+  host mirror** — the "sidecar death → resubmit pool" recovery path;
+- deliveries are acked only after their window's responses are published
+  (at-least-once end to end).
+
+Run a self-contained demo with ``python -m matchmaking_tpu.service.app --demo``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+
+from matchmaking_tpu.config import Config, QueueConfig
+from matchmaking_tpu.engine.interface import Engine, Match, SearchOutcome, make_engine
+from matchmaking_tpu.service.batcher import Batcher
+from matchmaking_tpu.service.broker import Delivery, InProcBroker, Properties
+from matchmaking_tpu.service.contract import (
+    SearchRequest,
+    SearchResponse,
+    encode_response,
+)
+from matchmaking_tpu.service.middleware import (
+    MessageContext,
+    MiddlewareReject,
+    Pipeline,
+    default_pipeline,
+)
+from matchmaking_tpu.utils.metrics import Metrics
+
+log = logging.getLogger(__name__)
+
+
+class _QueueRuntime:
+    """Everything one matchmaking queue owns (consumer, batcher, engine)."""
+
+    def __init__(self, app: "MatchmakingApp", queue_cfg: QueueConfig):
+        self.app = app
+        self.queue_cfg = queue_cfg
+        self.engine: Engine = make_engine(app.cfg, queue_cfg)
+        self.pipeline: Pipeline = default_pipeline(app.cfg.auth, app.broker)
+        self.batcher: Batcher = Batcher(app.cfg.batcher, self._flush)
+        # Serializes ALL engine access (window flushes vs the timeout
+        # sweeper): engines are single-writer objects with no internal locks.
+        self._engine_lock = asyncio.Lock()
+        # At-least-once dedup: player id → (terminal SearchResponse, expiry).
+        self._recent: dict[str, tuple[SearchResponse, float]] = {}
+        self.consumer_tag = app.broker.basic_consume(
+            queue_cfg.name, self._on_delivery, prefetch=app.cfg.broker.prefetch
+        )
+        self._sweeper: asyncio.Task | None = None
+        if queue_cfg.request_timeout_s is not None:
+            self._sweeper = asyncio.create_task(self._sweep_timeouts())
+
+    # ---- ingress ----------------------------------------------------------
+
+    async def _on_delivery(self, delivery: Delivery) -> None:
+        ctx = MessageContext(delivery=delivery, queue=self.queue_cfg.name)
+        try:
+            await self.pipeline.run(ctx)
+        except MiddlewareReject as e:
+            self.app.metrics.counters.inc("rejected_by_middleware")
+            self._respond_error(delivery, e.code, e.reason)
+            self.app.broker.ack(self.consumer_tag, delivery.delivery_tag)
+            return
+        assert ctx.request is not None
+        self.batcher.submit((ctx.request, delivery))
+
+    # ---- the window flush: THE seam into Engine.search --------------------
+
+    async def _flush(self, window: list[tuple[SearchRequest, Delivery]]) -> None:
+        now = time.time()
+        # At-least-once dedup: a redelivered copy of a request whose player
+        # already reached a terminal state must not re-enter the pool (the
+        # player could end up in two matches); replay the cached response.
+        self._prune_recent(now)
+        fresh: list[tuple[SearchRequest, Delivery]] = []
+        for req, delivery in window:
+            cached = self._recent.get(req.id)
+            if cached is not None and cached[1] <= now:
+                del self._recent[req.id]  # expired: a genuine re-queue
+                cached = None
+            if cached is not None:
+                self.app.metrics.counters.inc("deduped_replays")
+                self._respond(req, cached[0])
+                self.app.broker.ack(self.consumer_tag, delivery.delivery_tag)
+            else:
+                fresh.append((req, delivery))
+        window = fresh
+        if not window:
+            return
+        requests = [r for r, _ in window]
+        try:
+            # Engine.search blocks (host work + device step); keep the event
+            # loop responsive for other queues. The lock serializes against
+            # the timeout sweeper.
+            async with self._engine_lock:
+                outcome = await asyncio.to_thread(self.engine.search, requests, now)
+        except Exception:
+            log.exception("engine step crashed; reviving engine from mirror")
+            self.app.metrics.counters.inc("engine_crashes")
+            self._revive_engine(now)
+            for _, delivery in window:
+                self.app.broker.nack(self.consumer_tag, delivery.delivery_tag,
+                                     requeue=True)
+            return
+        self._publish_outcome(outcome, now)
+        for _, delivery in window:
+            self.app.broker.ack(self.consumer_tag, delivery.delivery_tag)
+        self.app.metrics.counters.inc("windows")
+        self.app.metrics.counters.inc("requests_batched", len(window))
+
+    def _revive_engine(self, now: float) -> None:
+        """Elastic recovery: rebuild the engine and resubmit the pool from
+        the authoritative host mirror (SURVEY.md §5)."""
+        try:
+            snapshot = self.engine.waiting()
+        except Exception:
+            snapshot = []
+            log.exception("mirror unreadable; pool lost (broker will redeliver)")
+        self.engine = make_engine(self.app.cfg, self.queue_cfg)
+        self.engine.restore(snapshot, now)
+
+    # ---- egress -----------------------------------------------------------
+
+    def _publish_outcome(self, outcome: SearchOutcome, now: float) -> None:
+        m = self.app.metrics
+        for match in outcome.matches:
+            result = match.result()
+            for req in match.requests():
+                m.counters.inc("players_matched")
+                if req.enqueued_at:
+                    m.record_latency("match_wait", now - req.enqueued_at)
+                resp = SearchResponse(
+                    status="matched", player_id=req.id, match=result,
+                    latency_ms=(now - req.enqueued_at) * 1e3 if req.enqueued_at else 0.0,
+                )
+                self._remember(req.id, resp, now)
+                self._respond(req, resp)
+        if self.queue_cfg.send_queued_ack:
+            for req in outcome.queued:
+                self._respond(req, SearchResponse(status="queued", player_id=req.id))
+        for req, code in outcome.rejected:
+            m.counters.inc("rejected_by_engine")
+            self._respond(req, SearchResponse(
+                status="error", player_id=req.id, error_code=code,
+                error_reason=f"engine rejected request: {code}",
+            ))
+        for req in outcome.timed_out:
+            resp = SearchResponse(status="timeout", player_id=req.id)
+            self._remember(req.id, resp, now)
+            self._respond(req, resp)
+
+    def _remember(self, player_id: str, resp: SearchResponse, now: float) -> None:
+        self._recent[player_id] = (resp, now + self.queue_cfg.dedup_ttl_s)
+
+    def _prune_recent(self, now: float) -> None:
+        if len(self._recent) > 4096:
+            self._recent = {k: v for k, v in self._recent.items() if v[1] > now}
+
+    def _respond(self, req: SearchRequest, resp: SearchResponse) -> None:
+        if not req.reply_to:
+            return
+        self.app.broker.publish(
+            req.reply_to, encode_response(resp),
+            Properties(correlation_id=req.correlation_id),
+        )
+
+    def _respond_error(self, delivery: Delivery, code: str, reason: str) -> None:
+        if not delivery.properties.reply_to:
+            return
+        self.app.broker.publish(
+            delivery.properties.reply_to,
+            encode_response(SearchResponse(
+                status="error", player_id="", error_code=code, error_reason=reason,
+            )),
+            Properties(correlation_id=delivery.properties.correlation_id),
+        )
+
+    # ---- timeout sweeper --------------------------------------------------
+
+    async def _sweep_timeouts(self) -> None:
+        timeout = self.queue_cfg.request_timeout_s
+        assert timeout is not None
+        interval = max(0.05, timeout / 4.0)
+        while True:
+            await asyncio.sleep(interval)
+            now = time.time()
+            # The lock keeps evictions from racing an in-flight window's
+            # engine.search (engines have no internal locking).
+            async with self._engine_lock:
+                expired = [r for r in self.engine.waiting()
+                           if r.enqueued_at and now - r.enqueued_at > timeout]
+                for req in expired:
+                    removed = self.engine.remove(req.id)
+                    if removed is not None:
+                        self.app.metrics.counters.inc("timeouts")
+                        resp = SearchResponse(
+                            status="timeout", player_id=removed.id,
+                            latency_ms=(now - removed.enqueued_at) * 1e3,
+                        )
+                        self._remember(removed.id, resp, now)
+                        self._respond(removed, resp)
+
+    async def close(self) -> None:
+        if self._sweeper is not None:
+            self._sweeper.cancel()
+        # Drain the batcher BEFORE cancelling the consumer so the final
+        # windows can still ack their deliveries.
+        await self.batcher.close()
+        self.app.broker.basic_cancel(self.consumer_tag)
+
+
+class MatchmakingApp:
+    """Boot/own the whole service (SURVEY.md §3 Entry 1)."""
+
+    def __init__(self, cfg: Config | None = None, broker: InProcBroker | None = None):
+        self.cfg = cfg or Config()
+        self.broker = broker or InProcBroker(self.cfg.broker, self.cfg.seed)
+        self.metrics = Metrics()
+        self._runtimes: dict[str, _QueueRuntime] = {}
+        self._started = False
+
+    async def start(self) -> None:
+        assert not self._started
+        for queue_cfg in self.cfg.queues:
+            self.broker.declare_queue(queue_cfg.name)
+            self._runtimes[queue_cfg.name] = _QueueRuntime(self, queue_cfg)
+        self._started = True
+
+    async def stop(self) -> None:
+        for rt in self._runtimes.values():
+            await rt.close()
+        self.broker.close()
+        self._started = False
+
+    def runtime(self, queue_name: str) -> _QueueRuntime:
+        return self._runtimes[queue_name]
+
+
+async def _demo() -> None:
+    """Self-contained end-to-end demo: spin the app, submit players, print
+    responses (the project verify recipe drives this)."""
+    from matchmaking_tpu.config import EngineConfig
+    from matchmaking_tpu.service.client import MatchmakingClient
+
+    cfg = Config(engine=EngineConfig(backend="tpu", pool_capacity=1024,
+                                     pool_block=256, batch_buckets=(16, 64)))
+    app = MatchmakingApp(cfg)
+    await app.start()
+    client = MatchmakingClient(app.broker, cfg.broker.request_queue)
+    players = [{"id": f"p{i}", "rating": 1500 + (i % 7) * 12} for i in range(10)]
+    results = await asyncio.gather(*[
+        client.search_until_matched(p, timeout=5.0) for p in players
+    ])
+    for resp in results:
+        match_id = resp.match.match_id[:8] if resp.match else "-"
+        print(f"{resp.player_id}: {resp.status} match={match_id}")
+    print("metrics:", app.metrics.report_json())
+    await app.stop()
+
+
+if __name__ == "__main__":
+    import sys
+
+    logging.basicConfig(level=logging.INFO)
+    if "--demo" in sys.argv:
+        asyncio.run(_demo())
+    else:
+        print("usage: python -m matchmaking_tpu.service.app --demo")

@@ -1,0 +1,100 @@
+"""Request batcher: collects a time/size window per queue, dispatches once.
+
+This is the structural pivot of the rebuild (BASELINE north_star: "the AMQP
+consumer batches a window of incoming search requests and hands them to a
+JAX sidecar"): instead of one engine call per delivery, deliveries accumulate
+until ``max_batch`` or ``max_wait_ms``, whichever first, then flush as one
+window. Windows per queue are serialized — the next window is not dispatched
+until the previous one's flush callback returns — which is the atomicity
+guarantee (a matched player is out of the pool before anyone else can see
+them; SURVEY.md §7 "Hard parts").
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Awaitable, Callable, Generic, TypeVar
+
+from matchmaking_tpu.config import BatcherConfig
+
+T = TypeVar("T")
+
+
+class Batcher(Generic[T]):
+    def __init__(self, cfg: BatcherConfig,
+                 flush: Callable[[list[T]], Awaitable[None]]):
+        self.cfg = cfg
+        self._flush = flush
+        self._pending: list[T] = []
+        self._first = asyncio.Event()   # first item of a window arrived
+        self._full = asyncio.Event()    # size trigger
+        self._closed = False
+        self._task = asyncio.create_task(self._run())
+
+    def submit(self, item: T) -> None:
+        if self._closed:
+            raise RuntimeError("batcher closed")
+        self._pending.append(item)
+        self._first.set()
+        if len(self._pending) >= self.cfg.max_batch:
+            self._full.set()
+
+    async def _run(self) -> None:
+        max_wait = self.cfg.max_wait_ms / 1000.0
+        while not self._closed:
+            if not self._pending:
+                # Idle: wake immediately on the window's first item.
+                self._first.clear()
+                try:
+                    await asyncio.wait_for(self._first.wait(), timeout=0.5)
+                except asyncio.TimeoutError:
+                    continue
+                if not self._pending:
+                    continue
+            # Window open: close after max_wait unless the size trigger
+            # fires first.
+            self._full.clear()
+            if len(self._pending) < self.cfg.max_batch:
+                try:
+                    await asyncio.wait_for(self._full.wait(), timeout=max_wait)
+                except asyncio.TimeoutError:
+                    pass
+            if not self._pending:
+                continue
+            window = self._pending[: self.cfg.max_batch]
+            self._pending = self._pending[self.cfg.max_batch:]
+            try:
+                await self._flush(window)
+            except Exception:
+                # The flush owner handles its own errors; a crash here must
+                # not kill the batching loop (supervision logs it).
+                import logging
+
+                logging.getLogger(__name__).exception("batch flush crashed")
+
+    def flush_hint(self) -> None:
+        """Close the current window early (e.g. at shutdown)."""
+        self._full.set()
+
+    @property
+    def depth(self) -> int:
+        return len(self._pending)
+
+    async def close(self) -> None:
+        """Graceful close: let any in-flight flush finish (cancelling it
+        would drop a window already sliced out of ``_pending``), then flush
+        the remainder."""
+        self._closed = True
+        self._first.set()
+        self._full.set()
+        try:
+            await asyncio.wait_for(self._task, timeout=5.0)
+        except (asyncio.TimeoutError, asyncio.CancelledError):
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+        if self._pending:
+            window, self._pending = self._pending, []
+            await self._flush(window)

@@ -1,0 +1,272 @@
+"""In-process message broker with AMQP 0-9-1 work-queue semantics.
+
+The reference's only transport is RabbitMQ: requests arrive on a named queue,
+responses go to the per-request ``reply_to`` queue with the request's
+``correlation_id``, deliveries are acked after processing, and unacked
+deliveries are redelivered (at-least-once) (SURVEY.md §1 L5, §2 C2–C4).
+No RabbitMQ/pika exists in this environment (SURVEY.md §7 [ENV]), so this
+module implements those semantics in-process behind an interface a real AMQP
+client could also satisfy; it doubles as the test fake and carries the
+fault-injection hooks (drop/dup/delay — SURVEY.md §5 "Failure detection").
+
+Semantics implemented:
+
+- named queues, auto-declared on first use;
+- competing consumers with per-consumer prefetch (basic.qos);
+- ack / nack(requeue) by delivery tag; consumer cancellation requeues its
+  unacked deliveries (like an AMQP channel close);
+- redelivery cap with dead-lettering (counted, not silently dropped);
+- RPC helper (ephemeral reply queue + correlation id) — the pattern the
+  reference's auth middleware uses against ``microservice-auth`` (§2 C5).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import random
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Awaitable, Callable
+
+from matchmaking_tpu.config import BrokerConfig
+
+
+@dataclass(frozen=True)
+class Properties:
+    """AMQP basic.properties subset the contract uses."""
+
+    reply_to: str = ""
+    correlation_id: str = ""
+    headers: dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class Delivery:
+    body: bytes
+    properties: Properties
+    queue: str
+    delivery_tag: int
+    redelivered: bool = False
+    redelivery_count: int = 0
+
+
+class _Queue:
+    def __init__(self, name: str):
+        self.name = name
+        self.messages: asyncio.Queue[Delivery] = asyncio.Queue()
+        self.consumers: list["_Consumer"] = []
+
+
+class _Consumer:
+    def __init__(self, broker: "InProcBroker", queue: _Queue,
+                 callback: Callable[[Delivery], Awaitable[None]], prefetch: int):
+        self.broker = broker
+        self.queue = queue
+        self.callback = callback
+        self.prefetch = max(1, prefetch)
+        self.unacked: dict[int, Delivery] = {}
+        self.cancelled = False
+        self.tag = f"ctag-{uuid.uuid4().hex[:8]}"
+        self._capacity = asyncio.Semaphore(self.prefetch)
+        self._handlers: set[asyncio.Task] = set()
+        self._task = asyncio.create_task(self._run())
+
+    async def _run(self) -> None:
+        # Deliveries are handled CONCURRENTLY up to ``prefetch`` — this is
+        # the rebuild's request-level data parallelism (the reference's
+        # Search.Worker GenServer pool; SURVEY.md §2 "Parallelism
+        # strategies"): N in-flight handlers per consumer.
+        while not self.cancelled:
+            await self._capacity.acquire()
+            try:
+                delivery = await self.queue.messages.get()
+            except asyncio.CancelledError:
+                self._capacity.release()
+                raise
+            if self.cancelled:
+                # Requeue and bail (channel closed mid-delivery).
+                self.queue.messages.put_nowait(delivery)
+                self._capacity.release()
+                return
+            task = asyncio.create_task(self._handle(delivery))
+            self._handlers.add(task)
+            task.add_done_callback(self._handlers.discard)
+
+    async def _handle(self, delivery: Delivery) -> None:
+        await self.broker._inject_faults(self.queue, delivery)
+        if self.broker._should_drop():
+            # Fault injection: consumer "crashed" before processing —
+            # the delivery is requeued as AMQP would on channel close.
+            self.broker.stats["dropped"] += 1
+            self._capacity.release()
+            self.broker._requeue(self.queue, delivery)
+            return
+        self.unacked[delivery.delivery_tag] = delivery
+        try:
+            await self.callback(delivery)
+        except Exception:
+            # A crashing consumer callback must not lose the delivery:
+            # requeue it (OTP-style let-it-crash + redeliver, §3 Entry 4).
+            self.broker.stats["consumer_errors"] += 1
+            self.nack(delivery.delivery_tag, requeue=True)
+
+    def ack(self, delivery_tag: int) -> None:
+        if self.unacked.pop(delivery_tag, None) is not None:
+            self.broker.stats["acked"] += 1
+            self._capacity.release()
+
+    def nack(self, delivery_tag: int, requeue: bool = True) -> None:
+        delivery = self.unacked.pop(delivery_tag, None)
+        if delivery is None:
+            return
+        self._capacity.release()
+        if requeue:
+            self.broker._requeue(self.queue, delivery)
+        else:
+            self.broker.stats["dead_lettered"] += 1
+
+    def cancel(self) -> None:
+        self.cancelled = True
+        self._task.cancel()
+        for task in list(self._handlers):
+            task.cancel()
+        for delivery in list(self.unacked.values()):
+            self.broker._requeue(self.queue, delivery)
+        self.unacked.clear()
+
+
+class InProcBroker:
+    """The broker. All methods are called from one event loop."""
+
+    def __init__(self, cfg: BrokerConfig | None = None, seed: int = 0):
+        self.cfg = cfg or BrokerConfig()
+        self._queues: dict[str, _Queue] = {}
+        self._tags = itertools.count(1)
+        self._consumers: dict[str, _Consumer] = {}
+        self._rng = random.Random(seed)
+        self.stats = {
+            "published": 0, "acked": 0, "dropped": 0, "duplicated": 0,
+            "dead_lettered": 0, "consumer_errors": 0, "unroutable": 0,
+        }
+
+    # ---- queue ops --------------------------------------------------------
+
+    def declare_queue(self, name: str) -> None:
+        self._queues.setdefault(name, _Queue(name))
+
+    def delete_queue(self, name: str) -> None:
+        """Drop a queue and its buffered messages (AMQP queue.delete — used
+        for ephemeral reply queues, which would otherwise leak one map entry
+        per request)."""
+        q = self._queues.pop(name, None)
+        if q is not None:
+            for consumer in list(q.consumers):
+                self.basic_cancel(consumer.tag)
+
+    def queue_depth(self, name: str) -> int:
+        q = self._queues.get(name)
+        return q.messages.qsize() if q else 0
+
+    def publish(self, queue: str, body: bytes,
+                properties: Properties | None = None) -> None:
+        # AMQP default-exchange semantics: publishing to a queue that does
+        # not exist drops the message as unroutable (it does NOT declare —
+        # otherwise deleted reply queues would resurrect and leak).
+        q = self._queues.get(queue)
+        if q is None:
+            self.stats["unroutable"] += 1
+            return
+        delivery = Delivery(
+            body=bytes(body), properties=properties or Properties(),
+            queue=queue, delivery_tag=next(self._tags),
+        )
+        self.stats["published"] += 1
+        q.messages.put_nowait(delivery)
+        if self._rng.random() < self.cfg.dup_prob:
+            # Fault injection: duplicate delivery (at-least-once world).
+            self.stats["duplicated"] += 1
+            dup = Delivery(body=bytes(body), properties=delivery.properties,
+                           queue=queue, delivery_tag=next(self._tags),
+                           redelivered=True)
+            q.messages.put_nowait(dup)
+
+    def basic_consume(self, queue: str,
+                      callback: Callable[[Delivery], Awaitable[None]],
+                      prefetch: int | None = None) -> str:
+        self.declare_queue(queue)
+        consumer = _Consumer(self, self._queues[queue], callback,
+                             prefetch or self.cfg.prefetch)
+        self._queues[queue].consumers.append(consumer)
+        self._consumers[consumer.tag] = consumer
+        return consumer.tag
+
+    def basic_cancel(self, consumer_tag: str) -> None:
+        consumer = self._consumers.pop(consumer_tag, None)
+        if consumer is not None:
+            consumer.cancel()
+            consumer.queue.consumers.remove(consumer)
+
+    def ack(self, consumer_tag: str, delivery_tag: int) -> None:
+        # A late ack after basic_cancel is a no-op: the cancel already
+        # requeued the delivery (at-least-once; dedup absorbs the replay).
+        consumer = self._consumers.get(consumer_tag)
+        if consumer is not None:
+            consumer.ack(delivery_tag)
+
+    def nack(self, consumer_tag: str, delivery_tag: int, requeue: bool = True) -> None:
+        consumer = self._consumers.get(consumer_tag)
+        if consumer is not None:
+            consumer.nack(delivery_tag, requeue)
+
+    async def get(self, queue: str, timeout: float | None = None) -> Delivery | None:
+        """basic.get analog for clients awaiting replies (no consumer)."""
+        self.declare_queue(queue)
+        q = self._queues[queue]
+        try:
+            if timeout is None:
+                return await q.messages.get()
+            return await asyncio.wait_for(q.messages.get(), timeout)
+        except asyncio.TimeoutError:
+            return None
+
+    async def rpc(self, queue: str, body: bytes, timeout: float) -> bytes | None:
+        """Publish with an ephemeral reply queue; await the correlated reply."""
+        reply_queue = f"amq.gen-{uuid.uuid4().hex}"
+        corr = uuid.uuid4().hex
+        self.declare_queue(reply_queue)  # before publish: replies must route
+        self.publish(queue, body, Properties(reply_to=reply_queue, correlation_id=corr))
+        deadline = asyncio.get_event_loop().time() + timeout
+        try:
+            while True:
+                remaining = deadline - asyncio.get_event_loop().time()
+                if remaining <= 0:
+                    return None
+                reply = await self.get(reply_queue, timeout=remaining)
+                if reply is None:
+                    return None
+                if reply.properties.correlation_id == corr:
+                    return reply.body
+        finally:
+            self.delete_queue(reply_queue)  # exclusive reply queues auto-delete
+
+    def close(self) -> None:
+        for tag in list(self._consumers):
+            self.basic_cancel(tag)
+
+    # ---- fault injection --------------------------------------------------
+
+    def _should_drop(self) -> bool:
+        return self.cfg.drop_prob > 0 and self._rng.random() < self.cfg.drop_prob
+
+    async def _inject_faults(self, queue: _Queue, delivery: Delivery) -> None:
+        if self.cfg.delay_ms > 0:
+            await asyncio.sleep(self.cfg.delay_ms / 1000.0)
+
+    def _requeue(self, queue: _Queue, delivery: Delivery) -> None:
+        if delivery.redelivery_count >= self.cfg.max_redelivery:
+            self.stats["dead_lettered"] += 1
+            return
+        delivery.redelivered = True
+        delivery.redelivery_count += 1
+        queue.messages.put_nowait(delivery)

@@ -1,0 +1,71 @@
+"""Client helper: speaks the wire contract like an external service would.
+
+The reference's clients reach the matchmaking queue through the platform's
+``pathfinder`` gateway (SURVEY.md §1); here the client publishes a search
+request with a private reply queue + correlation id and awaits responses —
+used by tests, the demo, and the bench harness.
+"""
+
+from __future__ import annotations
+
+import json
+import uuid
+from typing import Any, Mapping
+
+from matchmaking_tpu.service.broker import InProcBroker, Properties
+from matchmaking_tpu.service.contract import SearchResponse, decode_response
+
+
+class MatchmakingClient:
+    def __init__(self, broker: InProcBroker, request_queue: str,
+                 auth_token: str = ""):
+        self.broker = broker
+        self.request_queue = request_queue
+        self.auth_token = auth_token
+
+    def submit(self, player: Mapping[str, Any], *, queue: str | None = None) -> str:
+        """Fire a search request; returns the private reply queue name."""
+        reply_to = f"amq.gen-{uuid.uuid4().hex}"
+        self.broker.declare_queue(reply_to)  # before publish: replies must route
+        headers = {"authorization": self.auth_token} if self.auth_token else {}
+        self.broker.publish(
+            queue or self.request_queue,
+            json.dumps(dict(player)).encode(),
+            Properties(reply_to=reply_to, correlation_id=uuid.uuid4().hex,
+                       headers=headers),
+        )
+        return reply_to
+
+    async def next_response(self, reply_to: str,
+                            timeout: float = 5.0) -> SearchResponse | None:
+        delivery = await self.broker.get(reply_to, timeout=timeout)
+        if delivery is None:
+            return None
+        return decode_response(delivery.body)
+
+    async def search_until_matched(self, player: Mapping[str, Any], *,
+                                   timeout: float = 5.0,
+                                   queue: str | None = None) -> SearchResponse:
+        """Submit and wait through ``queued`` acks until a terminal response
+        (matched / timeout / error) or the deadline."""
+        reply_to = self.submit(player, queue=queue)
+        import asyncio
+
+        deadline = asyncio.get_event_loop().time() + timeout
+        last: SearchResponse | None = None
+        try:
+            while True:
+                remaining = deadline - asyncio.get_event_loop().time()
+                if remaining <= 0:
+                    return last or SearchResponse(status="timeout",
+                                                  player_id=str(player.get("id", "")))
+                resp = await self.next_response(reply_to, timeout=remaining)
+                if resp is None:
+                    continue
+                last = resp
+                if resp.status != "queued":
+                    return resp
+        finally:
+            # Exclusive reply queues auto-delete with their consumer in real
+            # AMQP; mirror that so the broker's queue map doesn't leak.
+            self.broker.delete_queue(reply_to)

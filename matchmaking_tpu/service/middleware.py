@@ -1,0 +1,117 @@
+"""The middleware pipeline — ``Matchmaking.Middleware`` rebuilt.
+
+The reference runs each AMQP delivery through an ordered chain of middlewares
+(token/permission check against the platform auth service, payload parsing /
+validation) before the engine sees it (SURVEY.md §2 C5, §3 Entry 2). Same
+shape here: each middleware gets the message context and a ``next`` thunk;
+it can short-circuit by raising ``MiddlewareReject``, which the app maps to
+an error response on the request's reply queue.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable, Sequence
+
+from matchmaking_tpu.config import AuthConfig
+from matchmaking_tpu.service.broker import Delivery, InProcBroker
+from matchmaking_tpu.service.contract import ContractError, SearchRequest, decode_request
+
+
+class MiddlewareReject(Exception):
+    """Stop the pipeline and answer with an error response."""
+
+    def __init__(self, code: str, reason: str):
+        super().__init__(reason)
+        self.code = code
+        self.reason = reason
+
+
+@dataclass
+class MessageContext:
+    delivery: Delivery
+    queue: str
+    received_at: float = field(default_factory=time.time)
+    request: SearchRequest | None = None  # set by DecodeMiddleware
+
+
+Next = Callable[[], Awaitable[None]]
+
+
+class Middleware:
+    async def call(self, ctx: MessageContext, next: Next) -> None:  # noqa: A002
+        raise NotImplementedError
+
+
+class Pipeline:
+    """Ordered middleware chain; mirrors a Plug-style ``call(msg, next)``."""
+
+    def __init__(self, middlewares: Sequence[Middleware]):
+        self._middlewares = tuple(middlewares)
+
+    async def run(self, ctx: MessageContext) -> None:
+        async def invoke(i: int) -> None:
+            if i == len(self._middlewares):
+                return
+            await self._middlewares[i].call(ctx, lambda: invoke(i + 1))
+
+        await invoke(0)
+
+
+class DecodeMiddleware(Middleware):
+    """Payload → validated SearchRequest (rejects malformed payloads before
+    they reach the engine)."""
+
+    async def call(self, ctx: MessageContext, next: Next) -> None:  # noqa: A002
+        try:
+            ctx.request = decode_request(
+                ctx.delivery.body,
+                reply_to=ctx.delivery.properties.reply_to,
+                correlation_id=ctx.delivery.properties.correlation_id,
+                queue=ctx.queue,
+                enqueued_at=ctx.received_at,
+            )
+        except ContractError as e:
+            raise MiddlewareReject(e.code, e.reason) from e
+        await next()
+
+
+class AuthMiddleware(Middleware):
+    """Token check. The reference verifies each request's token against
+    ``microservice-auth`` over an AMQP RPC round-trip (SURVEY.md §2 C5);
+    modes: ``none`` (off), ``static`` (shared-secret prefix — the local
+    stand-in), ``rpc`` (round-trip over the broker to an auth queue, which is
+    how a real auth sidecar would be wired)."""
+
+    def __init__(self, cfg: AuthConfig, broker: InProcBroker | None = None):
+        self.cfg = cfg
+        self.broker = broker
+
+    async def call(self, ctx: MessageContext, next: Next) -> None:  # noqa: A002
+        mode = self.cfg.mode
+        if mode == "none":
+            await next()
+            return
+        token = str(ctx.delivery.properties.headers.get("authorization", ""))
+        if mode == "static":
+            if not token or not token.startswith(self.cfg.static_secret):
+                raise MiddlewareReject("unauthorized", "invalid or missing token")
+        elif mode == "rpc":
+            if self.broker is None:
+                raise MiddlewareReject("auth_unavailable", "no broker for auth rpc")
+            reply = await self.broker.rpc(
+                self.cfg.rpc_queue, token.encode(),
+                timeout=self.cfg.rpc_timeout_ms / 1000.0,
+            )
+            if reply is None:
+                raise MiddlewareReject("auth_unavailable", "auth service timeout")
+            if reply != b"ok":
+                raise MiddlewareReject("unauthorized", reply.decode(errors="replace"))
+        else:
+            raise MiddlewareReject("auth_misconfigured", f"unknown auth mode {mode!r}")
+        await next()
+
+
+def default_pipeline(auth_cfg: AuthConfig, broker: InProcBroker) -> Pipeline:
+    return Pipeline([DecodeMiddleware(), AuthMiddleware(auth_cfg, broker)])

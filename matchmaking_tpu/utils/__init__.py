@@ -1,0 +1,1 @@
+"""Utilities: metrics, structured logging, timing spans."""

@@ -1,0 +1,94 @@
+"""Metrics/observability: counters, latency percentiles, stage spans.
+
+The reference leans on Elixir ``Logger`` and BEAM introspection; the rebuild
+makes the BASELINE headline numbers (matches/sec, p50/p99 end-to-end latency,
+pool occupancy, batch fill, recompile count) first-class (SURVEY.md §5
+"Metrics/logging/observability"). Pure stdlib, no deps.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+
+class Counter:
+    def __init__(self) -> None:
+        self._values: dict[str, float] = defaultdict(float)
+
+    def inc(self, name: str, value: float = 1.0) -> None:
+        self._values[name] += value
+
+    def get(self, name: str) -> float:
+        return self._values[name]
+
+    def snapshot(self) -> dict[str, float]:
+        return dict(self._values)
+
+
+class LatencyRecorder:
+    """Reservoir-less latency recorder: keeps every sample (bench windows are
+    bounded); exposes percentiles the BASELINE metric asks for."""
+
+    def __init__(self) -> None:
+        self._samples: list[float] = []
+
+    def record(self, seconds: float) -> None:
+        self._samples.append(seconds)
+
+    def __len__(self) -> int:
+        return len(self._samples)
+
+    def percentile(self, p: float) -> float:
+        if not self._samples:
+            return math.nan
+        s = sorted(self._samples)
+        k = min(len(s) - 1, max(0, math.ceil(p / 100.0 * len(s)) - 1))
+        return s[k]
+
+    def summary_ms(self) -> dict[str, float]:
+        if not self._samples:
+            return {"count": 0}
+        return {
+            "count": len(self._samples),
+            "p50_ms": round(self.percentile(50) * 1e3, 3),
+            "p90_ms": round(self.percentile(90) * 1e3, 3),
+            "p99_ms": round(self.percentile(99) * 1e3, 3),
+            "max_ms": round(max(self._samples) * 1e3, 3),
+            "mean_ms": round(sum(self._samples) / len(self._samples) * 1e3, 3),
+        }
+
+
+@dataclass
+class Span:
+    """Wall-clock span for per-stage latency accounting (batcher wait, H2D,
+    kernel, D2H, publish — SURVEY.md §5 tracing plan)."""
+
+    name: str
+    start: float = field(default_factory=time.perf_counter)
+    elapsed: float = 0.0
+
+    def stop(self) -> float:
+        self.elapsed = time.perf_counter() - self.start
+        return self.elapsed
+
+
+class Metrics:
+    def __init__(self) -> None:
+        self.counters = Counter()
+        self.latency: dict[str, LatencyRecorder] = defaultdict(LatencyRecorder)
+
+    def record_latency(self, name: str, seconds: float) -> None:
+        self.latency[name].record(seconds)
+
+    def report(self) -> dict:
+        return {
+            "counters": self.counters.snapshot(),
+            "latency": {k: v.summary_ms() for k, v in self.latency.items()},
+        }
+
+    def report_json(self) -> str:
+        return json.dumps(self.report(), sort_keys=True)

@@ -21,6 +21,9 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+import asyncio
+import inspect
+
 import numpy as np
 import pytest
 
@@ -28,3 +31,22 @@ import pytest
 @pytest.fixture
 def rng():
     return np.random.default_rng(1234)
+
+
+# Minimal async-test support (pytest-asyncio is not in this image): any
+# ``async def test_*`` runs under asyncio.run with its sync fixtures.
+def pytest_configure(config):
+    config.addinivalue_line("markers", "asyncio: run coroutine test in an event loop")
+
+
+@pytest.hookimpl(tryfirst=True)
+def pytest_pyfunc_call(pyfuncitem):
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+        asyncio.run(fn(**kwargs))
+        return True
+    return None

@@ -1,0 +1,186 @@
+"""Broker semantics: work-queue fan-out, ack/nack, redelivery caps, RPC,
+fault injection (SURVEY.md §2 C2–C4, §5 failure detection)."""
+
+import asyncio
+
+import pytest
+
+from matchmaking_tpu.config import BrokerConfig
+from matchmaking_tpu.service.broker import Delivery, InProcBroker, Properties
+
+
+@pytest.fixture
+def broker():
+    b = InProcBroker(BrokerConfig())
+    yield b
+    b.close()
+
+
+async def _drain(received, n, timeout=2.0):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while len(received) < n:
+        if asyncio.get_event_loop().time() > deadline:
+            raise AssertionError(f"only {len(received)}/{n} deliveries arrived")
+        await asyncio.sleep(0.005)
+
+
+@pytest.mark.asyncio
+async def test_publish_consume_ack(broker):
+    received = []
+
+    async def cb(d: Delivery):
+        received.append(d)
+        broker.ack(tag, d.delivery_tag)
+
+    tag = broker.basic_consume("q1", cb)
+    for i in range(5):
+        broker.publish("q1", f"m{i}".encode())
+    await _drain(received, 5)
+    assert [d.body for d in received] == [b"m0", b"m1", b"m2", b"m3", b"m4"]
+    assert broker.stats["acked"] == 5
+
+
+@pytest.mark.asyncio
+async def test_competing_consumers_share_work(broker):
+    got_a, got_b = [], []
+
+    async def cb_a(d):
+        await asyncio.sleep(0.002)  # simulate work so qos matters
+        got_a.append(d.body)
+        broker.ack(tag_a, d.delivery_tag)
+
+    async def cb_b(d):
+        await asyncio.sleep(0.002)
+        got_b.append(d.body)
+        broker.ack(tag_b, d.delivery_tag)
+
+    tag_a = broker.basic_consume("q", cb_a, prefetch=1)
+    tag_b = broker.basic_consume("q", cb_b, prefetch=1)
+    for i in range(20):
+        broker.publish("q", b"x")
+    deadline = asyncio.get_event_loop().time() + 2.0
+    while len(got_a) + len(got_b) < 20:
+        assert asyncio.get_event_loop().time() < deadline
+        await asyncio.sleep(0.005)
+    assert got_a and got_b  # with qos=1 both consumers share the work
+
+
+@pytest.mark.asyncio
+async def test_nack_redelivers_then_dead_letters(broker):
+    attempts = []
+
+    async def cb(d: Delivery):
+        attempts.append(d.redelivery_count)
+        broker.nack(tag, d.delivery_tag, requeue=True)
+
+    tag = broker.basic_consume("q", cb)
+    broker.publish("q", b"poison")
+    await asyncio.sleep(0.1)
+    # Initial + max_redelivery attempts, then dead-lettered.
+    assert len(attempts) == 1 + broker.cfg.max_redelivery
+    assert broker.stats["dead_lettered"] == 1
+
+
+@pytest.mark.asyncio
+async def test_crashing_callback_requeues(broker):
+    seen = []
+
+    async def cb(d: Delivery):
+        seen.append(d.redelivered)
+        if len(seen) == 1:
+            raise RuntimeError("boom")
+        broker.ack(tag, d.delivery_tag)
+
+    tag = broker.basic_consume("q", cb)
+    broker.publish("q", b"x")
+    await _drain(seen, 2)
+    assert seen == [False, True]
+    assert broker.stats["consumer_errors"] == 1
+    assert broker.stats["acked"] == 1
+
+
+@pytest.mark.asyncio
+async def test_prefetch_caps_inflight(broker):
+    inflight, max_inflight = [0], [0]
+    release = asyncio.Event()
+
+    async def cb(d: Delivery):
+        inflight[0] += 1
+        max_inflight[0] = max(max_inflight[0], inflight[0])
+        await release.wait()
+        inflight[0] -= 1
+        broker.ack(tag, d.delivery_tag)
+
+    tag = broker.basic_consume("q", cb, prefetch=3)
+    for _ in range(10):
+        broker.publish("q", b"x")
+    await asyncio.sleep(0.05)
+    assert max_inflight[0] == 3  # qos honored
+    release.set()
+    await asyncio.sleep(0.05)
+    assert broker.stats["acked"] == 10
+
+
+@pytest.mark.asyncio
+async def test_cancel_requeues_unacked(broker):
+    async def cb(d: Delivery):
+        pass  # never acks
+
+    tag = broker.basic_consume("q", cb, prefetch=5)
+    for _ in range(3):
+        broker.publish("q", b"x")
+    await asyncio.sleep(0.05)
+    broker.basic_cancel(tag)
+    assert broker.queue_depth("q") == 3  # everything back on the queue
+
+
+@pytest.mark.asyncio
+async def test_rpc_roundtrip(broker):
+    async def echo(d: Delivery):
+        broker.publish(d.properties.reply_to, b"ok:" + d.body,
+                       Properties(correlation_id=d.properties.correlation_id))
+        broker.ack(tag, d.delivery_tag)
+
+    tag = broker.basic_consume("auth", echo)
+    reply = await broker.rpc("auth", b"token123", timeout=1.0)
+    assert reply == b"ok:token123"
+
+
+@pytest.mark.asyncio
+async def test_rpc_timeout_returns_none(broker):
+    reply = await broker.rpc("nobody-home", b"x", timeout=0.05)
+    assert reply is None
+
+
+@pytest.mark.asyncio
+async def test_drop_fault_injection_redelivers():
+    b = InProcBroker(BrokerConfig(drop_prob=0.5, max_redelivery=50), seed=42)
+    received = []
+
+    async def cb(d: Delivery):
+        received.append(d)
+        b.ack(tag, d.delivery_tag)
+
+    tag = b.basic_consume("q", cb)
+    for i in range(20):
+        b.publish("q", str(i).encode())
+    await _drain(received, 20)
+    assert sorted(int(d.body) for d in received) == list(range(20))
+    assert b.stats["dropped"] > 0  # faults actually fired
+    b.close()
+
+
+@pytest.mark.asyncio
+async def test_dup_fault_injection_duplicates():
+    b = InProcBroker(BrokerConfig(dup_prob=1.0), seed=1)
+    received = []
+
+    async def cb(d: Delivery):
+        received.append(d)
+        b.ack(tag, d.delivery_tag)
+
+    tag = b.basic_consume("q", cb)
+    b.publish("q", b"x")
+    await _drain(received, 2)
+    assert received[1].redelivered
+    b.close()
