@@ -55,6 +55,54 @@ def _effective_threshold(thr, enqueue_t, now, widen_per_sec: float, max_threshol
 _pair_distance = scoring.distance
 
 
+def greedy_pair(vals, idxs, self_slot, capacity: int):
+    """Greedy conflict-free pairing over B×K candidate lists.
+
+    Repeatedly takes the globally best remaining (request, candidate) edge
+    and retires both endpoints — the batched analog of the reference's "best
+    candidate wins" applied in score order; a NumPy mirror of this exact
+    loop is the oracle in tests. Slot ids may be local (single device,
+    ``capacity`` = P) or global (sharded, ``capacity`` = n·P_local) — the
+    loop only needs ids < capacity to be real and >= capacity to be padding.
+
+    Returns (q_slot i32[B], c_slot i32[B], dist f32[B]); unmatched lanes
+    hold the sentinel ``capacity`` / +inf.
+    """
+    b, k = vals.shape
+    cap = capacity
+
+    def body(i, state):
+        row_used, slot_used, out_q, out_c, out_d = state
+        cand_used = slot_used[jnp.clip(idxs, 0, cap - 1)] | (idxs >= cap)
+        self_used = slot_used[jnp.clip(self_slot, 0, cap - 1)] | (self_slot >= cap)
+        dead = row_used[:, None] | cand_used | self_used[:, None]
+        masked = jnp.where(dead, _NEG_INF, vals)
+        flat = masked.reshape(-1)
+        a = jnp.argmax(flat)
+        v = flat[a]
+        ok = v > _NEG_INF
+        r = a // k
+        c = idxs.reshape(-1)[a]
+        sq = self_slot[r]
+        out_q = out_q.at[i].set(jnp.where(ok, sq, cap))
+        out_c = out_c.at[i].set(jnp.where(ok, c, cap))
+        out_d = out_d.at[i].set(jnp.where(ok, -v, jnp.float32(jnp.inf)))
+        row_used = row_used.at[r].set(row_used[r] | ok)
+        slot_used = slot_used.at[jnp.clip(sq, 0, cap - 1)].max(ok)
+        slot_used = slot_used.at[jnp.clip(c, 0, cap - 1)].max(ok)
+        return row_used, slot_used, out_q, out_c, out_d
+
+    init = (
+        jnp.zeros(b, jnp.bool_),
+        jnp.zeros(cap, jnp.bool_),
+        jnp.full(b, cap, jnp.int32),
+        jnp.full(b, cap, jnp.int32),
+        jnp.full(b, jnp.inf, jnp.float32),
+    )
+    _, _, out_q, out_c, out_d = lax.fori_loop(0, b, body, init)
+    return out_q, out_c, out_d
+
+
 class KernelSet:
     """Compiled step functions for one (pool geometry × queue config).
 
@@ -159,57 +207,16 @@ class KernelSet:
     # ---- pairing ----------------------------------------------------------
 
     def greedy_pair(self, vals, idxs, self_slot):
-        """Greedy conflict-free pairing over the B×K candidate lists.
-
-        Repeatedly takes the globally best remaining (request, candidate)
-        edge and retires both endpoints — the batched analog of the
-        reference's "best candidate wins" applied in score order; a NumPy
-        mirror of this exact loop is the oracle in tests.
-
-        Returns (q_slot i32[B], c_slot i32[B], dist f32[B]); unmatched lanes
-        hold the sentinel P.
-        """
-        b, k = vals.shape
-        P = self.capacity
-
-        def body(i, state):
-            row_used, slot_used, out_q, out_c, out_d = state
-            cand_used = slot_used[jnp.clip(idxs, 0, P - 1)] | (idxs >= P)
-            self_used = slot_used[jnp.clip(self_slot, 0, P - 1)] | (self_slot >= P)
-            dead = row_used[:, None] | cand_used | self_used[:, None]
-            masked = jnp.where(dead, _NEG_INF, vals)
-            flat = masked.reshape(-1)
-            a = jnp.argmax(flat)
-            v = flat[a]
-            ok = v > _NEG_INF
-            r = a // k
-            c = idxs.reshape(-1)[a]
-            sq = self_slot[r]
-            out_q = out_q.at[i].set(jnp.where(ok, sq, P))
-            out_c = out_c.at[i].set(jnp.where(ok, c, P))
-            out_d = out_d.at[i].set(jnp.where(ok, -v, jnp.float32(jnp.inf)))
-            row_used = row_used.at[r].set(row_used[r] | ok)
-            slot_used = slot_used.at[jnp.clip(sq, 0, P - 1)].max(ok)
-            slot_used = slot_used.at[jnp.clip(c, 0, P - 1)].max(ok)
-            return row_used, slot_used, out_q, out_c, out_d
-
-        init = (
-            jnp.zeros(b, jnp.bool_),
-            jnp.zeros(P, jnp.bool_),
-            jnp.full(b, P, jnp.int32),
-            jnp.full(b, P, jnp.int32),
-            jnp.full(b, jnp.inf, jnp.float32),
-        )
-        _, _, out_q, out_c, out_d = lax.fori_loop(0, b, body, init)
-        return out_q, out_c, out_d
+        return greedy_pair(vals, idxs, self_slot, self.capacity)
 
     # ---- the full step ----------------------------------------------------
 
     def _search_step(self, pool: dict[str, Any], batch: dict[str, Any], now):
         """One window: admit → score → top-k → pair → evict matched.
 
-        Returns (pool', q_slot[B], c_slot[B], quality[B]) with sentinel P in
-        unmatched lanes.
+        Returns (pool', q_slot[B], c_slot[B], dist[B]) with sentinel P /
+        +inf in unmatched lanes. Match quality is computed on the host from
+        the pair's requests (the host has both sides' exact thresholds).
         """
         pool = self._admit(pool, batch)
         q_thr_eff = _effective_threshold(
@@ -223,20 +230,7 @@ class KernelSet:
         active = pool["active"].at[out_q].set(False, mode="drop")
         active = active.at[out_c].set(False, mode="drop")
         pool = dict(pool, active=active)
-
-        # Quality from the pair's own effective limits: 1 − d / min(thr).
-        P = self.capacity
-        matched = out_q < P
-        gather = lambda arr, idx: arr[jnp.clip(idx, 0, P - 1)]
-        thr_eff_pool = _effective_threshold(
-            pool["threshold"], pool["enqueue_t"], now,
-            self.widen_per_sec, self.max_threshold,
-        )
-        limit = jnp.minimum(gather(thr_eff_pool, out_q), gather(thr_eff_pool, out_c))
-        quality = jnp.where(
-            matched & (limit > 0), jnp.maximum(0.0, 1.0 - out_d / limit), 0.0
-        )
-        return pool, out_q, out_c, quality
+        return pool, out_q, out_c, out_d
 
 
 @functools.lru_cache(maxsize=None)

@@ -1,0 +1,229 @@
+"""Multi-chip matching: the pool sharded over a device mesh.
+
+This is the rebuild's distributed story (SURVEY.md §2 "Distributed
+communication backend", §5 "Long-context / sequence parallelism"): where the
+reference scales by adding broker consumers on one BEAM node, here the pool's
+slot dimension is sharded over a ``jax.sharding.Mesh`` axis ``"pool"`` and
+each window is matched with XLA collectives over ICI:
+
+1. every shard scores the (replicated) request window against its local pool
+   block and keeps a local top-k — compute scales 1/n per chip;
+2. the tiny B×k candidate lists are merged across shards, either with one
+   ``all_gather`` (default; ≤ a few hundred KB) or with a ``ppermute`` ring
+   in which each hop merges a neighbor's running top-k — structurally ring
+   attention with "scores" = masked −distance and "softmax" = running top-k
+   (SURVEY.md §5's long-context analog);
+3. greedy pairing runs replicated on the merged lists (deterministic, so all
+   shards agree), and each shard evicts its own slice of the matched slots.
+
+The merged result is EXACTLY the global top-k (the global best k candidates
+per request are each the best within their own shard), so sharded and
+single-device engines produce identical matches — pinned by tests on the
+8-virtual-device CPU mesh.
+
+Interface matches ``KernelSet`` (admit / evict / search_step over a pool
+dict + padded batch dict), so ``TpuEngine`` swaps it in transparently when
+``EngineConfig.mesh_pool_axis > 1``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.6 exposes shard_map at the top level
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+from matchmaking_tpu.engine.kernels import KernelSet, _effective_threshold, greedy_pair
+
+AXIS = "pool"
+
+
+def pool_mesh(n_devices: int, devices: list | None = None) -> Mesh:
+    """A 1-D mesh over the pool axis (multi-host: pass jax.devices())."""
+    devs = (devices or jax.devices())[:n_devices]
+    if len(devs) < n_devices:
+        raise ValueError(
+            f"mesh_pool_axis={n_devices} but only {len(devs)} devices visible"
+        )
+    return Mesh(np.array(devs), (AXIS,))
+
+
+class ShardedKernelSet:
+    """Compiled sharded step functions; same call surface as KernelSet."""
+
+    def __init__(self, *, capacity: int, top_k: int, pool_block: int,
+                 glicko2: bool, widen_per_sec: float, max_threshold: float,
+                 mesh: Mesh, ring: bool = False, evict_bucket: int = 64):
+        self.mesh = mesh
+        self.n_shards = mesh.devices.size
+        if capacity % self.n_shards != 0:
+            capacity += self.n_shards - capacity % self.n_shards
+        self.capacity = capacity
+        self.local_capacity = capacity // self.n_shards
+        self.ring = ring
+        self.evict_bucket = evict_bucket
+        # Per-shard compute reuses the single-device kernel internals on the
+        # LOCAL slice (capacity = local_capacity).
+        self.local = KernelSet(
+            capacity=self.local_capacity, top_k=top_k,
+            pool_block=min(pool_block, self.local_capacity), glicko2=glicko2,
+            widen_per_sec=widen_per_sec, max_threshold=max_threshold,
+        )
+        self.top_k = self.local.top_k
+        self.widen_per_sec = widen_per_sec
+        self.max_threshold = max_threshold
+
+        pool_spec = {k: P(AXIS) for k in
+                     ("rating", "rd", "region", "mode", "threshold",
+                      "enqueue_t", "active")}
+        rep = P()
+        batch_spec = {k: rep for k in
+                      ("slot", "rating", "rd", "region", "mode", "threshold",
+                       "enqueue_t", "valid")}
+
+        self.search_step = jax.jit(
+            _shard_map(
+                self._search_step_shard, mesh=mesh,
+                in_specs=(pool_spec, batch_spec, rep),
+                out_specs=(pool_spec, rep, rep, rep),
+                check_vma=False,
+            ),
+            donate_argnums=0,
+        )
+        self.admit = jax.jit(
+            _shard_map(self._admit_shard, mesh=mesh,
+                       in_specs=(pool_spec, batch_spec), out_specs=pool_spec,
+                       check_vma=False),
+            donate_argnums=0,
+        )
+        self.evict = jax.jit(
+            _shard_map(self._evict_shard, mesh=mesh,
+                       in_specs=(pool_spec, rep), out_specs=pool_spec,
+                       check_vma=False),
+            donate_argnums=0,
+        )
+
+    # ---- helpers (run per shard, inside shard_map) ------------------------
+
+    def _localize_batch(self, batch: dict[str, Any]) -> dict[str, Any]:
+        """Global slot ids → this shard's local ids (others → sentinel)."""
+        offset = lax.axis_index(AXIS) * self.local_capacity
+        local = batch["slot"] - offset
+        mine = (local >= 0) & (local < self.local_capacity)
+        return dict(batch, slot=jnp.where(mine, local, self.local_capacity))
+
+    def _admit_shard(self, pool, batch):
+        return self.local._admit(pool, self._localize_batch(batch))
+
+    def _evict_shard(self, pool, slots):
+        offset = lax.axis_index(AXIS) * self.local_capacity
+        local = slots - offset
+        mine = (local >= 0) & (local < self.local_capacity)
+        return self.local._evict(pool, jnp.where(mine, local, self.local_capacity))
+
+    def _global_topk(self, vals, gidx):
+        """Merge per-shard top-k into the global top-k on every shard.
+
+        Both paths assemble the n contributions in CANONICAL shard order
+        before the final top-k: lax.top_k breaks exact-score ties by input
+        position, so a shard-dependent merge order would let tied candidates
+        win on some shards and lose on others — the "replicated" pairing
+        would then diverge across shards and desynchronize device state from
+        the host mirror (exact distance ties are common with integer
+        ratings).
+        """
+        n = self.n_shards
+        b, k = vals.shape
+        if not self.ring:
+            av = lax.all_gather(vals, AXIS)            # (n, B, k), axis order
+            ai = lax.all_gather(gidx, AXIS)
+        else:
+            # Ring collect: rotate the ORIGINAL local top-k one hop per step
+            # (the ring-attention communication pattern — each hop only
+            # talks to a neighbor) and scatter each received block into its
+            # source shard's slot, so the final merge sees the identical
+            # canonically-ordered buffer on every shard.
+            my = lax.axis_index(AXIS)
+            perm = [(i, (i + 1) % n) for i in range(n)]
+            av = jnp.zeros((n, b, k), vals.dtype).at[my].set(vals)
+            ai = jnp.full((n, b, k), self.capacity, gidx.dtype).at[my].set(gidx)
+            rot_v, rot_i = vals, gidx
+            for h in range(1, n):
+                rot_v = lax.ppermute(rot_v, AXIS, perm)
+                rot_i = lax.ppermute(rot_i, AXIS, perm)
+                src = (my - h) % n
+                av = av.at[src].set(rot_v)
+                ai = ai.at[src].set(rot_i)
+        av = jnp.moveaxis(av, 0, 1).reshape(b, n * k)
+        ai = jnp.moveaxis(ai, 0, 1).reshape(b, n * k)
+        nv, sel = lax.top_k(av, self.top_k)
+        return nv, jnp.take_along_axis(ai, sel, axis=1)
+
+    # ---- the sharded step -------------------------------------------------
+
+    def _search_step_shard(self, pool, batch, now):
+        lk = self.local
+        offset = lax.axis_index(AXIS) * self.local_capacity
+
+        # 1. Admit this shard's slice of the window.
+        local_batch = self._localize_batch(batch)
+        pool = lk._admit(pool, local_batch)
+
+        # 2. Local top-k against the local pool block. The batch keeps its
+        #    GLOBAL slot ids for self-masking: compare against global index.
+        q_thr_eff = _effective_threshold(
+            batch["threshold"], batch["enqueue_t"], now,
+            self.widen_per_sec, self.max_threshold,
+        )
+        # Self-mask needs global ids: shift the batch slots into the local
+        # frame (non-local ids land outside [0, local_capacity) and thus
+        # never self-mask, which is correct — the self slot lives on exactly
+        # one shard).
+        vals, idxs_local = lk._topk_candidates(
+            dict(batch, slot=batch["slot"] - offset), q_thr_eff, pool, now
+        )
+        gidx = jnp.where(idxs_local >= self.local_capacity,
+                         self.capacity, idxs_local + offset)
+
+        # 3. Global top-k on every shard (all_gather or ppermute ring).
+        mv, mi = self._global_topk(vals, gidx)
+
+        # 4. Replicated greedy pairing on global ids (deterministic — every
+        #    shard computes the identical pairing, no broadcast needed).
+        out_q, out_c, out_d = greedy_pair(mv, mi, batch["slot"], self.capacity)
+
+        # 5. Each shard evicts its slice of the matched slots.
+        for side in (out_q, out_c):
+            local = side - offset
+            mine = (local >= 0) & (local < self.local_capacity)
+            safe = jnp.where(mine, local, self.local_capacity)
+            pool = dict(pool, active=pool["active"].at[safe].set(False, mode="drop"))
+        return pool, out_q, out_c, out_d
+
+    # ---- placement --------------------------------------------------------
+
+    def place_pool(self, arrays: dict[str, np.ndarray]) -> dict[str, jnp.ndarray]:
+        sharding = NamedSharding(self.mesh, P(AXIS))
+        return {k: jax.device_put(jnp.asarray(v), sharding)
+                for k, v in arrays.items()}
+
+
+@functools.lru_cache(maxsize=None)
+def sharded_kernel_set(capacity: int, top_k: int, pool_block: int,
+                       glicko2: bool, widen_per_sec: float,
+                       max_threshold: float, n_shards: int,
+                       ring: bool) -> ShardedKernelSet:
+    return ShardedKernelSet(
+        capacity=capacity, top_k=top_k, pool_block=pool_block, glicko2=glicko2,
+        widen_per_sec=widen_per_sec, max_threshold=max_threshold,
+        mesh=pool_mesh(n_shards), ring=ring,
+    )

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 
 from matchmaking_tpu.config import Config, QueueConfig
 from matchmaking_tpu.core.pool import BatchArrays, PlayerPool
+from matchmaking_tpu.engine import scoring
 from matchmaking_tpu.engine.interface import Engine, Match, SearchOutcome
 from matchmaking_tpu.engine.kernels import kernel_set
 from matchmaking_tpu.service.contract import SearchRequest, new_match_id
@@ -40,23 +41,43 @@ class TpuEngine(Engine):
     def __init__(self, cfg: Config, queue: QueueConfig):
         super().__init__(cfg, queue)
         ec = cfg.engine
-        self.pool = PlayerPool(ec.pool_capacity, queue.rating_threshold)
-        self.kernels = kernel_set(
-            capacity=ec.pool_capacity,
-            top_k=ec.top_k,
-            pool_block=min(ec.pool_block, ec.pool_capacity),
-            glicko2=queue.glicko2,
-            widen_per_sec=queue.widen_per_sec,
-            max_threshold=queue.max_threshold,
-        )
+        if ec.mesh_pool_axis > 1:
+            # Multi-chip: pool slots sharded over the mesh axis "pool";
+            # windows matched with XLA collectives (engine/sharded.py).
+            from matchmaking_tpu.engine.sharded import sharded_kernel_set
+
+            self.kernels = sharded_kernel_set(
+                capacity=ec.pool_capacity,
+                top_k=ec.top_k,
+                pool_block=ec.pool_block,
+                glicko2=queue.glicko2,
+                widen_per_sec=queue.widen_per_sec,
+                max_threshold=queue.max_threshold,
+                n_shards=ec.mesh_pool_axis,
+                ring=ec.ring_merge,
+            )
+            init = PlayerPool.empty_device_arrays(self.kernels.capacity)
+            self._dev_pool = self.kernels.place_pool(init)
+        else:
+            self.kernels = kernel_set(
+                capacity=ec.pool_capacity,
+                top_k=ec.top_k,
+                pool_block=min(ec.pool_block, ec.pool_capacity),
+                glicko2=queue.glicko2,
+                widen_per_sec=queue.widen_per_sec,
+                max_threshold=queue.max_threshold,
+            )
+            self._dev_pool = jax.device_put(
+                {k: jnp.asarray(v)
+                 for k, v in PlayerPool.empty_device_arrays(self.kernels.capacity).items()}
+            )
+        # Capacity may have been rounded up (sharding divisibility).
+        self.pool = PlayerPool(self.kernels.capacity, queue.rating_threshold)
         self.buckets = tuple(sorted(ec.batch_buckets))
         # Wall-clock rebase: device times are float32 (128 s spacing at epoch
         # magnitude), so all device-visible times are relative to the first
         # timestamp this engine sees.
         self._t0: float | None = None
-        self._dev_pool = jax.device_put(
-            {k: jnp.asarray(v) for k, v in PlayerPool.empty_device_arrays(ec.pool_capacity).items()}
-        )
         # Team/role queues: host-side matching over the mirror (same oracle
         # semantics as CpuEngine); device kernels cover the 1v1 hot path.
         self._team_delegate = None
@@ -154,15 +175,15 @@ class TpuEngine(Engine):
         bucket = self._bucket_for(len(window))
         t0 = self._rel_base(now)
         batch = self.pool.batch_arrays(window, slots, bucket, t0)
-        self._dev_pool, q_slot, c_slot, quality = self.kernels.search_step(
+        self._dev_pool, q_slot, c_slot, dist = self.kernels.search_step(
             self._dev_pool, _as_jnp(batch), jnp.float32(now - t0)
         )
         # One small D2H transfer per window: three B-length arrays.
-        q_slot, c_slot, quality = (np.asarray(q_slot), np.asarray(c_slot),
-                                   np.asarray(quality))
+        q_slot, c_slot, dist = (np.asarray(q_slot), np.asarray(c_slot),
+                                np.asarray(dist))
         P = self.kernels.capacity
         matched_ids: set[str] = set()
-        for qs, cs, qual in zip(q_slot, c_slot, quality):
+        for qs, cs, d in zip(q_slot, c_slot, dist):
             if qs >= P:
                 continue
             req_q = self.pool.request_at(int(qs))
@@ -170,9 +191,16 @@ class TpuEngine(Engine):
             self.pool.release([int(qs), int(cs)])
             matched_ids.add(req_q.id)
             matched_ids.add(req_c.id)
+            # Quality from the pair's effective limits at match time (host
+            # has both requests; same formula as the CPU oracle).
+            qual = scoring.quality(
+                float(d),
+                self.effective_threshold(req_q, now),
+                self.effective_threshold(req_c, now),
+            )
             out.matches.append(
                 Match(match_id=new_match_id(), teams=((req_q,), (req_c,)),
-                      quality=float(qual))
+                      quality=qual)
             )
         for req in window:
             if req.id not in matched_ids:

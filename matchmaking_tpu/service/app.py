@@ -57,6 +57,7 @@ class _QueueRuntime:
         self._engine_lock = asyncio.Lock()
         # At-least-once dedup: player id → (terminal SearchResponse, expiry).
         self._recent: dict[str, tuple[SearchResponse, float]] = {}
+        self._next_prune = 0.0
         self.consumer_tag = app.broker.basic_consume(
             queue_cfg.name, self._on_delivery, prefetch=app.cfg.broker.prefetch
         )
@@ -167,8 +168,12 @@ class _QueueRuntime:
         self._recent[player_id] = (resp, now + self.queue_cfg.dedup_ttl_s)
 
     def _prune_recent(self, now: float) -> None:
-        if len(self._recent) > 4096:
+        # Time-throttled: a full-dict rebuild on every window would be O(n)
+        # hot-path overhead under sustained load; expiry only moves at TTL
+        # granularity anyway.
+        if len(self._recent) > 4096 and now >= self._next_prune:
             self._recent = {k: v for k, v in self._recent.items() if v[1] > now}
+            self._next_prune = now + self.queue_cfg.dedup_ttl_s / 2.0
 
     def _respond(self, req: SearchRequest, resp: SearchResponse) -> None:
         if not req.reply_to:

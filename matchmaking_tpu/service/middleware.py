@@ -64,13 +64,20 @@ class DecodeMiddleware(Middleware):
     they reach the engine)."""
 
     async def call(self, ctx: MessageContext, next: Next) -> None:  # noqa: A002
+        # The wait clock must survive redelivery: a nacked/crashed window's
+        # redelivered copy carries the same Properties object, so the first
+        # receive time is stamped into its headers once — otherwise timeout
+        # sweeping and threshold widening restart from zero on every retry.
+        first_received = ctx.delivery.properties.headers.setdefault(
+            "x-first-received", ctx.received_at
+        )
         try:
             ctx.request = decode_request(
                 ctx.delivery.body,
                 reply_to=ctx.delivery.properties.reply_to,
                 correlation_id=ctx.delivery.properties.correlation_id,
                 queue=ctx.queue,
-                enqueued_at=ctx.received_at,
+                enqueued_at=float(first_received),
             )
         except ContractError as e:
             raise MiddlewareReject(e.code, e.reason) from e

@@ -11,7 +11,7 @@ from __future__ import annotations
 import json
 import math
 import time
-from collections import defaultdict
+from collections import defaultdict, deque
 from dataclasses import dataclass, field
 
 
@@ -30,17 +30,24 @@ class Counter:
 
 
 class LatencyRecorder:
-    """Reservoir-less latency recorder: keeps every sample (bench windows are
-    bounded); exposes percentiles the BASELINE metric asks for."""
+    """Sliding-window latency recorder: keeps the most recent ``window``
+    samples (bounded memory for a long-lived service; one sample lands here
+    per matched player) plus lifetime count/max; percentiles are over the
+    window."""
 
-    def __init__(self) -> None:
-        self._samples: list[float] = []
+    def __init__(self, window: int = 65_536) -> None:
+        self._samples: deque[float] = deque(maxlen=window)
+        self._count = 0
+        self._max = 0.0
 
     def record(self, seconds: float) -> None:
         self._samples.append(seconds)
+        self._count += 1
+        if seconds > self._max:
+            self._max = seconds
 
     def __len__(self) -> int:
-        return len(self._samples)
+        return self._count
 
     def percentile(self, p: float) -> float:
         if not self._samples:
@@ -52,13 +59,19 @@ class LatencyRecorder:
     def summary_ms(self) -> dict[str, float]:
         if not self._samples:
             return {"count": 0}
+        s = sorted(self._samples)
+
+        def pct(p: float) -> float:
+            k = min(len(s) - 1, max(0, math.ceil(p / 100.0 * len(s)) - 1))
+            return s[k]
+
         return {
-            "count": len(self._samples),
-            "p50_ms": round(self.percentile(50) * 1e3, 3),
-            "p90_ms": round(self.percentile(90) * 1e3, 3),
-            "p99_ms": round(self.percentile(99) * 1e3, 3),
-            "max_ms": round(max(self._samples) * 1e3, 3),
-            "mean_ms": round(sum(self._samples) / len(self._samples) * 1e3, 3),
+            "count": self._count,
+            "p50_ms": round(pct(50) * 1e3, 3),
+            "p90_ms": round(pct(90) * 1e3, 3),
+            "p99_ms": round(pct(99) * 1e3, 3),
+            "max_ms": round(self._max * 1e3, 3),
+            "mean_ms": round(sum(s) / len(s) * 1e3, 3),
         }
 
 

@@ -76,20 +76,19 @@ def make_batch(slots, ratings, bucket, capacity, thresholds=None, regions=None,
 
 
 def run_step(ks, pool, batch, now=0.0):
-    pool, q, c, qual = ks.search_step(pool, batch, jnp.float32(now))
-    return pool, np.asarray(q), np.asarray(c), np.asarray(qual)
+    pool, q, c, dist = ks.search_step(pool, batch, jnp.float32(now))
+    return pool, np.asarray(q), np.asarray(c), np.asarray(dist)
 
 
 def test_single_pair_matches_in_one_window():
     ks = make_kernels()
     pool = empty_pool()
     batch = make_batch([0, 1], [1500.0, 1540.0], bucket=4, capacity=256)
-    pool, q, c, qual = run_step(ks, pool, batch)
+    pool, q, c, dist = run_step(ks, pool, batch)
     pairs = {(int(a), int(b)) for a, b in zip(q, c) if a < 256}
     assert pairs == {(0, 1)} or pairs == {(1, 0)}
     assert not bool(np.asarray(pool["active"]).any())
-    matched_qual = qual[q < 256]
-    assert matched_qual[0] == pytest.approx(1.0 - 40.0 / 100.0)
+    assert dist[q < 256][0] == pytest.approx(40.0)
 
 
 def test_out_of_threshold_stays_active():
@@ -156,11 +155,10 @@ def test_glicko2_device_matches_scoring_formula():
     delta = 140.0
     batch = make_batch([0, 1], [1500.0, 1500.0 + delta], bucket=4, capacity=256,
                        rds=[350.0, 350.0])
-    pool, q, c, qual = run_step(ks, pool, batch)
+    pool, q, c, dist = run_step(ks, pool, batch)
     assert (q < 256).any()  # g·Δ ≈ 82.6 < 100 → matches
     d = scoring.distance(1500.0, 1500.0 + delta, 350.0, 350.0, glicko2=True)
-    expect_q = scoring.quality(d, 100.0, 100.0)
-    assert qual[q < 256][0] == pytest.approx(expect_q, rel=1e-5)
+    assert dist[q < 256][0] == pytest.approx(d, rel=1e-5)
     # rd = 0 → plain distance 140 > 100 → no match.
     pool2 = empty_pool()
     batch2 = make_batch([0, 1], [1500.0, 1500.0 + delta], bucket=4, capacity=256,

@@ -383,3 +383,35 @@ async def test_reply_queues_do_not_leak():
     # players still being matched.
     assert len(app.broker._queues) <= base + 1
     await app.stop()
+
+
+async def test_redelivery_preserves_wait_clock(monkeypatch):
+    # A crashed window's redelivered request must keep its original
+    # enqueued_at (timeout sweeper / widening restart otherwise).
+    app = MatchmakingApp(tiny_cfg())
+    await app.start()
+    rt = app.runtime("matchmaking.search")
+    real_search = rt.engine.search
+    calls = {"n": 0}
+    seen_enqueued = []
+
+    def crashing_search(requests, now):
+        calls["n"] += 1
+        seen_enqueued.extend(r.enqueued_at for r in requests)
+        if calls["n"] == 1:
+            raise RuntimeError("crash before matching")
+        return real_search(requests, now)
+
+    monkeypatch.setattr(rt.engine, "search", crashing_search)
+    client = MatchmakingClient(app.broker, "matchmaking.search")
+    r = client.submit({"id": "alice", "rating": 1500})
+    resp = await client.next_response(r, timeout=3.0)
+    assert resp is not None and resp.status == "queued"
+    # The crash revived the engine (new object, real search), so the
+    # redelivered copy lives in the NEW engine's pool: its enqueued_at must
+    # equal the original receive time, not the redelivery time.
+    assert calls["n"] == 1
+    waiting = rt.engine.waiting()
+    assert len(waiting) == 1
+    assert waiting[0].enqueued_at == pytest.approx(seen_enqueued[0], abs=1e-6)
+    await app.stop()

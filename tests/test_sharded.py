@@ -1,0 +1,126 @@
+"""Sharded engine on the 8-virtual-device CPU mesh (SURVEY.md §4: emulate
+multi-node by running mesh code under jax.sharding): sharded ≡ single-device
+matches, all_gather ≡ ring merge, eviction correctness across shards."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from matchmaking_tpu.config import Config, EngineConfig, QueueConfig
+from matchmaking_tpu.engine.tpu import TpuEngine
+from matchmaking_tpu.service.contract import SearchRequest
+
+
+needs_8 = pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+
+
+def cfg(n_shards, ring=False, capacity=512):
+    return Config(engine=EngineConfig(
+        backend="tpu", pool_capacity=capacity, top_k=4, pool_block=64,
+        batch_buckets=(8, 32), mesh_pool_axis=n_shards, ring_merge=ring,
+    ))
+
+
+def req(pid, rating, **kw):
+    return SearchRequest(id=pid, rating=rating, **kw)
+
+
+def run_workload(engine, seed=5, n_windows=10, per_window=8):
+    rng = np.random.default_rng(seed)
+    pairs = set()
+    pid = 0
+    for w in range(n_windows):
+        window = []
+        for _ in range(per_window):
+            window.append(req(f"p{pid}", float(rng.normal(1500, 90))))
+            pid += 1
+        out = engine.search(window, now=float(w))
+        for m in out.matches:
+            pairs.add(frozenset(r.id for t in m.teams for r in t))
+    return pairs
+
+
+@needs_8
+@pytest.mark.parametrize("ring", [False, True], ids=["all_gather", "ring"])
+def test_sharded_equals_single_device(ring):
+    single = TpuEngine(cfg(1), QueueConfig(rating_threshold=100.0))
+    sharded = TpuEngine(cfg(8, ring=ring), QueueConfig(rating_threshold=100.0))
+    pairs_single = run_workload(single)
+    pairs_sharded = run_workload(sharded)
+    # Same greedy semantics on the global top-k → identical match sets.
+    assert pairs_sharded == pairs_single
+    assert sharded.pool_size() == single.pool_size()
+
+
+@needs_8
+def test_sharded_cross_shard_match_and_eviction():
+    # Two players whose slots land on different shards must still match,
+    # and both shards must evict their half.
+    eng = TpuEngine(cfg(8, capacity=64), QueueConfig(rating_threshold=100.0))
+    local = eng.kernels.local_capacity  # 8 slots per shard
+    # Fill shard 0 completely with far-apart players so the next allocation
+    # lands on shard 1.
+    filler = [req(f"f{i}", 100_000.0 * (i + 1)) for i in range(local)]
+    eng.search(filler, now=0.0)
+    assert eng.pool.slot_of("f0") is not None
+    a = req("a", 1500.0)
+    eng.search([a], now=1.0)
+    slot_a = eng.pool.slot_of("a")
+    assert slot_a >= local  # landed beyond shard 0
+    out = eng.search([req("b", 1510.0)], now=2.0)
+    assert len(out.matches) == 1
+    ids = {r.id for t in out.matches[0].teams for r in t}
+    assert ids == {"a", "b"}
+    assert eng.pool_size() == local  # only the filler remains
+    # The evicted cross-shard slots must not ghost-match later.
+    out = eng.search([req("c", 1505.0)], now=3.0)
+    assert not out.matches
+
+
+@needs_8
+def test_sharded_capacity_rounds_up():
+    eng = TpuEngine(cfg(8, capacity=100), QueueConfig())
+    assert eng.kernels.capacity == 104  # next multiple of 8
+    assert eng.pool.capacity == 104
+
+
+@needs_8
+def test_sharded_widening_and_glicko():
+    q = QueueConfig(rating_threshold=50.0, widen_per_sec=10.0,
+                    max_threshold=400.0, glicko2=True)
+    eng = TpuEngine(cfg(8), q)
+    eng.search([req("a", 1500.0, rating_deviation=0.0, enqueued_at=0.0)], now=0.0)
+    out = eng.search([req("b", 1580.0, rating_deviation=0.0, enqueued_at=0.0)], now=10.0)
+    # Δ=80 > 50 base, but widened to 150 after 10 s → match.
+    assert len(out.matches) == 1
+
+
+@needs_8
+@pytest.mark.parametrize("ring", [False, True], ids=["all_gather", "ring"])
+def test_sharded_exact_tie_stays_consistent(ring):
+    # Two candidates exactly equidistant from the query, on different
+    # shards: tie-breaking must be identical on every shard or device state
+    # desyncs from the host mirror (review regression).
+    eng = TpuEngine(cfg(8, ring=ring, capacity=64), QueueConfig(rating_threshold=100.0))
+    local = eng.kernels.local_capacity
+    # Far-apart fillers (gaps >> threshold so they never match each other).
+    filler = [req(f"f{i}", 1e6 + 10_000.0 * i) for i in range(local)]
+    eng.search(filler, now=0.0)          # fill shard 0
+    eng.search([req("lo", 1440.0)], now=0.0)   # shard 1
+    more = [req(f"g{i}", 2e6 + 10_000.0 * i) for i in range(local - 1)]
+    eng.search(more, now=0.0)            # finish shard 1
+    eng.search([req("hi", 1560.0)], now=0.0)   # shard 2
+    out = eng.search([req("mid", 1500.0)], now=1.0)  # d=60 to both
+    assert len(out.matches) == 1
+    winner = ({r.id for t in out.matches[0].teams for r in t} - {"mid"}).pop()
+    # The loser must still be matchable (device + mirror agree).
+    loser = "hi" if winner == "lo" else "lo"
+    loser_rating = 1440.0 if loser == "lo" else 1560.0
+    out = eng.search([req("x", loser_rating + 1.0)], now=2.0)
+    ids = {r.id for t in out.matches[0].teams for r in t}
+    assert ids == {"x", loser}
+    # No ghosts: active device slots == host mirror count (fillers remain).
+    import numpy as np
+    active = int(np.asarray(eng._dev_pool["active"]).sum())
+    assert active == eng.pool_size() == 2 * local - 1
