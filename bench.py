@@ -1,0 +1,269 @@
+#!/usr/bin/env python
+"""Headline benchmark: matches/sec + p99 match latency @ 100k-player pool.
+
+BASELINE.json: the reference (Elixir GenServer pool, sequential ETS scan per
+request) caps out around ~2k concurrently-queued players; the north star is
+>=100k concurrent players matched at p99 < 50 ms on TPU. This harness:
+
+1. TPU engine: pre-fills the device pool to POOL players (restore path — no
+   matching), then streams windows of fresh requests through the full engine
+   step (admit scatter -> blockwise score+mask -> streaming top-k -> greedy
+   conflict-free pairing -> evict scatter -> D2H), refilling the pool between
+   timed windows so every measurement sees a ~POOL-player pool.
+2. CPU oracle (reference semantics) at its own viable operating point
+   (~2k pool) for the vs_baseline ratio — the reference publishes no numbers
+   (BASELINE.json published: {}), so the oracle stands in for it.
+
+Prints exactly ONE JSON line on stdout:
+  {"metric": ..., "value": <matches/sec>, "unit": ..., "vs_baseline": ...}
+plus supporting fields (p99_ms, pool, cpu_mps, ...). Diagnostics go to stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def make_requests(rng: np.random.Generator, n: int, start_id: int,
+                  now: float, threshold: float | None = None):
+    from matchmaking_tpu.service.contract import SearchRequest
+
+    ratings = rng.normal(1500.0, 300.0, size=n)
+    return [
+        SearchRequest(
+            id=f"p{start_id + i}",
+            rating=float(ratings[i]),
+            rating_threshold=threshold,
+            enqueued_at=now,
+        )
+        for i in range(n)
+    ]
+
+
+def run_engine(engine, rng: np.random.Generator, *, pool_target: int,
+               window: int, warmup: int, measured: int, label: str):
+    """Stream windows through ``engine.search`` at a sustained pool size.
+
+    Returns (matches_per_sec, per-window latencies in seconds, total matches).
+    """
+    next_id = 0
+    now = 0.0
+
+    def refill() -> None:
+        nonlocal next_id, now
+        deficit = pool_target - engine.pool_size()
+        while deficit > 0:
+            chunk = min(deficit, 4096)
+            fillers = make_requests(rng, chunk, next_id, now)
+            next_id += chunk
+            engine.restore(fillers, now)
+            deficit -= chunk
+
+    refill()
+    log(f"[{label}] pool filled to {engine.pool_size()}")
+
+    latencies: list[float] = []
+    total_matches = 0
+    measured_time = 0.0
+    for i in range(warmup + measured):
+        reqs = make_requests(rng, window, next_id, now)
+        next_id += window
+        t0 = time.perf_counter()
+        out = engine.search(reqs, now)
+        dt = time.perf_counter() - t0
+        now += max(dt, 1e-4)
+        if i >= warmup:
+            latencies.append(dt)
+            total_matches += len(out.matches)
+            measured_time += dt
+        refill()
+
+    mps = total_matches / measured_time if measured_time > 0 else 0.0
+    return mps, latencies, total_matches
+
+
+def run_engine_pipelined(engine, rng: np.random.Generator, *, pool_target: int,
+                         window: int, warmup: int, measured: int, depth: int,
+                         label: str):
+    """Stream windows through the pipelined API (``search_async`` +
+    ``collect_ready``) keeping ≤ ``depth`` windows in flight.
+
+    Latency per window = dispatch call → results collected on host (the
+    end-to-end path a request sees past the batcher). Throughput is counted
+    over the measured tokens' span.
+    """
+    next_id = 0
+    wall0 = time.perf_counter()
+
+    def wall() -> float:
+        return time.perf_counter() - wall0
+
+    def refill() -> None:
+        nonlocal next_id
+        deficit = pool_target - engine.pool_size()
+        while deficit > 0:
+            chunk = min(deficit, 4096)
+            engine.restore(make_requests(rng, chunk, next_id, wall()), wall())
+            next_id += chunk
+            deficit -= chunk
+
+    refill()
+    log(f"[{label}] pool filled to {engine.pool_size()}")
+
+    submit_t: dict[int, float] = {}
+    timed: dict[int, bool] = {}
+    latencies: list[float] = []
+    total_matches = 0
+    t_start = None
+    t_last = None
+
+    def handle(token: int, out) -> None:
+        nonlocal total_matches, t_last
+        lat = time.perf_counter() - submit_t.pop(token)
+        if timed.pop(token):
+            latencies.append(lat)
+            total_matches += len(out.matches)
+            t_last = time.perf_counter()
+
+    for i in range(warmup + measured):
+        reqs = make_requests(rng, window, next_id, wall())
+        next_id += window
+        if i == warmup:
+            t_start = time.perf_counter()
+        tok, _ = engine.search_async(reqs, wall())
+        submit_t[tok] = time.perf_counter()
+        timed[tok] = i >= warmup
+        for tok2, out in engine.collect_ready():
+            handle(tok2, out)
+        while engine.inflight() >= depth:
+            got = engine.collect_ready()
+            if not got:
+                time.sleep(0.0005)
+            for tok2, out in got:
+                handle(tok2, out)
+        refill()
+    for tok2, out in engine.flush():
+        handle(tok2, out)
+
+    span = (t_last - t_start) if (t_start and t_last and t_last > t_start) else 0.0
+    mps = total_matches / span if span > 0 else 0.0
+    return mps, latencies, total_matches
+
+
+def bench_tpu(args) -> dict:
+    from matchmaking_tpu.config import Config, EngineConfig, QueueConfig
+    from matchmaking_tpu.engine.interface import make_engine
+
+    cfg = Config(
+        queues=(QueueConfig(rating_threshold=100.0),),
+        engine=EngineConfig(
+            backend="tpu",
+            pool_capacity=args.capacity,
+            pool_block=args.pool_block,
+            batch_buckets=(16, 64, 256, args.window),
+            top_k=8,
+        ),
+    )
+    engine = make_engine(cfg, cfg.queues[0])
+    rng = np.random.default_rng(0)
+    t0 = time.perf_counter()
+    mps, lats, total = run_engine_pipelined(
+        engine, rng, pool_target=args.pool, window=args.window,
+        warmup=args.warmup, measured=args.windows, depth=args.depth,
+        label="tpu")
+    log(f"[tpu] {total} matches over {len(lats)} windows "
+        f"({time.perf_counter() - t0:.1f}s total incl. fill/compile)")
+    lat_ms = np.sort(np.asarray(lats)) * 1e3
+    return {
+        "matches_per_sec": mps,
+        "p50_ms": float(np.percentile(lat_ms, 50)),
+        "p99_ms": float(np.percentile(lat_ms, 99)),
+        "total_matches": total,
+        "pool": args.pool,
+        "window": args.window,
+    }
+
+
+def bench_cpu_oracle(args) -> dict:
+    """Reference-semantics oracle at the reference's ~2k-player scale."""
+    from matchmaking_tpu.config import Config, QueueConfig
+    from matchmaking_tpu.engine.interface import make_engine
+
+    cfg = Config(queues=(QueueConfig(rating_threshold=100.0),))
+    engine = make_engine(cfg, cfg.queues[0])
+    rng = np.random.default_rng(1)
+    mps, lats, total = run_engine(
+        engine, rng, pool_target=args.cpu_pool, window=64,
+        warmup=2, measured=args.cpu_windows, label="cpu")
+    lat_ms = np.sort(np.asarray(lats)) * 1e3
+    return {
+        "matches_per_sec": mps,
+        "p99_ms": float(np.percentile(lat_ms, 99)),
+        "total_matches": total,
+        "pool": args.cpu_pool,
+    }
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--pool", type=int, default=100_000,
+                   help="sustained concurrent pool size (headline: 100k)")
+    p.add_argument("--capacity", type=int, default=131_072)
+    p.add_argument("--pool-block", type=int, default=8192)
+    p.add_argument("--window", type=int, default=1024,
+                   help="requests per timed search window")
+    p.add_argument("--windows", type=int, default=50,
+                   help="measured windows")
+    p.add_argument("--warmup", type=int, default=5)
+    p.add_argument("--depth", type=int, default=4,
+                   help="max in-flight windows (pipelining hides device RTT)")
+    p.add_argument("--cpu-pool", type=int, default=2000,
+                   help="CPU-oracle pool size (the reference's ~cap)")
+    p.add_argument("--cpu-windows", type=int, default=20)
+    p.add_argument("--skip-cpu", action="store_true")
+    args = p.parse_args()
+
+    import jax
+
+    log(f"jax {jax.__version__} devices={jax.devices()}")
+
+    tpu = bench_tpu(args)
+    if args.skip_cpu:
+        cpu = {"matches_per_sec": float("nan")}
+        vs = float("nan")
+    else:
+        cpu = bench_cpu_oracle(args)
+        vs = (tpu["matches_per_sec"] / cpu["matches_per_sec"]
+              if cpu["matches_per_sec"] > 0 else float("inf"))
+
+    result = {
+        "metric": f"matches/sec @ {args.pool}-player pool (1v1 ELO)",
+        "value": round(tpu["matches_per_sec"], 1),
+        "unit": "matches/sec",
+        "vs_baseline": round(vs, 2),
+        "p50_ms": round(tpu["p50_ms"], 3),
+        "p99_ms": round(tpu["p99_ms"], 3),
+        "p99_target_ms": 50.0,
+        "pool": tpu["pool"],
+        "window": tpu["window"],
+        "total_matches": tpu["total_matches"],
+        "baseline": {
+            "what": "CPU oracle (reference sequential-scan semantics) "
+                    f"@ {args.cpu_pool}-player pool",
+            "matches_per_sec": round(cpu["matches_per_sec"], 1),
+        },
+    }
+    print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

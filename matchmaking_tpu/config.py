@@ -79,6 +79,11 @@ class EngineConfig:
     #: Score tile size over the pool dimension (blockwise scoring keeps the
     #: B×P score matrix out of HBM at P=100k; SURVEY.md §7 "Hard parts").
     pool_block: int = 8192
+    #: Proposal rounds in the parallel greedy pairing kernel. Each round
+    #: resolves all non-conflicting best edges at once; leftovers (rare —
+    #: they need ≥``pair_rounds`` collisions on their top-k list) stay in
+    #: the pool for the next window.
+    pair_rounds: int = 8
 
 
 @dataclass(frozen=True)

@@ -99,6 +99,10 @@ class Engine(abc.ABC):
         (matching a restored pair here would drop the Match on the floor —
         the service isn't listening for outcomes during recovery)."""
 
+    def close(self) -> None:
+        """Release engine resources (e.g. background threads) when the
+        engine is replaced. Default: nothing to release."""
+
     def effective_threshold(self, req: SearchRequest, now: float) -> float:
         """Reference knob ``rating_threshold`` + config-gated widening by
         wait time (SURVEY.md §2 C9)."""

@@ -55,51 +55,77 @@ def _effective_threshold(thr, enqueue_t, now, widen_per_sec: float, max_threshol
 _pair_distance = scoring.distance
 
 
-def greedy_pair(vals, idxs, self_slot, capacity: int):
-    """Greedy conflict-free pairing over B×K candidate lists.
+def greedy_pair(vals, idxs, self_slot, capacity: int, rounds: int = 8):
+    """Parallel greedy conflict-free pairing over B×K candidate lists.
 
-    Repeatedly takes the globally best remaining (request, candidate) edge
-    and retires both endpoints — the batched analog of the reference's "best
-    candidate wins" applied in score order; a NumPy mirror of this exact
-    loop is the oracle in tests. Slot ids may be local (single device,
-    ``capacity`` = P) or global (sharded, ``capacity`` = n·P_local) — the
-    loop only needs ids < capacity to be real and >= capacity to be padding.
+    A fixed number of proposal rounds (Luby-style parallel greedy matching —
+    the TPU-friendly replacement for picking edges one at a time, which
+    would be B sequential argmax steps):
 
-    Returns (q_slot i32[B], c_slot i32[B], dist f32[B]); unmatched lanes
-    hold the sentinel ``capacity`` / +inf.
+    1. every live request proposes its best remaining candidate;
+    2. each proposal claims BOTH endpoint slots (the requester's own slot
+       and the candidate's); a slot goes to the highest-scoring claimant,
+       ties to the lowest row index — two scatter passes (value max, then
+       row-id min among value-winners);
+    3. proposals that win both endpoints become matches; both slots retire;
+       losers re-propose next round against what remains.
+
+    The lexicographically-best live edge (score desc, row asc) always wins
+    both its claims, so every round forms ≥1 match while feasible edges
+    remain; with K candidates per row, ``rounds`` ≈ K retains effectively
+    everything a fully sequential greedy pass would form (leftovers stay in
+    the pool for the next window — same semantics as exhausting the K-deep
+    candidate list). Deterministic, so the sharded engine can run it
+    replicated on every shard. A NumPy mirror of this exact scheme is the
+    oracle in tests. Slot ids may be local (single device, ``capacity`` = P)
+    or global (sharded, ``capacity`` = n·P_local) — ids < capacity are real,
+    >= capacity are padding.
+
+    Returns (q_slot i32[B], c_slot i32[B], dist f32[B]), row-indexed;
+    unmatched lanes hold the sentinel ``capacity`` / +inf.
     """
     b, k = vals.shape
     cap = capacity
+    rid = jnp.arange(b, dtype=jnp.int32)
+    big = jnp.int32(1 << 30)
 
-    def body(i, state):
-        row_used, slot_used, out_q, out_c, out_d = state
-        cand_used = slot_used[jnp.clip(idxs, 0, cap - 1)] | (idxs >= cap)
-        self_used = slot_used[jnp.clip(self_slot, 0, cap - 1)] | (self_slot >= cap)
-        dead = row_used[:, None] | cand_used | self_used[:, None]
-        masked = jnp.where(dead, _NEG_INF, vals)
-        flat = masked.reshape(-1)
-        a = jnp.argmax(flat)
-        v = flat[a]
-        ok = v > _NEG_INF
-        r = a // k
-        c = idxs.reshape(-1)[a]
-        sq = self_slot[r]
-        out_q = out_q.at[i].set(jnp.where(ok, sq, cap))
-        out_c = out_c.at[i].set(jnp.where(ok, c, cap))
-        out_d = out_d.at[i].set(jnp.where(ok, -v, jnp.float32(jnp.inf)))
-        row_used = row_used.at[r].set(row_used[r] | ok)
-        slot_used = slot_used.at[jnp.clip(sq, 0, cap - 1)].max(ok)
-        slot_used = slot_used.at[jnp.clip(c, 0, cap - 1)].max(ok)
-        return row_used, slot_used, out_q, out_c, out_d
+    def clip(s):
+        return jnp.clip(s, 0, cap - 1)
+
+    def body(_, state):
+        slot_used, out_q, out_c, out_d = state
+        cand_dead = slot_used[clip(idxs)] | (idxs >= cap)
+        row_dead = slot_used[clip(self_slot)] | (self_slot >= cap)
+        masked = jnp.where(cand_dead | row_dead[:, None], _NEG_INF, vals)
+        bj = jnp.argmax(masked, axis=1)
+        bv = jnp.take_along_axis(masked, bj[:, None], axis=1)[:, 0]
+        bc = jnp.take_along_axis(idxs, bj[:, None], axis=1)[:, 0]
+        prop = bv > _NEG_INF
+        pv = jnp.where(prop, bv, _NEG_INF)
+        # Pass 1: best score claiming each slot (sentinel indices drop).
+        claim_v = jnp.full(cap, _NEG_INF).at[bc].max(pv, mode="drop")
+        claim_v = claim_v.at[self_slot].max(pv, mode="drop")
+        elig = prop & (bv >= claim_v[clip(bc)]) & (bv >= claim_v[clip(self_slot)])
+        # Pass 2: among score-winners, lowest row id takes the slot.
+        er = jnp.where(elig, rid, big)
+        claim_r = jnp.full(cap, big, jnp.int32).at[bc].min(er, mode="drop")
+        claim_r = claim_r.at[self_slot].min(er, mode="drop")
+        win = elig & (claim_r[clip(bc)] == rid) & (claim_r[clip(self_slot)] == rid)
+
+        out_q = jnp.where(win, self_slot, out_q)
+        out_c = jnp.where(win, bc, out_c)
+        out_d = jnp.where(win, -bv, out_d)
+        slot_used = slot_used.at[self_slot].max(win, mode="drop")
+        slot_used = slot_used.at[bc].max(win, mode="drop")
+        return slot_used, out_q, out_c, out_d
 
     init = (
-        jnp.zeros(b, jnp.bool_),
         jnp.zeros(cap, jnp.bool_),
         jnp.full(b, cap, jnp.int32),
         jnp.full(b, cap, jnp.int32),
         jnp.full(b, jnp.inf, jnp.float32),
     )
-    _, _, out_q, out_c, out_d = lax.fori_loop(0, b, body, init)
+    _, out_q, out_c, out_d = lax.fori_loop(0, rounds, body, init)
     return out_q, out_c, out_d
 
 
@@ -112,7 +138,7 @@ class KernelSet:
 
     def __init__(self, *, capacity: int, top_k: int, pool_block: int,
                  glicko2: bool, widen_per_sec: float, max_threshold: float,
-                 evict_bucket: int = 64):
+                 evict_bucket: int = 64, pair_rounds: int = 8):
         if capacity % pool_block != 0:
             # Round the block down to a divisor to keep the scan uniform.
             while capacity % pool_block != 0:
@@ -125,6 +151,7 @@ class KernelSet:
         self.widen_per_sec = widen_per_sec
         self.max_threshold = max_threshold
         self.evict_bucket = evict_bucket
+        self.pair_rounds = pair_rounds
 
         self.admit = jax.jit(self._admit, donate_argnums=0)
         self.evict = jax.jit(self._evict, donate_argnums=0)
@@ -207,7 +234,7 @@ class KernelSet:
     # ---- pairing ----------------------------------------------------------
 
     def greedy_pair(self, vals, idxs, self_slot):
-        return greedy_pair(vals, idxs, self_slot, self.capacity)
+        return greedy_pair(vals, idxs, self_slot, self.capacity, self.pair_rounds)
 
     # ---- the full step ----------------------------------------------------
 
@@ -235,9 +262,11 @@ class KernelSet:
 
 @functools.lru_cache(maxsize=None)
 def kernel_set(capacity: int, top_k: int, pool_block: int, glicko2: bool,
-               widen_per_sec: float, max_threshold: float) -> KernelSet:
+               widen_per_sec: float, max_threshold: float,
+               pair_rounds: int = 8) -> KernelSet:
     """Cached KernelSet per static config (compile once per queue shape)."""
     return KernelSet(
         capacity=capacity, top_k=top_k, pool_block=pool_block, glicko2=glicko2,
         widen_per_sec=widen_per_sec, max_threshold=max_threshold,
+        pair_rounds=pair_rounds,
     )

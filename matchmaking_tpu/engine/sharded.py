@@ -62,7 +62,8 @@ class ShardedKernelSet:
 
     def __init__(self, *, capacity: int, top_k: int, pool_block: int,
                  glicko2: bool, widen_per_sec: float, max_threshold: float,
-                 mesh: Mesh, ring: bool = False, evict_bucket: int = 64):
+                 mesh: Mesh, ring: bool = False, evict_bucket: int = 64,
+                 pair_rounds: int = 8):
         self.mesh = mesh
         self.n_shards = mesh.devices.size
         if capacity % self.n_shards != 0:
@@ -71,6 +72,7 @@ class ShardedKernelSet:
         self.local_capacity = capacity // self.n_shards
         self.ring = ring
         self.evict_bucket = evict_bucket
+        self.pair_rounds = pair_rounds
         # Per-shard compute reuses the single-device kernel internals on the
         # LOCAL slice (capacity = local_capacity).
         self.local = KernelSet(
@@ -199,7 +201,8 @@ class ShardedKernelSet:
 
         # 4. Replicated greedy pairing on global ids (deterministic — every
         #    shard computes the identical pairing, no broadcast needed).
-        out_q, out_c, out_d = greedy_pair(mv, mi, batch["slot"], self.capacity)
+        out_q, out_c, out_d = greedy_pair(mv, mi, batch["slot"], self.capacity,
+                                          self.pair_rounds)
 
         # 5. Each shard evicts its slice of the matched slots.
         for side in (out_q, out_c):
@@ -221,9 +224,9 @@ class ShardedKernelSet:
 def sharded_kernel_set(capacity: int, top_k: int, pool_block: int,
                        glicko2: bool, widen_per_sec: float,
                        max_threshold: float, n_shards: int,
-                       ring: bool) -> ShardedKernelSet:
+                       ring: bool, pair_rounds: int = 8) -> ShardedKernelSet:
     return ShardedKernelSet(
         capacity=capacity, top_k=top_k, pool_block=pool_block, glicko2=glicko2,
         widen_per_sec=widen_per_sec, max_threshold=max_threshold,
-        mesh=pool_mesh(n_shards), ring=ring,
+        mesh=pool_mesh(n_shards), ring=ring, pair_rounds=pair_rounds,
     )

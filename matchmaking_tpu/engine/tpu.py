@@ -22,9 +22,11 @@ the 1v1 paths (configs #1/#2/#4) — the north-star hot path — run on device.
 
 from __future__ import annotations
 
+from dataclasses import dataclass, field
+
 import numpy as np
 
-from typing import Sequence
+from typing import Any, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -35,6 +37,23 @@ from matchmaking_tpu.engine import scoring
 from matchmaking_tpu.engine.interface import Engine, Match, SearchOutcome
 from matchmaking_tpu.engine.kernels import kernel_set
 from matchmaking_tpu.service.contract import SearchRequest, new_match_id
+
+
+@dataclass
+class _Pending:
+    """One dispatched-but-uncollected request window."""
+
+    token: int
+    #: per device-chunk: (requests, (q_slot, c_slot, dist) device handles, now)
+    chunks: list[tuple[list[SearchRequest], tuple[Any, Any, Any], float]] = field(
+        default_factory=list
+    )
+    #: rejections determined at dispatch time (pool_full, party, ...)
+    outcome: SearchOutcome = field(default_factory=SearchOutcome)
+    #: filled by the collector thread: numpy (q_slot, c_slot, dist) per chunk
+    raw: list[tuple[np.ndarray, np.ndarray, np.ndarray]] | None = None
+    #: collector-thread failure, re-raised on the caller thread at finalize
+    error: BaseException | None = None
 
 
 class TpuEngine(Engine):
@@ -55,6 +74,7 @@ class TpuEngine(Engine):
                 max_threshold=queue.max_threshold,
                 n_shards=ec.mesh_pool_axis,
                 ring=ec.ring_merge,
+                pair_rounds=ec.pair_rounds,
             )
             init = PlayerPool.empty_device_arrays(self.kernels.capacity)
             self._dev_pool = self.kernels.place_pool(init)
@@ -66,6 +86,7 @@ class TpuEngine(Engine):
                 glicko2=queue.glicko2,
                 widen_per_sec=queue.widen_per_sec,
                 max_threshold=queue.max_threshold,
+                pair_rounds=ec.pair_rounds,
             )
             self._dev_pool = jax.device_put(
                 {k: jnp.asarray(v)
@@ -85,33 +106,160 @@ class TpuEngine(Engine):
             from matchmaking_tpu.engine.cpu import CpuEngine
 
             self._team_delegate = CpuEngine(cfg, queue)
+        # Pipelined windows: dispatched, not yet finalized (FIFO).
+        # Caller thread dispatches + finalizes (single-writer mirror);
+        # the collector thread ONLY does batched D2H transfers — one
+        # device_get per drain covers every pending window (per-call
+        # transfer latency through the device tunnel would otherwise put an
+        # RTT floor under every window).
+        import queue as _queue
+        import threading
+
+        self._open = 0                      # handed off, not yet finalized
+        self._handoff: _queue.Queue[_Pending | None] = _queue.Queue()
+        self._done: _queue.Queue[_Pending] = _queue.Queue()
+        self._next_token = 0
+        #: First collector-thread failure since the last sync search();
+        #: async callers should check this after collect_ready()/flush().
+        self.device_error: BaseException | None = None
+        self._collector = threading.Thread(
+            target=self._collect_loop, name="tpu-engine-collector", daemon=True
+        )
+        self._collector.start()
 
     # ---- Engine API -------------------------------------------------------
 
     def search(self, requests: Sequence[SearchRequest], now: float) -> SearchOutcome:
         if self._team_delegate is not None:
             return self._team_delegate.search(requests, now)
-
+        assert self._open == 0, (
+            "sync search() with windows in flight — collect with flush() first"
+        )
+        self.search_async(requests, now)
         out = SearchOutcome()
+        # flush() returns the full outcome (dispatch-time rejections
+        # included), so the search_async return value is dropped.
+        for _, o in self.flush():
+            _merge_outcomes(out, o)
+        if self.device_error is not None:
+            err, self.device_error = self.device_error, None
+            raise err
+        return out
+
+    # ---- pipelined window API ---------------------------------------------
+    # Device windows are dispatched without waiting for results; the donated
+    # pool chains them in order on device, so a later window can never see a
+    # player an earlier window matched. The host mirror lags: slots release
+    # at finalize time (they are never reallocated in between — the free
+    # list only shrinks until release). Pipelining hides the host↔device
+    # round trip, which otherwise puts a hard RTT floor under every window.
+
+    def _collect_loop(self) -> None:
+        """Collector thread: batched D2H of every pending window per drain."""
+        while True:
+            item = self._handoff.get()
+            if item is None:
+                return
+            batch = [item]
+            while True:
+                try:
+                    nxt = self._handoff.get_nowait()
+                except Exception:
+                    break
+                if nxt is None:
+                    self._drain(batch)
+                    return
+                batch.append(nxt)
+            self._drain(batch)
+
+    def _drain(self, batch: list[_Pending]) -> None:
+        handles = [c[1] for p in batch for c in p.chunks]
+        try:
+            # ONE device_get for every chunk of every pending window: the
+            # per-call round trip is paid once per drain, not per window.
+            flat = jax.device_get(handles)
+        except BaseException as e:  # surfaces on the caller thread
+            for p in batch:
+                p.error = e
+                self._done.put(p)
+            return
+        i = 0
+        for p in batch:
+            p.raw = [tuple(flat[i + j]) for j in range(len(p.chunks))]
+            i += len(p.chunks)
+            self._done.put(p)
+
+    def search_async(self, requests: Sequence[SearchRequest],
+                     now: float) -> tuple[int, SearchOutcome]:
+        """Dispatch a window without waiting. Returns (token, outcome-so-far)
+        — the outcome carries dispatch-time rejections only; the full
+        outcome arrives via collect_ready()/flush() under the same token."""
+        if self._team_delegate is not None:
+            out = self._team_delegate.search(requests, now)
+            token = self._next_token
+            self._next_token += 1
+            pending = _Pending(token=token, outcome=out)
+            pending.raw = []
+            self._open += 1
+            self._handoff.put(pending)
+            return token, SearchOutcome()
+
+        pending = _Pending(token=self._next_token)
+        self._next_token += 1
         fresh: list[SearchRequest] = []
         seen_ids: set[str] = set()
         for req in requests:
             if req.party_size > 1:
-                out.rejected.append((req, "party_not_supported"))
+                pending.outcome.rejected.append((req, "party_not_supported"))
             elif req.id in self.pool or req.id in seen_ids:
-                continue  # idempotent redelivery
+                continue  # idempotent redelivery (in pool or already in flight)
             else:
                 seen_ids.add(req.id)
                 fresh.append(req)
 
         max_bucket = self.buckets[-1]
         for start in range(0, len(fresh), max_bucket):
-            self._window(fresh[start:start + max_bucket], now, out)
-        return out
+            self._dispatch(fresh[start:start + max_bucket], now, pending)
+        self._open += 1
+        self._handoff.put(pending)
+        return pending.token, SearchOutcome(
+            rejected=list(pending.outcome.rejected))
+
+    def inflight(self) -> int:
+        """Windows dispatched but not yet finalized (caller-thread view)."""
+        return self._open
+
+    def collect_ready(self) -> list[tuple[int, SearchOutcome]]:
+        """Finalize every window whose results have landed (non-blocking)."""
+        done: list[tuple[int, SearchOutcome]] = []
+        while True:
+            try:
+                pending = self._done.get_nowait()
+            except Exception:
+                break
+            self._finalize(pending)
+            done.append((pending.token, pending.outcome))
+        return done
+
+    def flush(self) -> list[tuple[int, SearchOutcome]]:
+        """Block until every in-flight window is collected and finalized."""
+        done: list[tuple[int, SearchOutcome]] = []
+        while self._open > 0:
+            pending = self._done.get()
+            self._finalize(pending)
+            done.append((pending.token, pending.outcome))
+        return done
+
+    def close(self) -> None:
+        """Stop the collector thread (used when the engine is replaced)."""
+        self._handoff.put(None)
 
     def remove(self, player_id: str) -> SearchRequest | None:
         if self._team_delegate is not None:
             return self._team_delegate.remove(player_id)
+        assert self._open == 0, (
+            "remove() with windows in flight — collect with flush() first"
+        )
         slot = self.pool.slot_of(player_id)
         if slot is None:
             return None
@@ -159,7 +307,9 @@ class TpuEngine(Engine):
             self._t0 = now
         return self._t0
 
-    def _window(self, window: list[SearchRequest], now: float, out: SearchOutcome) -> None:
+    def _dispatch(self, window: list[SearchRequest], now: float,
+                  pending: _Pending) -> None:
+        """Admit + launch the device step for one window; no waiting."""
         if not window:
             return
         # Admit only what fits; reject the overflow (the reference has no
@@ -167,7 +317,7 @@ class TpuEngine(Engine):
         free = self.pool.free_count()
         if len(window) > free:
             for req in window[free:]:
-                out.rejected.append((req, "pool_full"))
+                pending.outcome.rejected.append((req, "pool_full"))
             window = window[:free]
             if not window:
                 return
@@ -178,33 +328,64 @@ class TpuEngine(Engine):
         self._dev_pool, q_slot, c_slot, dist = self.kernels.search_step(
             self._dev_pool, _as_jnp(batch), jnp.float32(now - t0)
         )
-        # One small D2H transfer per window: three B-length arrays.
-        q_slot, c_slot, dist = (np.asarray(q_slot), np.asarray(c_slot),
-                                np.asarray(dist))
-        P = self.kernels.capacity
-        matched_ids: set[str] = set()
-        for qs, cs, d in zip(q_slot, c_slot, dist):
-            if qs >= P:
-                continue
-            req_q = self.pool.request_at(int(qs))
-            req_c = self.pool.request_at(int(cs))
-            self.pool.release([int(qs), int(cs)])
-            matched_ids.add(req_q.id)
-            matched_ids.add(req_c.id)
-            # Quality from the pair's effective limits at match time (host
-            # has both requests; same formula as the CPU oracle).
-            qual = scoring.quality(
-                float(d),
-                self.effective_threshold(req_q, now),
-                self.effective_threshold(req_c, now),
-            )
-            out.matches.append(
-                Match(match_id=new_match_id(), teams=((req_q,), (req_c,)),
-                      quality=qual)
-            )
-        for req in window:
-            if req.id not in matched_ids:
-                out.queued.append(req)
+        pending.chunks.append((list(window), (q_slot, c_slot, dist), now))
+
+    def _finalize(self, pending: _Pending) -> None:
+        """Map one window's collected results back to requests. Runs on the
+        caller thread — the mirror stays single-writer.
+
+        A collector-thread failure (device reset/OOM) must NOT raise here:
+        raising mid-collect would drop outcomes already finalized in the
+        same call (their players are released from the mirror — the Match
+        would vanish). Instead the window's requests are reported as queued
+        (true: the mirror still holds them, and recovery restores from the
+        mirror) and the error is parked on ``device_error`` for the caller
+        to check — sync ``search()`` re-raises it so the service's revive
+        path fires."""
+        self._open -= 1
+        if pending.error is not None:
+            self.device_error = pending.error
+            for window, _, _ in pending.chunks:
+                pending.outcome.queued.extend(window)
+            return
+        out = pending.outcome
+        for (window, _, now), (q_slot, c_slot, dist) in zip(
+                pending.chunks, pending.raw or ()):
+            P = self.kernels.capacity
+            matched_ids: set[str] = set()
+            hit = q_slot < P
+            if hit.any():
+                qs_l = q_slot[hit].tolist()
+                cs_l = c_slot[hit].tolist()
+                d_l = dist[hit].tolist()
+                for qs, cs, d in zip(qs_l, cs_l, d_l):
+                    req_q = self.pool.request_at(qs)
+                    req_c = self.pool.request_at(cs)
+                    matched_ids.add(req_q.id)
+                    matched_ids.add(req_c.id)
+                    # Quality from the pair's effective limits at match time
+                    # (host has both requests; same formula as the oracle).
+                    qual = scoring.quality(
+                        d,
+                        self.effective_threshold(req_q, now),
+                        self.effective_threshold(req_c, now),
+                    )
+                    out.matches.append(
+                        Match(match_id=new_match_id(),
+                              teams=((req_q,), (req_c,)), quality=qual)
+                    )
+                self.pool.release(qs_l)
+                self.pool.release(cs_l)
+            for req in window:
+                if req.id not in matched_ids:
+                    out.queued.append(req)
+
+
+def _merge_outcomes(into: SearchOutcome, other: SearchOutcome) -> None:
+    into.matches.extend(other.matches)
+    into.queued.extend(other.queued)
+    into.timed_out.extend(other.timed_out)
+    into.rejected.extend(other.rejected)
 
 
 def _as_jnp(batch: BatchArrays) -> dict[str, jnp.ndarray]:

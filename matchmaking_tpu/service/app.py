@@ -131,6 +131,10 @@ class _QueueRuntime:
         except Exception:
             snapshot = []
             log.exception("mirror unreadable; pool lost (broker will redeliver)")
+        try:
+            self.engine.close()
+        except Exception:
+            log.exception("old engine close failed")
         self.engine = make_engine(self.app.cfg, self.queue_cfg)
         self.engine.restore(snapshot, now)
 

@@ -40,6 +40,7 @@ Response payload:
 
 from __future__ import annotations
 
+import itertools
 import json
 import uuid
 from dataclasses import dataclass, field
@@ -275,5 +276,14 @@ def decode_response(body: bytes | str) -> SearchResponse:
     )
 
 
+_match_id_prefix = uuid.uuid4().hex[:16]
+_match_id_counter = itertools.count(1)
+
+
 def new_match_id() -> str:
-    return uuid.uuid4().hex
+    """Unique match id: random per-process prefix + counter. A full uuid4
+    per match costs ~5 µs — measurable at >10^4 matches/sec — while the
+    prefix keeps ids unique across processes/restarts. ``next()`` on an
+    itertools.count is atomic, so concurrent queue runtimes (each finalizing
+    on its own executor thread) can't mint duplicates."""
+    return f"{_match_id_prefix}{next(_match_id_counter):012x}"

@@ -10,30 +10,50 @@ from matchmaking_tpu.engine import scoring
 from matchmaking_tpu.engine.kernels import KernelSet
 
 
-def np_greedy_pair(vals, idxs, self_slot, P):
-    """NumPy mirror of KernelSet.greedy_pair — the pairing oracle."""
-    vals = vals.copy().astype(np.float64)
+def np_greedy_pair(vals, idxs, self_slot, P, rounds=8):
+    """NumPy mirror of KernelSet.greedy_pair — the fixed-round proposal
+    matching oracle (same two-stage slot-claim resolution as the kernel:
+    value max, then row-id min among value-winners)."""
+    vals = np.asarray(vals, np.float32)
     b, k = vals.shape
-    row_used = np.zeros(b, bool)
-    slot_used = np.zeros(P + 1, bool)
-    pairs = []
-    for _ in range(b):
-        masked = vals.copy()
+    slot_used = np.zeros(P, bool)
+    out_q = np.full(b, P, np.int64)
+    out_c = np.full(b, P, np.int64)
+    out_d = np.full(b, np.inf, np.float64)
+    for _ in range(rounds):
+        props: dict[int, tuple[float, int]] = {}
         for r in range(b):
+            sq = int(self_slot[r])
+            if sq >= P or slot_used[sq]:
+                continue
+            best_v, best_c = -np.inf, None
             for j in range(k):
-                if (row_used[r] or idxs[r, j] >= P or slot_used[idxs[r, j]]
-                        or self_slot[r] >= P or slot_used[self_slot[r]]):
-                    masked[r, j] = -np.inf
-        a = int(np.argmax(masked))
-        r, j = divmod(a, k)
-        if masked[r, j] == -np.inf:
+                c = int(idxs[r, j])
+                if c >= P or slot_used[c]:
+                    continue
+                if vals[r, j] > best_v:
+                    best_v, best_c = float(vals[r, j]), c
+            if best_c is not None and best_v > -np.inf:
+                props[r] = (best_v, best_c)
+        if not props:
             break
-        c = int(idxs[r, j])
-        pairs.append((int(self_slot[r]), c, -float(masked[r, j])))
-        row_used[r] = True
-        slot_used[self_slot[r]] = True
-        slot_used[c] = True
-    return pairs
+        claim_v: dict[int, float] = {}
+        for r, (v, c) in props.items():
+            for s in (int(self_slot[r]), c):
+                claim_v[s] = max(claim_v.get(s, -np.inf), v)
+        elig = [r for r, (v, c) in props.items()
+                if v >= claim_v[int(self_slot[r])] and v >= claim_v[c]]
+        claim_r: dict[int, int] = {}
+        for r in elig:
+            for s in (int(self_slot[r]), props[r][1]):
+                claim_r[s] = min(claim_r.get(s, 1 << 30), r)
+        for r in elig:
+            v, c = props[r]
+            if claim_r[int(self_slot[r])] == r and claim_r[c] == r:
+                out_q[r], out_c[r], out_d[r] = int(self_slot[r]), c, -v
+                slot_used[int(self_slot[r])] = True
+                slot_used[c] = True
+    return out_q, out_c, out_d
 
 
 def make_kernels(capacity=256, top_k=4, pool_block=64, **kw):
@@ -207,13 +227,13 @@ def test_greedy_pair_matches_numpy_oracle(rng):
         vals[kill] = -np.inf
         q, c, d = ks.greedy_pair(jnp.asarray(vals), jnp.asarray(idxs),
                                  jnp.asarray(self_slot))
-        got = [(int(a), int(bb), float(dd))
-               for a, bb, dd in zip(np.asarray(q), np.asarray(c), np.asarray(d))
-               if a < P]
-        expect = np_greedy_pair(vals, idxs, self_slot, P)
-        assert [(a, b2) for a, b2, _ in got] == [(a, b2) for a, b2, _ in expect]
-        for (_, _, dg), (_, _, de) in zip(got, expect):
-            assert dg == pytest.approx(de, rel=1e-5)
+        q, c, d = np.asarray(q), np.asarray(c), np.asarray(d)
+        eq, ec, ed = np_greedy_pair(vals, idxs, self_slot, P)
+        np.testing.assert_array_equal(q, eq)
+        np.testing.assert_array_equal(c, ec)
+        matched = q < P
+        np.testing.assert_allclose(d[matched], ed[matched], rtol=1e-5)
+        assert np.isinf(d[~matched]).all()
 
 
 def test_admit_and_evict_roundtrip():
