@@ -49,6 +49,21 @@ def make_requests(rng: np.random.Generator, n: int, start_id: int,
     ]
 
 
+def make_columns(rng: np.random.Generator, n: int, start_id: int, now: float):
+    """Columnar window (the fast path the service batcher also produces)."""
+    from matchmaking_tpu.service.contract import RequestColumns
+
+    return RequestColumns(
+        ids=np.char.add("p", np.arange(start_id, start_id + n).astype(str)).astype(object),
+        rating=rng.normal(1500.0, 300.0, size=n).astype(np.float32),
+        rd=np.zeros(n, np.float32),
+        region=np.zeros(n, np.int32),
+        mode=np.zeros(n, np.int32),
+        threshold=np.full(n, np.nan, np.float32),
+        enqueued_at=np.full(n, now, np.float64),
+    )
+
+
 def run_engine(engine, rng: np.random.Generator, *, pool_target: int,
                window: int, warmup: int, measured: int, label: str):
     """Stream windows through ``engine.search`` at a sustained pool size.
@@ -111,8 +126,8 @@ def run_engine_pipelined(engine, rng: np.random.Generator, *, pool_target: int,
         nonlocal next_id
         deficit = pool_target - engine.pool_size()
         while deficit > 0:
-            chunk = min(deficit, 4096)
-            engine.restore(make_requests(rng, chunk, next_id, wall()), wall())
+            chunk = min(deficit, 8192)
+            engine.restore_columns(make_columns(rng, chunk, next_id, wall()), wall())
             next_id += chunk
             deficit -= chunk
 
@@ -131,15 +146,15 @@ def run_engine_pipelined(engine, rng: np.random.Generator, *, pool_target: int,
         lat = time.perf_counter() - submit_t.pop(token)
         if timed.pop(token):
             latencies.append(lat)
-            total_matches += len(out.matches)
+            total_matches += out.n_matches
             t_last = time.perf_counter()
 
     for i in range(warmup + measured):
-        reqs = make_requests(rng, window, next_id, wall())
+        cols = make_columns(rng, window, next_id, wall())
         next_id += window
         if i == warmup:
             t_start = time.perf_counter()
-        tok, _ = engine.search_async(reqs, wall())
+        tok = engine.search_columns_async(cols, wall())
         submit_t[tok] = time.perf_counter()
         timed[tok] = i >= warmup
         for tok2, out in engine.collect_ready():

@@ -84,6 +84,10 @@ class EngineConfig:
     #: they need ≥``pair_rounds`` collisions on their top-k list) stay in
     #: the pool for the next window.
     pair_rounds: int = 8
+    #: Team queues (device path): max matches extracted per step and
+    #: parallel-greedy window-selection rounds (engine/teams.py).
+    team_max_matches: int = 1024
+    team_rounds: int = 16
 
 
 @dataclass(frozen=True)

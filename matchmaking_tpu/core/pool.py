@@ -13,10 +13,15 @@ Design (SURVEY.md §7 step 1):
 - **Single-writer slot allocator on the host** (SURVEY.md §5 "Race
   detection"): all admissions/evictions flow through one `PlayerPool` object;
   the device arrays are updated only by the jitted step functions it calls.
-- **Authoritative host mirror.** The host keeps every waiting request (slot →
-  SearchRequest). Device state is a pure function of the mirror, which makes
-  the mirror the checkpoint: on sidecar death, re-admit the mirror
-  (SURVEY.md §5 "Checkpoint/resume").
+- **Authoritative host mirror, columnar.** The host keeps every waiting
+  request as parallel numpy columns (slot-indexed). Device state is a pure
+  function of the mirror, which makes the mirror the checkpoint: on sidecar
+  death, re-admit the mirror (SURVEY.md §5 "Checkpoint/resume").
+  `SearchRequest` objects are materialized lazily (only for matched slots
+  that need response objects) — the object layer costs ~10-20 µs/request,
+  which would dwarf the ~1 ms device kernel at 10^5 requests/sec.
+- **Vectorized free list**: a numpy stack with a head cursor; allocating a
+  window is one slice, releasing is one slice store — no per-request Python.
 - **String interning.** Wire-level region/game-mode strings are interned to
   int32 codes (0 = wildcard) so filter masks are integer compares on device.
 """
@@ -24,11 +29,11 @@ Design (SURVEY.md §7 step 1):
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Mapping, Sequence
+from typing import Sequence
 
 import numpy as np
 
-from matchmaking_tpu.service.contract import ANY, SearchRequest
+from matchmaking_tpu.service.contract import ANY, RequestColumns, SearchRequest
 
 # Field definitions for the device SoA. Kept in one place so the kernels, the
 # pool, and the sharded engine agree on array layout.
@@ -91,19 +96,32 @@ class PlayerPool:
     def __init__(self, capacity: int, default_threshold: float):
         self.capacity = int(capacity)
         self.default_threshold = float(default_threshold)
-        self._free = list(range(self.capacity - 1, -1, -1))  # pop() → slot 0 first
-        self._requests: dict[int, SearchRequest] = {}        # slot → request
+        # Vectorized free list: pop from the END (head), so initial pops
+        # yield slot 0, 1, 2, ... (kept for slot-order determinism in tests).
+        self._free = np.arange(self.capacity - 1, -1, -1, dtype=np.int32)
+        self._head = self.capacity  # number of free slots
         self._slot_of: dict[str, int] = {}                   # player id → slot
+        # Columnar mirror (slot-indexed).
+        self.m_id = np.full(self.capacity, None, dtype=object)
+        self.m_rating = np.zeros(self.capacity, np.float32)
+        self.m_rd = np.zeros(self.capacity, np.float32)
+        self.m_region = np.zeros(self.capacity, np.int32)
+        self.m_mode = np.zeros(self.capacity, np.int32)
+        self.m_threshold = np.zeros(self.capacity, np.float32)  # resolved (no NaN)
+        self.m_thr_override = np.zeros(self.capacity, np.bool_)
+        self.m_enqueued = np.zeros(self.capacity, np.float64)
+        self.m_reply = np.full(self.capacity, "", dtype=object)
+        self.m_corr = np.full(self.capacity, "", dtype=object)
         self.regions = Interner()
         self.modes = Interner()
 
     # ---- introspection ----------------------------------------------------
 
     def __len__(self) -> int:
-        return len(self._requests)
+        return len(self._slot_of)
 
     def free_count(self) -> int:
-        return len(self._free)
+        return self._head
 
     def __contains__(self, player_id: str) -> bool:
         return player_id in self._slot_of
@@ -112,56 +130,114 @@ class PlayerPool:
         return self._slot_of.get(player_id)
 
     def request_at(self, slot: int) -> SearchRequest:
-        return self._requests[slot]
+        """Materialize the SearchRequest for one occupied slot (lazy — the
+        mirror is columnar; objects are built only where needed)."""
+        return SearchRequest(
+            id=self.m_id[slot],
+            rating=float(self.m_rating[slot]),
+            rating_deviation=float(self.m_rd[slot]),
+            game_mode=self.modes.name(int(self.m_mode[slot])),
+            region=self.regions.name(int(self.m_region[slot])),
+            rating_threshold=(float(self.m_threshold[slot])
+                              if self.m_thr_override[slot] else None),
+            reply_to=self.m_reply[slot],
+            correlation_id=self.m_corr[slot],
+            enqueued_at=float(self.m_enqueued[slot]),
+        )
 
     def waiting(self) -> list[SearchRequest]:
         """Checkpoint payload: every waiting request (insertion-time data)."""
-        return list(self._requests.values())
+        return [self.request_at(s) for s in self._slot_of.values()]
+
+    def waiting_slots(self) -> np.ndarray:
+        return np.fromiter(self._slot_of.values(), np.int32, len(self._slot_of))
 
     # ---- mutation (single writer) -----------------------------------------
 
-    def allocate(self, requests: Sequence[SearchRequest]) -> list[int]:
-        """Assign slots to new requests and record them in the mirror."""
-        if len(requests) > len(self._free):
+    def allocate_columns(self, cols: RequestColumns) -> np.ndarray:
+        """Assign slots to a columnar window and record it in the mirror.
+        All stores are vectorized; the id checks and the id→slot dict update
+        are the only per-row work (~50 ns/id).
+
+        Ids must be unique within the window and absent from the pool
+        (engines dedupe before allocating); violations raise BEFORE any
+        mutation, so the pool state is never half-updated."""
+        n = len(cols)
+        if n > self._head:
             raise PoolFullError(
-                f"pool exhausted: {len(requests)} requested, {len(self._free)} free "
+                f"pool exhausted: {n} requested, {self._head} free "
                 f"(capacity {self.capacity})"
             )
-        slots = []
+        ids = cols.ids.tolist()
+        if len(set(ids)) != n:
+            raise ValueError("duplicate player id in window")
+        if any(pid in self._slot_of for pid in ids):
+            raise ValueError("player already in pool")
+        slots = self._free[self._head - n:self._head][::-1].copy()
+        self._head -= n
+        self.m_id[slots] = cols.ids
+        self.m_rating[slots] = cols.rating
+        self.m_rd[slots] = cols.rd
+        self.m_region[slots] = cols.region
+        self.m_mode[slots] = cols.mode
+        override = ~np.isnan(cols.threshold)
+        self.m_thr_override[slots] = override
+        self.m_threshold[slots] = np.where(override, cols.threshold,
+                                           self.default_threshold)
+        self.m_enqueued[slots] = cols.enqueued_at
+        # Missing transport columns must CLEAR the slots (a recycled slot
+        # would otherwise leak the previous occupant's reply queue and route
+        # a response to an unrelated player).
+        self.m_reply[slots] = "" if cols.reply_to is None else cols.reply_to
+        self.m_corr[slots] = ("" if cols.correlation_id is None
+                              else cols.correlation_id)
+        self._slot_of.update(zip(ids, slots.tolist()))
+        return slots
+
+    def allocate(self, requests: Sequence[SearchRequest]) -> list[int]:
+        """Object-path compatibility wrapper around allocate_columns."""
         for req in requests:
             if req.id in self._slot_of:
                 raise ValueError(f"player {req.id!r} already in pool")
-            slot = self._free.pop()
-            self._requests[slot] = req
-            self._slot_of[req.id] = slot
-            slots.append(slot)
-        return slots
+        cols = RequestColumns.from_requests(
+            requests, self.regions.code, self.modes.code)
+        return self.allocate_columns(cols).tolist()
 
-    def release(self, slots: Sequence[int]) -> None:
+    def release(self, slots: Sequence[int] | np.ndarray) -> None:
         """Evict slots (matched / cancelled / timed out) from the mirror."""
-        for slot in slots:
-            req = self._requests.pop(slot, None)
-            if req is None:
-                continue
-            del self._slot_of[req.id]
-            self._free.append(slot)
+        arr = np.unique(np.asarray(slots, dtype=np.int32))
+        if arr.size == 0:
+            return
+        # np.unique guards intra-call duplicate slots; the occupancy mask
+        # guards cross-call double-release (idempotent like a dict mirror).
+        ids = self.m_id[arr]
+        occupied = np.fromiter((i is not None for i in ids), bool, arr.size)
+        arr = arr[occupied]
+        if arr.size == 0:
+            return
+        for pid in ids[occupied].tolist():
+            del self._slot_of[pid]
+        self.m_id[arr] = None
+        self._free[self._head:self._head + arr.size] = arr
+        self._head += arr.size
 
     # ---- array building ---------------------------------------------------
 
     def effective_base_threshold(self, req: SearchRequest) -> float:
         return req.rating_threshold if req.rating_threshold is not None else self.default_threshold
 
-    def batch_arrays(self, requests: Sequence[SearchRequest], slots: Sequence[int],
-                     bucket: int, t_offset: float = 0.0) -> BatchArrays:
-        """Pack a window into padded arrays of size ``bucket``. Padding lanes
-        get slot = capacity (the scatter sentinel the kernels drop).
+    def batch_arrays_cols(self, cols: RequestColumns, slots: np.ndarray,
+                          bucket: int, t_offset: float = 0.0) -> BatchArrays:
+        """Pack a columnar window into padded arrays of size ``bucket``.
+        Padding lanes get slot = capacity (the sentinel the kernels treat as
+        never-matching).
 
         ``t_offset`` rebases wall-clock timestamps: device times are float32,
         whose spacing at epoch magnitude (~1.7e9 s) is 128 s — far too coarse
         for threshold widening. The engine subtracts its start time so device
         times stay small (sub-millisecond spacing for a week-long process).
         """
-        b = len(requests)
+        b = len(cols)
         assert b <= bucket
         arr = BatchArrays(
             slot=np.full(bucket, self.capacity, np.int32),
@@ -174,27 +250,27 @@ class PlayerPool:
             valid=np.zeros(bucket, np.bool_),
         )
         if b:
-            # Bulk column assignment (one numpy store per field) — a
-            # per-request elementwise loop costs several ms per 1k window.
-            rc, mc = self.regions.code, self.modes.code
-            dt = self.default_threshold
             arr.slot[:b] = slots
-            arr.rating[:b] = [r.rating for r in requests]
-            arr.rd[:b] = [r.rating_deviation for r in requests]
-            arr.region[:b] = [rc(r.region) for r in requests]
-            arr.mode[:b] = [mc(r.game_mode) for r in requests]
-            arr.threshold[:b] = [
-                dt if r.rating_threshold is None else r.rating_threshold
-                for r in requests
-            ]
+            arr.rating[:b] = cols.rating
+            arr.rd[:b] = cols.rd
+            arr.region[:b] = cols.region
+            arr.mode[:b] = cols.mode
+            thr = np.where(np.isnan(cols.threshold), self.default_threshold,
+                           cols.threshold)
+            arr.threshold[:b] = thr
             # Rebase in float64 BEFORE the float32 store: epoch-magnitude
             # seconds only carry 128 s resolution in float32.
-            arr.enqueue_t[:b] = (
-                np.asarray([r.enqueued_at for r in requests], np.float64)
-                - t_offset
-            )
+            arr.enqueue_t[:b] = cols.enqueued_at - t_offset
             arr.valid[:b] = True
         return arr
+
+    def batch_arrays(self, requests: Sequence[SearchRequest], slots: Sequence[int],
+                     bucket: int, t_offset: float = 0.0) -> BatchArrays:
+        """Object-path compatibility wrapper around batch_arrays_cols."""
+        cols = RequestColumns.from_requests(
+            requests, self.regions.code, self.modes.code)
+        return self.batch_arrays_cols(cols, np.asarray(slots, np.int32),
+                                      bucket, t_offset)
 
     @staticmethod
     def empty_device_arrays(capacity: int) -> dict[str, np.ndarray]:

@@ -194,10 +194,16 @@ class CpuEngine(Engine):
         n_win = len(sorted_ratings) - need + 1
         spreads = sorted_ratings[need - 1:] - sorted_ratings[:n_win]
         win_thr = np.array([sorted_thrs[w:w + need].min() for w in range(n_win)])
+        # The BASELINE config-#3 team-sum constraint (|sum_A - sum_B| ≤
+        # threshold) is satisfied BY CONSTRUCTION: the snake split's signed
+        # sum telescopes into an alternating series of disjoint consecutive
+        # gaps, so |sum_A - sum_B| ≤ window spread ≤ win_thr always (pinned
+        # by tests/test_teams_device.py; scoring.snake_signs documents the
+        # pattern). No separate validity term is needed.
         valid = spreads <= win_thr
         if not valid.any():
             return None
-        # Tightest valid window wins.
+        # Tightest valid window wins (ties: lowest start index).
         w = int(np.argmin(np.where(valid, spreads, np.inf)))
         spread = float(spreads[w])
         thr = float(win_thr[w])
@@ -207,6 +213,4 @@ class CpuEngine(Engine):
         team_a, team_b = [], []
         for j, p in enumerate(players):
             (team_a if (j % 4 in (0, 3)) else team_b).append(p)
-        if abs(sum(p.rating for p in team_a) - sum(p.rating for p in team_b)) > thr:
-            return None
         return (tuple(team_a), tuple(team_b)), spread, thr

@@ -55,6 +55,30 @@ def quality(dist, thr_a, thr_b):
     return max(0.0, 1.0 - dist / limit)
 
 
+def snake_signs(need: int) -> list[float]:
+    """Sign of each ASCENDING-sorted window position in the team-sum
+    difference (team A minus team B) under the snake split used by team
+    queues (BASELINE config #3).
+
+    The split assigns players in DESCENDING rating order: position j goes to
+    team A iff j % 4 ∈ {0, 3} (A B B A A B B A ...). Ascending position i
+    corresponds to descending position j = need-1-i. The sum difference
+    depends only on the value multiset at each signed position, so
+    equal-rating tie order cannot change it — the CPU oracle and the device
+    kernel stay consistent however ties sort.
+
+    Why the config-#3 team-sum constraint holds by construction: over the
+    descending window the signed sum telescopes into an alternating series
+    of DISJOINT consecutive gaps, (r0−r1) − (r2−r3) + (r4−r5) − …, each
+    gap ≥ 0 and their total ≤ the window spread; an alternating series of
+    non-negative terms is bounded by the sum of its positive terms, so
+    |sum_A − sum_B| ≤ spread ≤ every member's threshold whenever the window
+    is valid. Engines therefore enforce only the spread check; tests pin
+    the balance property on formed matches.
+    """
+    return [1.0 if (need - 1 - i) % 4 in (0, 3) else -1.0 for i in range(need)]
+
+
 def region_mode_compatible(region_a: str, mode_a: str, region_b: str, mode_b: str,
                            *, any_token: str = "*") -> bool:
     """Hard filters (BASELINE config #2): wildcard-or-equal on both axes."""

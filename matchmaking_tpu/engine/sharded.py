@@ -204,12 +204,11 @@ class ShardedKernelSet:
         out_q, out_c, out_d = greedy_pair(mv, mi, batch["slot"], self.capacity,
                                           self.pair_rounds)
 
-        # 5. Each shard evicts its slice of the matched slots.
-        for side in (out_q, out_c):
-            local = side - offset
-            mine = (local >= 0) & (local < self.local_capacity)
-            safe = jnp.where(mine, local, self.local_capacity)
-            pool = dict(pool, active=pool["active"].at[safe].set(False, mode="drop"))
+        # 5. Each shard evicts its slice of the matched slots (compare-masked
+        #    via the local kernel's scatter-free eviction).
+        matched = jnp.concatenate([out_q, out_c]) - offset
+        mine = (matched >= 0) & (matched < self.local_capacity)
+        pool = lk._evict(pool, jnp.where(mine, matched, self.local_capacity))
         return pool, out_q, out_c, out_d
 
     # ---- placement --------------------------------------------------------

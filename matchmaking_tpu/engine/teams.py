@@ -1,0 +1,201 @@
+"""Device-side 5v5 team-balanced matching (BASELINE config #3).
+
+The oracle semantics (``engine/cpu.py:_try_team_window``): among mutually
+region/mode-compatible waiting players, the contiguous rating-sorted window of
+``2 * team_size`` players with minimal rating spread forms a match iff the
+spread fits every member's effective threshold (min over the window). The
+BASELINE config-#3 team-sum constraint (|sum_A − sum_B| ≤ threshold) is then
+satisfied by construction: the snake split (A B B A A B B A ... by descending
+rating) bounds the team-sum difference by the window spread
+(scoring.snake_signs has the proof sketch; tests pin it).
+
+The reference triggers one sequential scan per request (SURVEY.md §3 Entry 2);
+the CPU oracle mirrors that one-match-per-arrival behavior. This module is
+the TPU-native batch version: ONE jitted step admits a request window and
+forms EVERY available match in the pool at once:
+
+    admit (scatter) → stable two-pass argsort by (group, rating)
+    → windowed spread / min-threshold via static shifts
+    → parallel greedy selection of disjoint tightest windows
+    → top-k extraction of winners → evict matched (scatter)
+
+TPU-first notes:
+
+- All shapes static: window width ``need = 2*team_size`` ≤ ~12, so every
+  sliding-window reduction is ``need`` shifted element-wise ops — VPU-friendly,
+  no gather loops, no data-dependent control flow.
+- Sorting is ``jnp.argsort`` (XLA's bitonic/radix sort on TPU) — two stable
+  passes give a lexicographic (group, rating) order without 64-bit keys
+  (x64 is off on TPU).
+- Window selection is the same fixed-round parallel-greedy scheme as
+  ``kernels.greedy_pair``: a window wins a round iff it is the (spread, index)
+  lexicographic minimum among the windows overlapping it; winners knock out
+  their neighborhoods; ``rounds`` rounds retain everything a sequential
+  tightest-first sweep would keep, up to pathological chains (which stay in
+  the pool for the next step — same leftover semantics as the 1v1 kernel).
+
+Grouping semantics (deviation, documented): the device path groups by EXACT
+(region, mode) code — wildcard players (code 0) form their own group and only
+match each other. The oracle expands wildcards into every concrete group
+(non-transitive pairwise compatibility); that expansion is data-dependent and
+host-shaped. Queues mixing wildcard and concrete players on team matching
+should use ``backend: "cpu"``; oracle-equivalence tests run on
+uniform-group pools where the two semantics coincide.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from matchmaking_tpu.engine.kernels import KernelSet, _effective_threshold
+
+_BIG_I32 = jnp.int32(1 << 30)
+_INF = jnp.float32(jnp.inf)
+
+
+class TeamKernelSet:
+    """Compiled team-match step for one (pool geometry × queue config).
+
+    Call surface mirrors ``KernelSet`` (admit / evict / search_step over the
+    same pool dict + padded batch dict); ``search_step`` returns
+    ``(pool', match_slots i32[M, need], spread f32[M], limit f32[M])`` where
+    rows with ``match_slots[m, 0] == capacity`` are padding.
+    """
+
+    def __init__(self, *, capacity: int, team_size: int,
+                 widen_per_sec: float, max_threshold: float,
+                 max_matches: int = 1024, rounds: int = 16,
+                 evict_bucket: int = 64):
+        assert team_size > 1, "team kernel needs team_size > 1"
+        self.capacity = capacity
+        self.team_size = team_size
+        self.need = 2 * team_size
+        self.widen_per_sec = widen_per_sec
+        self.max_threshold = max_threshold
+        self.max_matches = min(max_matches, max(1, capacity // self.need))
+        self.rounds = rounds
+        self.evict_bucket = evict_bucket
+        # Reuse the 1v1 kernel's admit/evict scatters (same pool layout).
+        self._base = KernelSet(
+            capacity=capacity, top_k=1, pool_block=min(256, capacity),
+            glicko2=False, widen_per_sec=widen_per_sec,
+            max_threshold=max_threshold, evict_bucket=evict_bucket,
+        )
+        self.admit = self._base.admit
+        self.evict = self._base.evict
+        self.search_step = jax.jit(self._search_step, donate_argnums=0)
+
+    # ---- internals --------------------------------------------------------
+
+    def _sorted_order(self, pool: dict[str, Any]):
+        """Stable lexicographic order by (group, rating); inactive last."""
+        group = pool["region"] * jnp.int32(1 << 15) + pool["mode"]
+        group = jnp.where(pool["active"], group, _BIG_I32)
+        # Two stable passes: sort by rating, then by group — net effect is
+        # (group asc, rating asc), matching the oracle's per-group rating
+        # sort (np.argsort stable).
+        p1 = jnp.argsort(pool["rating"], stable=True)
+        p2 = jnp.argsort(group[p1], stable=True)
+        return p1[p2], group
+
+    def _windows(self, pool: dict[str, Any], order, group, now):
+        """Validity + stats for every window start w ∈ [0, P - need]."""
+        need = self.need
+        n_win = self.capacity - need + 1
+        r_s = pool["rating"][order]
+        g_s = group[order]
+        a_s = pool["active"][order]
+        thr_s = _effective_threshold(
+            pool["threshold"][order], pool["enqueue_t"][order], now,
+            self.widen_per_sec, self.max_threshold,
+        )
+
+        # Windowed reductions as `need` static shifts (need ≤ ~12). The
+        # config-#3 team-sum constraint needs no term here: the snake
+        # split's |sum_A - sum_B| telescopes to ≤ spread ≤ win_thr by
+        # construction (see cpu.py:_try_team_window and scoring.snake_signs).
+        win_thr = thr_s[:n_win]
+        all_active = a_s[:n_win]
+        for i in range(1, need):
+            win_thr = jnp.minimum(
+                win_thr, jax.lax.dynamic_slice_in_dim(thr_s, i, n_win))
+            all_active = all_active & jax.lax.dynamic_slice_in_dim(a_s, i, n_win)
+        spread = jax.lax.dynamic_slice_in_dim(r_s, need - 1, n_win) - r_s[:n_win]
+        same_group = g_s[:n_win] == jax.lax.dynamic_slice_in_dim(g_s, need - 1, n_win)
+        valid = (
+            all_active & same_group & (g_s[:n_win] < _BIG_I32)
+            & (spread <= win_thr)
+        )
+        return valid, spread, win_thr
+
+    def _neigh_reduce(self, x, *, op, pad):
+        """Reduce each position over its overlap neighborhood |Δw| < need
+        (2·need−1 static shifts — windows overlap iff starts differ by <need)."""
+        n = x.shape[0]
+        out = x
+        for d in range(1, self.need):
+            right = jnp.concatenate([x[d:], jnp.full((d,), pad, x.dtype)])
+            left = jnp.concatenate([jnp.full((d,), pad, x.dtype), x[:-d]])
+            out = op(op(out, right), left)
+        return out
+
+    def _select_windows(self, valid, spread):
+        """Fixed-round parallel greedy: disjoint windows, tightest-first."""
+        n_win = valid.shape[0]
+        idx = jnp.arange(n_win, dtype=jnp.int32)
+
+        def body(_, state):
+            valid, won = state
+            sp = jnp.where(valid, spread, _INF)
+            neigh_min = self._neigh_reduce(sp, op=jnp.minimum, pad=_INF)
+            cand = valid & (sp <= neigh_min)
+            ci = jnp.where(cand, idx, _BIG_I32)
+            neigh_imin = self._neigh_reduce(ci, op=jnp.minimum, pad=_BIG_I32)
+            winner = cand & (ci == neigh_imin)
+            # Knock out every window overlapping a winner (winner included).
+            hit = self._neigh_reduce(winner, op=jnp.logical_or, pad=False)
+            return valid & ~hit, won | winner
+
+        valid, won = jax.lax.fori_loop(
+            0, self.rounds, body, (valid, jnp.zeros_like(valid)))
+        return won
+
+    def _search_step(self, pool: dict[str, Any], batch: dict[str, Any], now):
+        """One team window step. Returns (pool', slots i32[M,need],
+        spread f32[M], limit f32[M]); padding rows carry slot sentinel P."""
+        pool = self._base._admit(pool, batch)
+        order, group = self._sorted_order(pool)
+        valid, spread, win_thr = self._windows(pool, order, group, now)
+        won = self._select_windows(valid, spread)
+
+        # Extract up to M winner window starts (order within M irrelevant —
+        # winners are disjoint; host sorts by slot for determinism).
+        score = jnp.where(won, -jnp.arange(won.shape[0], dtype=jnp.int32), -_BIG_I32)
+        topv, topi = jax.lax.top_k(score, self.max_matches)
+        is_match = topv > -_BIG_I32
+        w = jnp.where(is_match, topi, 0)
+
+        # Window members: sorted positions w..w+need-1 → original slots.
+        member_pos = w[:, None] + jnp.arange(self.need, dtype=jnp.int32)[None, :]
+        slots = order[member_pos]
+        slots = jnp.where(is_match[:, None], slots, self.capacity)
+
+        # Compare-masked eviction (scatter-free — see kernels.py header).
+        pool = self._base._evict(pool, slots.reshape(-1))
+        out_spread = jnp.where(is_match, spread[w], _INF)
+        out_thr = jnp.where(is_match, win_thr[w], 0.0)
+        return pool, slots, out_spread, out_thr
+
+
+@functools.lru_cache(maxsize=None)
+def team_kernel_set(capacity: int, team_size: int, widen_per_sec: float,
+                    max_threshold: float, max_matches: int = 1024,
+                    rounds: int = 16) -> TeamKernelSet:
+    return TeamKernelSet(
+        capacity=capacity, team_size=team_size, widen_per_sec=widen_per_sec,
+        max_threshold=max_threshold, max_matches=max_matches, rounds=rounds,
+    )

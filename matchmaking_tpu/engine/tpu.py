@@ -15,9 +15,11 @@ Host/device split (SURVEY.md §7):
 - Device: admission scatter, blockwise score+mask, streaming top-k, greedy
   conflict-free pairing, eviction scatter — one fused jitted step.
 
-Team/role queues (BASELINE configs #3/#5) currently run the host-side
-algorithms over the authoritative mirror (same semantics as the CPU oracle);
-the 1v1 paths (configs #1/#2/#4) — the north-star hot path — run on device.
+Team-balanced queues (BASELINE config #3) run on device via the batch
+team-window kernel (``engine/teams.py``); role/party queues (config #5) and
+multi-chip team queues run the host-side oracle algorithms over the
+authoritative mirror. The 1v1 paths (configs #1/#2/#4) — the north-star hot
+path — run on device single- or multi-chip.
 """
 
 from __future__ import annotations
@@ -34,9 +36,19 @@ import jax.numpy as jnp
 from matchmaking_tpu.config import Config, QueueConfig
 from matchmaking_tpu.core.pool import BatchArrays, PlayerPool
 from matchmaking_tpu.engine import scoring
-from matchmaking_tpu.engine.interface import Engine, Match, SearchOutcome
+from matchmaking_tpu.engine.interface import (
+    ColumnarOutcome,
+    Engine,
+    Match,
+    SearchOutcome,
+    empty_columnar_outcome,
+)
 from matchmaking_tpu.engine.kernels import kernel_set
-from matchmaking_tpu.service.contract import SearchRequest, new_match_id
+from matchmaking_tpu.service.contract import (
+    RequestColumns,
+    SearchRequest,
+    new_match_id,
+)
 
 
 @dataclass
@@ -44,12 +56,16 @@ class _Pending:
     """One dispatched-but-uncollected request window."""
 
     token: int
-    #: per device-chunk: (requests, (q_slot, c_slot, dist) device handles, now)
-    chunks: list[tuple[list[SearchRequest], tuple[Any, Any, Any], float]] = field(
+    #: per device-chunk: (payload, (q_slot, c_slot, dist) device handles,
+    #: now). payload is list[SearchRequest] (object path) or
+    #: (RequestColumns, slots ndarray) (columnar path).
+    chunks: list[tuple[Any, tuple[Any, Any, Any], float]] = field(
         default_factory=list
     )
     #: rejections determined at dispatch time (pool_full, party, ...)
     outcome: SearchOutcome = field(default_factory=SearchOutcome)
+    #: columnar-path outcome (set instead of ``outcome`` when columnar)
+    columnar: "ColumnarOutcome | None" = None
     #: filled by the collector thread: numpy (q_slot, c_slot, dist) per chunk
     raw: list[tuple[np.ndarray, np.ndarray, np.ndarray]] | None = None
     #: collector-thread failure, re-raised on the caller thread at finalize
@@ -60,7 +76,27 @@ class TpuEngine(Engine):
     def __init__(self, cfg: Config, queue: QueueConfig):
         super().__init__(cfg, queue)
         ec = cfg.engine
-        if ec.mesh_pool_axis > 1:
+        # Role/party queues (config #5) and multi-chip team queues run the
+        # host oracle over the mirror; plain team queues (config #3) and all
+        # 1v1 configs run on device.
+        self._team_device = queue.team_size > 1 and not queue.role_slots \
+            and ec.mesh_pool_axis <= 1
+        if self._team_device:
+            from matchmaking_tpu.engine.teams import team_kernel_set
+
+            self.kernels = team_kernel_set(
+                capacity=ec.pool_capacity,
+                team_size=queue.team_size,
+                widen_per_sec=queue.widen_per_sec,
+                max_threshold=queue.max_threshold,
+                max_matches=ec.team_max_matches,
+                rounds=ec.team_rounds,
+            )
+            self._dev_pool = jax.device_put(
+                {k: jnp.asarray(v)
+                 for k, v in PlayerPool.empty_device_arrays(self.kernels.capacity).items()}
+            )
+        elif ec.mesh_pool_axis > 1:
             # Multi-chip: pool slots sharded over the mesh axis "pool";
             # windows matched with XLA collectives (engine/sharded.py).
             from matchmaking_tpu.engine.sharded import sharded_kernel_set
@@ -99,10 +135,10 @@ class TpuEngine(Engine):
         # magnitude), so all device-visible times are relative to the first
         # timestamp this engine sees.
         self._t0: float | None = None
-        # Team/role queues: host-side matching over the mirror (same oracle
-        # semantics as CpuEngine); device kernels cover the 1v1 hot path.
+        # Role/party + sharded-team queues: host-side matching over the
+        # mirror (same oracle semantics as CpuEngine).
         self._team_delegate = None
-        if queue.team_size > 1:
+        if queue.team_size > 1 and not self._team_device:
             from matchmaking_tpu.engine.cpu import CpuEngine
 
             self._team_delegate = CpuEngine(cfg, queue)
@@ -225,29 +261,123 @@ class TpuEngine(Engine):
         return pending.token, SearchOutcome(
             rejected=list(pending.outcome.rejected))
 
+    def search_columns_async(self, cols: RequestColumns, now: float) -> int:
+        """Columnar fast path: dispatch a 1v1 window given as numpy columns
+        (region/mode already interned via ``intern_columns``). Returns the
+        window token; the full ColumnarOutcome (including dispatch-time
+        rejections) arrives via collect_ready()/flush() under that token.
+
+        Per-request Python work here is ONLY the id→slot dict membership
+        (dedupe for at-least-once redelivery); everything else is
+        vectorized numpy + one device dispatch per bucket chunk.
+        """
+        assert not self._team_device and self._team_delegate is None, (
+            "columnar path is 1v1-only (team/role queues use the object API)"
+        )
+        pending = _Pending(token=self._next_token)
+        pending.columnar = empty_columnar_outcome()
+        self._next_token += 1
+
+        ids = cols.ids.tolist()
+        waiting = self.pool._slot_of
+        if len(set(ids)) == len(ids):  # common case: no intra-window dups
+            keep = np.fromiter((i not in waiting for i in ids), bool, len(ids))
+        else:
+            local: set[str] = set()
+            keep = np.empty(len(ids), bool)
+            for j, pid in enumerate(ids):
+                keep[j] = pid not in waiting and pid not in local
+                if keep[j]:
+                    local.add(pid)
+        if not keep.all():
+            cols = cols.take(keep)
+
+        max_bucket = self.buckets[-1]
+        for start in range(0, len(cols), max_bucket):
+            self._dispatch_cols(cols.slice(start, start + max_bucket), now, pending)
+        self._open += 1
+        self._handoff.put(pending)
+        return pending.token
+
+    def intern_columns(self, regions, modes) -> tuple[np.ndarray, np.ndarray]:
+        """str sequences → interned int32 code arrays (pool-owned interners)."""
+        rc, mc = self.pool.regions.code, self.pool.modes.code
+        n = len(regions)
+        return (np.fromiter((rc(r) for r in regions), np.int32, n),
+                np.fromiter((mc(m) for m in modes), np.int32, n))
+
+    def restore_columns(self, cols: RequestColumns, now: float) -> None:
+        """Columnar restore: re-admit without matching (checkpoint path).
+        Dedupes both against the pool and within the window (checkpoint
+        files may carry duplicates after an at-least-once replay)."""
+        waiting = self.pool._slot_of
+        ids = cols.ids.tolist()
+        seen: set[str] = set()
+        keep = np.empty(len(ids), bool)
+        for j, pid in enumerate(ids):
+            keep[j] = pid not in waiting and pid not in seen
+            if keep[j]:
+                seen.add(pid)
+        if not keep.all():
+            cols = cols.take(keep)
+        bucket = self.buckets[-1]
+        t0 = self._rel_base(now)
+        for start in range(0, len(cols), bucket):
+            chunk = cols.slice(start, start + bucket)
+            slots = self.pool.allocate_columns(chunk)
+            batch = self.pool.batch_arrays_cols(chunk, slots, bucket, t0)
+            self._dev_pool = self.kernels.admit(self._dev_pool, _as_jnp(batch))
+
+    def _dispatch_cols(self, cols: RequestColumns, now: float,
+                       pending: _Pending) -> None:
+        """Columnar twin of _dispatch: admit + launch, no waiting."""
+        if not len(cols):
+            return
+        free = self.pool.free_count()
+        if len(cols) > free:
+            assert pending.columnar is not None
+            pending.columnar.rejected.extend(
+                (pid, "pool_full") for pid in cols.ids[free:].tolist())
+            cols = cols.slice(0, free)
+            if not len(cols):
+                return
+        slots = self.pool.allocate_columns(cols)
+        bucket = self._bucket_for(len(cols))
+        t0 = self._rel_base(now)
+        batch = self.pool.batch_arrays_cols(cols, slots, bucket, t0)
+        self._dev_pool, q_slot, c_slot, dist = self.kernels.search_step(
+            self._dev_pool, _as_jnp(batch), jnp.float32(now - t0)
+        )
+        pending.chunks.append(((cols, slots), (q_slot, c_slot, dist), now))
+
     def inflight(self) -> int:
         """Windows dispatched but not yet finalized (caller-thread view)."""
         return self._open
 
-    def collect_ready(self) -> list[tuple[int, SearchOutcome]]:
-        """Finalize every window whose results have landed (non-blocking)."""
-        done: list[tuple[int, SearchOutcome]] = []
+    def collect_ready(self) -> list[tuple[int, SearchOutcome | ColumnarOutcome]]:
+        """Finalize every window whose results have landed (non-blocking).
+        Columnar windows yield ColumnarOutcome; object windows SearchOutcome."""
+        done: list[tuple[int, SearchOutcome | ColumnarOutcome]] = []
         while True:
             try:
                 pending = self._done.get_nowait()
             except Exception:
                 break
             self._finalize(pending)
-            done.append((pending.token, pending.outcome))
+            done.append((pending.token,
+                         pending.columnar if pending.columnar is not None
+                         else pending.outcome))
         return done
 
-    def flush(self) -> list[tuple[int, SearchOutcome]]:
+    def flush(self) -> list[tuple[int, SearchOutcome | ColumnarOutcome]]:
         """Block until every in-flight window is collected and finalized."""
-        done: list[tuple[int, SearchOutcome]] = []
+        done: list[tuple[int, SearchOutcome | ColumnarOutcome]] = []
         while self._open > 0:
             pending = self._done.get()
             self._finalize(pending)
-            done.append((pending.token, pending.outcome))
+            done.append((pending.token,
+                         pending.columnar if pending.columnar is not None
+                         else pending.outcome))
         return done
 
     def close(self) -> None:
@@ -345,10 +475,21 @@ class TpuEngine(Engine):
         self._open -= 1
         if pending.error is not None:
             self.device_error = pending.error
-            for window, _, _ in pending.chunks:
-                pending.outcome.queued.extend(window)
+            for payload, _, _ in pending.chunks:
+                if pending.columnar is not None:
+                    cols, _slots = payload
+                    pending.columnar.q_ids = np.concatenate(
+                        [pending.columnar.q_ids, cols.ids])
+                else:
+                    pending.outcome.queued.extend(payload)
+            return
+        if pending.columnar is not None:
+            self._finalize_columnar(pending)
             return
         out = pending.outcome
+        if self._team_device:
+            self._finalize_team(pending)
+            return
         for (window, _, now), (q_slot, c_slot, dist) in zip(
                 pending.chunks, pending.raw or ()):
             P = self.kernels.capacity
@@ -376,6 +517,85 @@ class TpuEngine(Engine):
                     )
                 self.pool.release(qs_l)
                 self.pool.release(cs_l)
+            for req in window:
+                if req.id not in matched_ids:
+                    out.queued.append(req)
+
+    def _eff_vec(self, thr: np.ndarray, enqueued: np.ndarray, now: float) -> np.ndarray:
+        """Vectorized effective_threshold over mirror columns."""
+        if self.queue.widen_per_sec <= 0.0:
+            return thr
+        waited = np.maximum(0.0, now - enqueued)
+        return np.minimum(self.queue.max_threshold,
+                          thr + self.queue.widen_per_sec * waited).astype(np.float32)
+
+    def _finalize_columnar(self, pending: _Pending) -> None:
+        """Columnar finalize: everything vectorized except match-id minting.
+        Same semantics/formulas as the object path (quality from both sides'
+        effective thresholds at match time)."""
+        out = pending.columnar
+        assert out is not None
+        pool = self.pool
+        for (payload, _, now), (q_slot, c_slot, dist) in zip(
+                pending.chunks, pending.raw or ()):
+            cols, slots = payload
+            P = self.kernels.capacity
+            hit = q_slot < P
+            qs, cs, d = q_slot[hit], c_slot[hit], dist[hit]
+            if qs.size:
+                ids_a, ids_b = pool.m_id[qs], pool.m_id[cs]
+                eff_a = self._eff_vec(pool.m_threshold[qs], pool.m_enqueued[qs], now)
+                eff_b = self._eff_vec(pool.m_threshold[cs], pool.m_enqueued[cs], now)
+                limit = np.minimum(eff_a, eff_b)
+                quality = np.where(
+                    limit > 0.0,
+                    np.clip(1.0 - d / np.maximum(limit, 1e-30), 0.0, 1.0),
+                    0.0,
+                ).astype(np.float32)
+                match_ids = np.fromiter(
+                    (new_match_id() for _ in range(qs.size)), object, qs.size)
+                out.m_id_a = np.concatenate([out.m_id_a, ids_a])
+                out.m_id_b = np.concatenate([out.m_id_b, ids_b])
+                out.m_match_id = np.concatenate([out.m_match_id, match_ids])
+                out.m_dist = np.concatenate([out.m_dist, d])
+                out.m_quality = np.concatenate([out.m_quality, quality])
+                out.m_reply_a = np.concatenate([out.m_reply_a, pool.m_reply[qs]])
+                out.m_reply_b = np.concatenate([out.m_reply_b, pool.m_reply[cs]])
+                out.m_corr_a = np.concatenate([out.m_corr_a, pool.m_corr[qs]])
+                out.m_corr_b = np.concatenate([out.m_corr_b, pool.m_corr[cs]])
+                matched = np.concatenate([qs, cs])
+                pool.release(matched)
+                queued_ids = cols.ids[~np.isin(slots, matched)]
+            else:
+                queued_ids = cols.ids
+            out.q_ids = np.concatenate([out.q_ids, queued_ids])
+
+    def _finalize_team(self, pending: _Pending) -> None:
+        """Map team-kernel results (slots M×need, spread, limit) back to
+        requests and split each window into two teams (oracle's snake split —
+        the device kernel validated the sum constraint with the same signed
+        pattern, which is tie-order invariant, see teams.snake_signs)."""
+        out = pending.outcome
+        for (window, _, now), (slots, spread, limit) in zip(
+                pending.chunks, pending.raw or ()):
+            P = self.kernels.capacity
+            matched_ids: set[str] = set()
+            hit = slots[:, 0] < P
+            for m in np.nonzero(hit)[0].tolist():
+                row = slots[m].tolist()
+                members = [self.pool.request_at(s) for s in row]
+                matched_ids.update(r.id for r in members)
+                members.sort(key=lambda r: -r.rating)
+                team_a, team_b = [], []
+                for j, p in enumerate(members):
+                    (team_a if (j % 4 in (0, 3)) else team_b).append(p)
+                thr = float(limit[m])
+                qual = max(0.0, 1.0 - float(spread[m]) / thr) if thr > 0 else 0.0
+                out.matches.append(
+                    Match(match_id=new_match_id(),
+                          teams=(tuple(team_a), tuple(team_b)), quality=qual)
+                )
+                self.pool.release(row)
             for req in window:
                 if req.id not in matched_ids:
                     out.queued.append(req)

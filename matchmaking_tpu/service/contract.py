@@ -46,6 +46,8 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Any, Mapping, Sequence
 
+import numpy as np
+
 DEFAULT_RD = 350.0  # Glicko-2 deviation for an unrated player
 
 # Wildcards: requests that omit region/mode match anything.
@@ -274,6 +276,77 @@ def decode_response(body: bytes | str) -> SearchResponse:
         error_reason=err.get("reason", ""),
         latency_ms=float(payload.get("latency_ms", 0.0)),
     )
+
+
+# ---- columnar requests ----------------------------------------------------
+
+
+@dataclass
+class RequestColumns:
+    """A window of 1v1 search requests as a structure-of-arrays.
+
+    The columnar fast path: the per-request Python object layer
+    (SearchRequest construction, per-field list comprehensions) costs
+    ~10-20 µs/request — at 10^5+ requests/sec that dwarfs the ~1 ms device
+    kernel, so the batcher/bench hand the engine numpy columns instead and
+    objects are only materialized lazily for the few slots that need them
+    (match responses). Region/game-mode are pre-interned int32 codes
+    (0 = wildcard; the engine's pool owns the interners).
+
+    Parties/roles have no columnar form — party matching is host-side
+    (BASELINE config #5) and stays on the object path.
+    """
+
+    ids: "np.ndarray"          # object[N] str
+    rating: "np.ndarray"       # f32[N]
+    rd: "np.ndarray"           # f32[N]
+    region: "np.ndarray"       # i32[N] interned
+    mode: "np.ndarray"         # i32[N] interned
+    threshold: "np.ndarray"    # f32[N]; NaN = queue default
+    enqueued_at: "np.ndarray"  # f64[N] wall-clock seconds
+    reply_to: "np.ndarray | None" = None       # object[N] str, or None
+    correlation_id: "np.ndarray | None" = None
+
+    def __len__(self) -> int:
+        return len(self.ids)
+
+    def slice(self, start: int, stop: int) -> "RequestColumns":
+        return self._apply(lambda a: a[start:stop])
+
+    def take(self, mask_or_idx: "np.ndarray") -> "RequestColumns":
+        """Row subset by boolean mask or index array."""
+        return self._apply(lambda a: a[mask_or_idx])
+
+    def _apply(self, f) -> "RequestColumns":
+        return RequestColumns(
+            ids=f(self.ids), rating=f(self.rating), rd=f(self.rd),
+            region=f(self.region), mode=f(self.mode),
+            threshold=f(self.threshold), enqueued_at=f(self.enqueued_at),
+            reply_to=None if self.reply_to is None else f(self.reply_to),
+            correlation_id=(None if self.correlation_id is None
+                            else f(self.correlation_id)),
+        )
+
+    @staticmethod
+    def from_requests(requests: Sequence[SearchRequest],
+                      region_code, mode_code) -> "RequestColumns":
+        """Object → columnar (the compatibility bridge for the object API).
+        ``region_code``/``mode_code`` are the pool's interner functions."""
+        n = len(requests)
+        cols = RequestColumns(
+            ids=np.fromiter((r.id for r in requests), object, n),
+            rating=np.fromiter((r.rating for r in requests), np.float32, n),
+            rd=np.fromiter((r.rating_deviation for r in requests), np.float32, n),
+            region=np.fromiter((region_code(r.region) for r in requests), np.int32, n),
+            mode=np.fromiter((mode_code(r.game_mode) for r in requests), np.int32, n),
+            threshold=np.fromiter(
+                (np.nan if r.rating_threshold is None else r.rating_threshold
+                 for r in requests), np.float32, n),
+            enqueued_at=np.fromiter((r.enqueued_at for r in requests), np.float64, n),
+            reply_to=np.fromiter((r.reply_to for r in requests), object, n),
+            correlation_id=np.fromiter((r.correlation_id for r in requests), object, n),
+        )
+        return cols
 
 
 _match_id_prefix = uuid.uuid4().hex[:16]

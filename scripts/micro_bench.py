@@ -1,0 +1,88 @@
+"""Stage-level micro-benchmark of the 1v1 device step on the real TPU.
+
+Times each stage with block_until_ready to find where the ~50ms/window goes:
+admit scatter, blockwise score+top-k, greedy pairing, full fused step, and a
+bare no-op roundtrip (tunnel RTT floor).
+"""
+import sys
+import time
+
+import numpy as np
+
+
+def timeit(label, fn, *args, n=20):
+    fn(*args)  # compile
+    out = fn(*args)
+    _block(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    _block(out)
+    dt = (time.perf_counter() - t0) / n
+    print(f"{label:34s} {dt * 1e3:8.2f} ms", file=sys.stderr, flush=True)
+    return dt
+
+
+def _block(out):
+    import jax
+
+    jax.tree_util.tree_map(
+        lambda x: x.block_until_ready() if hasattr(x, "block_until_ready") else x,
+        out,
+    )
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from matchmaking_tpu.core.pool import PlayerPool
+    from matchmaking_tpu.engine.kernels import KernelSet
+
+    print(f"devices: {jax.devices()}", file=sys.stderr)
+    P, B = 131_072, 1024
+    ks = KernelSet(capacity=P, top_k=8, pool_block=8192, glicko2=False,
+                   widen_per_sec=0.0, max_threshold=400.0)
+    rng = np.random.default_rng(0)
+    pool_np = PlayerPool.empty_device_arrays(P)
+    pool_np["rating"] = rng.normal(1500, 300, P).astype(np.float32)
+    pool_np["threshold"] = np.full(P, 100.0, np.float32)
+    pool_np["active"] = np.ones(P, bool)
+    pool = jax.device_put({k: jnp.asarray(v) for k, v in pool_np.items()})
+
+    batch = {
+        "slot": jnp.asarray(np.arange(B, dtype=np.int32) + P),  # sentinel: no admit
+        "rating": jnp.asarray(rng.normal(1500, 300, B).astype(np.float32)),
+        "rd": jnp.zeros(B, jnp.float32),
+        "region": jnp.zeros(B, jnp.int32),
+        "mode": jnp.zeros(B, jnp.int32),
+        "threshold": jnp.full(B, 100.0, jnp.float32),
+        "enqueue_t": jnp.zeros(B, jnp.float32),
+        "valid": jnp.ones(B, bool),
+    }
+    now = jnp.float32(1.0)
+
+    noop = jax.jit(lambda x: x + 1)
+    timeit("noop roundtrip (RTT floor)", lambda: _block(noop(now)))
+
+    q_thr = batch["threshold"]
+    topk = jax.jit(lambda p, b: ks._topk_candidates(b, q_thr, p, now))
+    timeit("blockwise score+topk", topk, pool, batch)
+
+    vals, idxs = topk(pool, batch)
+    pair = jax.jit(lambda v, i: ks.greedy_pair(v, i, batch["slot"]))
+    timeit("greedy_pair", pair, vals, idxs)
+
+    admit = jax.jit(lambda p, b: ks._admit(dict(p), b))
+    timeit("admit scatter", admit, pool, batch)
+
+    step = jax.jit(lambda p, b: ks._search_step(dict(p), b, now))
+    timeit("full search_step (no donate)", step, pool, batch)
+
+    # D2H cost of the outputs (3 arrays of B)
+    outs = step(pool, batch)[1:]
+    timeit("D2H of outputs", lambda: jax.device_get(outs))
+
+
+if __name__ == "__main__":
+    main()

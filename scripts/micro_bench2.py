@@ -1,0 +1,178 @@
+"""Probe: what inside score+topk is slow, and how fast are the scatter-free
+alternatives (compare-select admit, B×B pairing, compare evict, 2-stage topk)."""
+import sys
+import time
+
+import numpy as np
+
+
+def _block(out):
+    import jax
+    jax.tree_util.tree_map(
+        lambda x: x.block_until_ready() if hasattr(x, "block_until_ready") else x, out)
+
+
+def timeit(label, fn, *args, n=20):
+    out = fn(*args)
+    _block(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    _block(out)
+    dt = (time.perf_counter() - t0) / n
+    print(f"{label:44s} {dt * 1e3:8.2f} ms", file=sys.stderr, flush=True)
+    return dt
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    print(f"devices: {jax.devices()}", file=sys.stderr)
+    P, B, BLK, K = 131_072, 1024, 8192, 8
+    NBLK = P // BLK
+    rng = np.random.default_rng(0)
+    pool_r = jnp.asarray(rng.normal(1500, 300, P).astype(np.float32))
+    pool_thr = jnp.full(P, 100.0, jnp.float32)
+    pool_act = jnp.ones(P, bool)
+    br = jnp.asarray(rng.normal(1500, 300, B).astype(np.float32))
+    bthr = jnp.full(B, 100.0, jnp.float32)
+    slot = jnp.asarray(rng.choice(P, B, replace=False).astype(np.int32))
+
+    # -- scoring with plain max (no top_k): isolates score/mask cost
+    @jax.jit
+    def score_max(pool_r, br):
+        def body(carry, i):
+            start = i * BLK
+            c = lax.dynamic_slice_in_dim(pool_r, start, BLK)
+            d = jnp.abs(br[:, None] - c[None, :])
+            s = jnp.where(d <= 100.0, -d, -jnp.inf)
+            return jnp.maximum(carry, s.max(axis=1)), None
+        init = jnp.full(B, -jnp.inf)
+        out, _ = lax.scan(body, init, jnp.arange(NBLK))
+        return out
+    timeit("score only (max reduce)", score_max, pool_r, br)
+
+    # -- current: top_k per block
+    @jax.jit
+    def score_topk(pool_r, br):
+        def body(carry, i):
+            bv, bi = carry
+            start = i * BLK
+            c = lax.dynamic_slice_in_dim(pool_r, start, BLK)
+            d = jnp.abs(br[:, None] - c[None, :])
+            s = jnp.where(d <= 100.0, -d, -jnp.inf)
+            v, ix = lax.top_k(s, K)
+            cv = jnp.concatenate([bv, v], axis=1)
+            ci = jnp.concatenate([bi, ix + start], axis=1)
+            nv, sel = lax.top_k(cv, K)
+            return (nv, jnp.take_along_axis(ci, sel, axis=1)), None
+        init = (jnp.full((B, K), -jnp.inf), jnp.full((B, K), P, jnp.int32))
+        out, _ = lax.scan(body, init, jnp.arange(NBLK))
+        return out
+    timeit("score + lax.top_k per block", score_topk, pool_r, br)
+
+    # -- 2-stage exact top-k: subblock max, topk over maxima, gather, topk
+    SUB = 128
+    NSUB = BLK // SUB
+    @jax.jit
+    def score_topk2(pool_r, br):
+        def body(carry, i):
+            bv, bi = carry
+            start = i * BLK
+            c = lax.dynamic_slice_in_dim(pool_r, start, BLK)
+            d = jnp.abs(br[:, None] - c[None, :])
+            s = jnp.where(d <= 100.0, -d, -jnp.inf)          # (B, BLK)
+            sub = s.reshape(B, NSUB, SUB)
+            submax = sub.max(axis=2)                          # (B, NSUB)
+            _, top_sub = lax.top_k(submax, K)                 # (B, K)
+            cand = jnp.take_along_axis(sub, top_sub[:, :, None], axis=1)  # (B,K,SUB)
+            cand = cand.reshape(B, K * SUB)
+            v, ci = lax.top_k(cand, K)
+            sub_base = jnp.take_along_axis(top_sub, ci // SUB, axis=1) * SUB
+            ix = sub_base + ci % SUB
+            cv = jnp.concatenate([bv, v], axis=1)
+            cix = jnp.concatenate([bi, ix + start], axis=1)
+            nv, sel = lax.top_k(cv, K)
+            return (nv, jnp.take_along_axis(cix, sel, axis=1)), None
+        init = (jnp.full((B, K), -jnp.inf), jnp.full((B, K), P, jnp.int32))
+        out, _ = lax.scan(body, init, jnp.arange(NBLK))
+        return out
+    timeit("score + 2-stage exact top-k", score_topk2, pool_r, br)
+
+    # -- compare-select admit (scatter-free): rebuild pool in one pass
+    @jax.jit
+    def admit_cmp(pool_r, pool_thr, slot, br, bthr):
+        def body(_, i):
+            start = i * BLK
+            pos = start + jnp.arange(BLK, dtype=jnp.int32)
+            eq = slot[None, :] == pos[:, None]                # (BLK, B)
+            hit = eq.any(axis=1)
+            eqf = eq.astype(jnp.float32)
+            vals = jnp.stack([br, bthr], axis=1)              # (B, 2)
+            scat = eqf @ vals                                 # (BLK, 2)
+            r_old = lax.dynamic_slice_in_dim(pool_r, start, BLK)
+            t_old = lax.dynamic_slice_in_dim(pool_thr, start, BLK)
+            return None, (jnp.where(hit, scat[:, 0], r_old),
+                          jnp.where(hit, scat[:, 1], t_old))
+        _, (r_blocks, t_blocks) = lax.scan(body, None, jnp.arange(NBLK))
+        return r_blocks.reshape(P), t_blocks.reshape(P)
+    timeit("compare-select admit (2 fields)", admit_cmp, pool_r, pool_thr, slot, br, bthr)
+
+    # -- compare evict
+    @jax.jit
+    def evict_cmp(pool_act, slot):
+        def body(_, i):
+            start = i * BLK
+            pos = start + jnp.arange(BLK, dtype=jnp.int32)
+            hit = (slot[None, :] == pos[:, None]).any(axis=1)
+            a = lax.dynamic_slice_in_dim(pool_act, start, BLK)
+            return None, a & ~hit
+        _, blocks = lax.scan(body, None, jnp.arange(NBLK))
+        return blocks.reshape(P)
+    timeit("compare evict (1 bool field)", evict_cmp, pool_act, slot)
+
+    # -- B×B greedy pairing (no scatter)
+    vals = jnp.asarray(rng.normal(-50, 20, (B, K)).astype(np.float32))
+    idxs = jnp.asarray(rng.integers(0, P, (B, K)).astype(np.int32))
+    @jax.jit
+    def pair_bb(vals, idxs, slot):
+        rid = jnp.arange(B, dtype=jnp.int32)
+        NEG = -jnp.inf
+        def body(_, state):
+            row_dead, cand_dead, out_q, out_c, out_d = state
+            masked = jnp.where(cand_dead | row_dead[:, None], NEG, vals)
+            bj = jnp.argmax(masked, axis=1)
+            bv = jnp.take_along_axis(masked, bj[:, None], axis=1)[:, 0]
+            bc = jnp.take_along_axis(idxs, bj[:, None], axis=1)[:, 0]
+            live = bv > NEG
+            # Conflict matrix (B, B): shares an endpoint with another proposal
+            se = slot[:, None] == slot[None, :]
+            sc = slot[:, None] == bc[None, :]
+            cs = bc[:, None] == slot[None, :]
+            cc = bc[:, None] == bc[None, :]
+            conflict = (se | sc | cs | cc) & live[None, :] & live[:, None]
+            conflict = conflict & ~jnp.eye(B, dtype=bool)
+            better = (bv[None, :] > bv[:, None]) | \
+                     ((bv[None, :] == bv[:, None]) & (rid[None, :] < rid[:, None]))
+            loses = (conflict & better).any(axis=1)
+            win = live & ~loses
+            out_q = jnp.where(win, slot, out_q)
+            out_c = jnp.where(win, bc, out_c)
+            out_d = jnp.where(win, -bv, out_d)
+            wq = jnp.where(win, slot, P)
+            wc = jnp.where(win, bc, P)
+            used = jnp.concatenate([wq, wc])                  # (2B,)
+            cand_dead = cand_dead | (idxs[:, :, None] == used[None, None, :]).any(-1)
+            row_dead = row_dead | (slot[:, None] == used[None, :]).any(-1)
+            return row_dead, cand_dead, out_q, out_c, out_d
+        init = (jnp.zeros(B, bool), jnp.zeros((B, K), bool),
+                jnp.full(B, P, jnp.int32), jnp.full(B, P, jnp.int32),
+                jnp.full(B, jnp.inf))
+        return lax.fori_loop(0, 8, body, init)[2:]
+    timeit("B×B greedy pairing (8 rounds)", pair_bb, vals, idxs, slot)
+
+
+if __name__ == "__main__":
+    main()

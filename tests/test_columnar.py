@@ -1,0 +1,156 @@
+"""Columnar fast path (RequestColumns → ColumnarOutcome) — must match the
+object path exactly (same kernels, same formulas, vectorized host layer)."""
+
+import numpy as np
+import pytest
+
+from matchmaking_tpu.config import Config, EngineConfig, QueueConfig
+from matchmaking_tpu.engine.interface import make_engine
+from matchmaking_tpu.service.contract import RequestColumns, SearchRequest
+
+
+def _cfg(**queue_kw):
+    return Config(
+        queues=(QueueConfig(rating_threshold=80.0, **queue_kw),),
+        engine=EngineConfig(backend="tpu", pool_capacity=512, pool_block=128,
+                            batch_buckets=(16, 64)),
+    )
+
+
+def _cols(ids, ratings, now=0.0, thresholds=None, regions=None, modes=None,
+          engine=None):
+    n = len(ids)
+    region = np.zeros(n, np.int32)
+    mode = np.zeros(n, np.int32)
+    if regions is not None or modes is not None:
+        region, mode = engine.intern_columns(
+            regions or ["*"] * n, modes or ["*"] * n)
+    return RequestColumns(
+        ids=np.asarray(ids, object),
+        rating=np.asarray(ratings, np.float32),
+        rd=np.zeros(n, np.float32),
+        region=region,
+        mode=mode,
+        threshold=(np.full(n, np.nan, np.float32) if thresholds is None
+                   else np.asarray(thresholds, np.float32)),
+        enqueued_at=np.full(n, now, np.float64),
+        reply_to=np.asarray([f"rq.{i}" for i in ids], object),
+        correlation_id=np.asarray([f"c{i}" for i in ids], object),
+    )
+
+
+def _flush_one(engine):
+    done = engine.flush()
+    assert len(done) == 1
+    return done[0][1]
+
+
+class TestColumnarMatchesObjectPath:
+    def test_same_matches_and_quality(self, rng):
+        cfg = _cfg()
+        obj_eng = make_engine(cfg, cfg.queues[0])
+        col_eng = make_engine(cfg, cfg.queues[0])
+        ratings = rng.permutation(4000)[:100].astype(np.float64) / 2.0
+        ids = [f"p{i}" for i in range(100)]
+
+        reqs = [SearchRequest(id=i, rating=float(r), enqueued_at=1.0,
+                              reply_to=f"rq.{i}", correlation_id=f"c{i}")
+                for i, r in zip(ids, ratings)]
+        out_obj = obj_eng.search(reqs, now=1.0)
+
+        col_eng.search_columns_async(_cols(ids, ratings, now=1.0), now=1.0)
+        out_col = _flush_one(col_eng)
+
+        obj_pairs = {frozenset((m.teams[0][0].id, m.teams[1][0].id)):
+                     m.quality for m in out_obj.matches}
+        col_pairs = {frozenset((a, b)): q for a, b, q in
+                     zip(out_col.m_id_a, out_col.m_id_b, out_col.m_quality)}
+        assert set(obj_pairs) == set(col_pairs)
+        for k, q in obj_pairs.items():
+            assert col_pairs[k] == pytest.approx(q, abs=1e-5)
+        # Queued sets agree too.
+        obj_q = {r.id for r in out_obj.queued}
+        assert obj_q == set(out_col.q_ids.tolist())
+        assert obj_eng.pool_size() == col_eng.pool_size()
+
+    def test_reply_metadata_carried(self, rng):
+        cfg = _cfg()
+        eng = make_engine(cfg, cfg.queues[0])
+        eng.search_columns_async(
+            _cols(["a", "b"], [1500.0, 1501.0], now=0.0), now=0.0)
+        out = _flush_one(eng)
+        assert out.n_matches == 1
+        assert {out.m_reply_a[0], out.m_reply_b[0]} == {"rq.a", "rq.b"}
+        assert {out.m_corr_a[0], out.m_corr_b[0]} == {"ca", "cb"}
+        assert out.m_match_id[0]
+
+    def test_dedup_and_pool_full(self, rng):
+        cfg = Config(
+            queues=(QueueConfig(rating_threshold=1.0),),
+            engine=EngineConfig(backend="tpu", pool_capacity=4, pool_block=4,
+                                batch_buckets=(4,)),
+        )
+        eng = make_engine(cfg, cfg.queues[0])
+        # Far-apart ratings: nothing matches, pool fills to 4.
+        eng.search_columns_async(
+            _cols(["a", "b", "c", "d"], [0.0, 100.0, 200.0, 300.0]), 0.0)
+        out = _flush_one(eng)
+        assert out.n_matches == 0 and len(out.q_ids) == 4
+        # Redelivered ids are dropped (idempotent); overflow is rejected.
+        eng.search_columns_async(
+            _cols(["a", "e", "f"], [0.0, 400.0, 500.0]), 1.0)
+        out2 = _flush_one(eng)
+        assert set(out2.q_ids.tolist()) == set()
+        rejected = dict(out2.rejected)
+        assert rejected == {"e": "pool_full", "f": "pool_full"}
+
+    def test_restore_columns_then_match(self, rng):
+        cfg = _cfg()
+        eng = make_engine(cfg, cfg.queues[0])
+        eng.restore_columns(_cols([f"w{i}" for i in range(8)],
+                                  1000.0 + 200.0 * np.arange(8)), now=0.0)
+        assert eng.pool_size() == 8
+        eng.search_columns_async(_cols(["x"], [1001.0], now=1.0), now=1.0)
+        out = _flush_one(eng)
+        assert out.n_matches == 1
+        assert {out.m_id_a[0], out.m_id_b[0]} == {"x", "w0"}
+
+    def test_region_mode_filters_columnar(self, rng):
+        cfg = _cfg()
+        eng = make_engine(cfg, cfg.queues[0])
+        cols = _cols(["a", "b", "c"], [1500.0, 1501.0, 1502.0],
+                     regions=["eu", "na", "eu"], modes=None, engine=eng)
+        eng.search_columns_async(cols, 0.0)
+        out = _flush_one(eng)
+        assert out.n_matches == 1
+        assert {out.m_id_a[0], out.m_id_b[0]} == {"a", "c"}
+
+    def test_mutual_threshold_rule_columnar(self, rng):
+        """The mutual rule (distance ≤ BOTH sides' effective thresholds) on
+        the columnar path, with widening on the pool side only."""
+        q = QueueConfig(rating_threshold=10.0, widen_per_sec=10.0,
+                        max_threshold=100.0)
+        cfg = Config(queues=(q,),
+                     engine=EngineConfig(backend="tpu", pool_capacity=64,
+                                         pool_block=64, batch_buckets=(16,)))
+        eng = make_engine(cfg, q)
+        eng.restore_columns(_cols(["old"], [1500.0], now=0.0), now=0.0)
+        # distance 40: new arrives with default threshold 10 → mutual limit
+        # min(old_eff=60, 10) = 10 < 40 → NO match even though old widened.
+        eng.search_columns_async(_cols(["new"], [1540.0], now=5.0), now=5.0)
+        out = _flush_one(eng)
+        assert out.n_matches == 0
+
+        # A request with an explicit 30-point threshold at distance 20:
+        # valid only because old's side widened (10 → 40 at t=3); quality
+        # uses the mutual limit min(40, 30) = 30 → 1 - 20/30.
+        cfg2 = Config(queues=(q,),
+                      engine=EngineConfig(backend="tpu", pool_capacity=64,
+                                          pool_block=64, batch_buckets=(16,)))
+        eng2 = make_engine(cfg2, q)
+        eng2.restore_columns(_cols(["old"], [1500.0], now=0.0), now=0.0)
+        eng2.search_columns_async(
+            _cols(["new"], [1520.0], now=3.0, thresholds=[30.0]), now=3.0)
+        out2 = _flush_one(eng2)
+        assert out2.n_matches == 1
+        assert out2.m_quality[0] == pytest.approx(1.0 - 20.0 / 30.0, abs=1e-5)
