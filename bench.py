@@ -197,6 +197,8 @@ def bench_tpu(args) -> dict:
         label="tpu")
     log(f"[tpu] {total} matches over {len(lats)} windows "
         f"({time.perf_counter() - t0:.1f}s total incl. fill/compile)")
+    if hasattr(engine, "span_report"):
+        log(f"[tpu] spans: {engine.span_report()}")
     lat_ms = np.sort(np.asarray(lats)) * 1e3
     return {
         "matches_per_sec": mps,

@@ -141,6 +141,11 @@ class Config:
     #: GenServer pool size analog — SURVEY.md §2 C7).
     workers: int = 2
     seed: int = 0
+    #: Online invariant checking (no player in two matches — SURVEY.md §5
+    #: "Race detection"). One dict op per matched player; on in tests.
+    debug_invariants: bool = False
+    #: Optional HTTP observability endpoint (0 disables).
+    metrics_port: int = 0
 
     # ---- loading -----------------------------------------------------------
 
@@ -164,7 +169,7 @@ class Config:
                     if f.name in sub and isinstance(sub[f.name], list):
                         sub[f.name] = tuple(sub[f.name])
                 kw[name] = cls(**sub)
-        for scalar in ("workers", "seed"):
+        for scalar in ("workers", "seed", "debug_invariants", "metrics_port"):
             if scalar in d:
                 kw[scalar] = d[scalar]
         return Config(**kw)
@@ -188,7 +193,7 @@ class Config:
                 val: Any = json.loads(raw)
             except (ValueError, json.JSONDecodeError):
                 val = raw
-            if key in ("workers", "seed"):
+            if key in ("workers", "seed", "debug_invariants", "metrics_port"):
                 d[key] = val
                 continue
             parts = key.split("_", 1)

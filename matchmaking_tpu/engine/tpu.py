@@ -24,6 +24,7 @@ path — run on device single- or multi-chip.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -62,6 +63,8 @@ class _Pending:
     chunks: list[tuple[Any, tuple[Any, Any, Any], float]] = field(
         default_factory=list
     )
+    #: wall-clock at dispatch, for the turnaround span.
+    created: float = 0.0
     #: rejections determined at dispatch time (pool_full, party, ...)
     outcome: SearchOutcome = field(default_factory=SearchOutcome)
     #: columnar-path outcome (set instead of ``outcome`` when columnar)
@@ -162,6 +165,13 @@ class TpuEngine(Engine):
             target=self._collect_loop, name="tpu-engine-collector", daemon=True
         )
         self._collector.start()
+        #: Stage spans (SURVEY.md §5 tracing): cumulative seconds + counts;
+        #: read via span_report(). Written only on the caller thread.
+        self.spans = {
+            "windows": 0, "requests": 0, "matches": 0,
+            "dispatch_s": 0.0,   # search_*_async host time (pack + H2D + jit)
+            "turnaround_s": 0.0, # dispatch → finalized (device + collect)
+        }
 
     # ---- Engine API -------------------------------------------------------
 
@@ -274,7 +284,8 @@ class TpuEngine(Engine):
         assert not self._team_device and self._team_delegate is None, (
             "columnar path is 1v1-only (team/role queues use the object API)"
         )
-        pending = _Pending(token=self._next_token)
+        t_start = time.perf_counter()
+        pending = _Pending(token=self._next_token, created=t_start)
         pending.columnar = empty_columnar_outcome()
         self._next_token += 1
 
@@ -297,6 +308,8 @@ class TpuEngine(Engine):
             self._dispatch_cols(cols.slice(start, start + max_bucket), now, pending)
         self._open += 1
         self._handoff.put(pending)
+        self.spans["requests"] += len(cols)
+        self.spans["dispatch_s"] += time.perf_counter() - t_start
         return pending.token
 
     def intern_columns(self, regions, modes) -> tuple[np.ndarray, np.ndarray]:
@@ -349,6 +362,17 @@ class TpuEngine(Engine):
             self._dev_pool, _as_jnp(batch), jnp.float32(now - t0)
         )
         pending.chunks.append(((cols, slots), (q_slot, c_slot, dist), now))
+
+    def span_report(self) -> dict[str, float]:
+        """Per-window averages of the stage spans (ms)."""
+        w = max(1, self.spans["windows"])
+        return {
+            "windows": self.spans["windows"],
+            "requests": self.spans["requests"],
+            "matches": self.spans["matches"],
+            "dispatch_ms_avg": self.spans["dispatch_s"] / w * 1e3,
+            "turnaround_ms_avg": self.spans["turnaround_s"] / w * 1e3,
+        }
 
     def inflight(self) -> int:
         """Windows dispatched but not yet finalized (caller-thread view)."""
@@ -473,6 +497,9 @@ class TpuEngine(Engine):
         to check — sync ``search()`` re-raises it so the service's revive
         path fires."""
         self._open -= 1
+        if pending.created:
+            self.spans["windows"] += 1
+            self.spans["turnaround_s"] += time.perf_counter() - pending.created
         if pending.error is not None:
             self.device_error = pending.error
             for payload, _, _ in pending.chunks:
@@ -569,6 +596,7 @@ class TpuEngine(Engine):
             else:
                 queued_ids = cols.ids
             out.q_ids = np.concatenate([out.q_ids, queued_ids])
+        self.spans["matches"] += out.n_matches
 
     def _finalize_team(self, pending: _Pending) -> None:
         """Map team-kernel results (slots M×need, spread, limit) back to

@@ -64,6 +64,12 @@ class _QueueRuntime:
         self._sweeper: asyncio.Task | None = None
         if queue_cfg.request_timeout_s is not None:
             self._sweeper = asyncio.create_task(self._sweep_timeouts())
+        # Online invariant checking (SURVEY.md §5 "Race detection").
+        self._invariants = None
+        if app.cfg.debug_invariants:
+            from matchmaking_tpu.utils.invariants import InvariantChecker
+
+            self._invariants = InvariantChecker(queue_cfg.team_size)
 
     # ---- ingress ----------------------------------------------------------
 
@@ -142,6 +148,8 @@ class _QueueRuntime:
 
     def _publish_outcome(self, outcome: SearchOutcome, now: float) -> None:
         m = self.app.metrics
+        if self._invariants is not None:
+            self._invariants.observe_outcome(outcome)
         for match in outcome.matches:
             result = match.result()
             for req in match.requests():
@@ -241,15 +249,24 @@ class MatchmakingApp:
         self.metrics = Metrics()
         self._runtimes: dict[str, _QueueRuntime] = {}
         self._started = False
+        self._observability = None
 
     async def start(self) -> None:
         assert not self._started
         for queue_cfg in self.cfg.queues:
             self.broker.declare_queue(queue_cfg.name)
             self._runtimes[queue_cfg.name] = _QueueRuntime(self, queue_cfg)
+        if self.cfg.metrics_port:
+            from matchmaking_tpu.service.observability import ObservabilityServer
+
+            self._observability = ObservabilityServer(
+                self, port=self.cfg.metrics_port)
+            await self._observability.start()
         self._started = True
 
     async def stop(self) -> None:
+        if self._observability is not None:
+            await self._observability.stop()
         for rt in self._runtimes.values():
             await rt.close()
         self.broker.close()
@@ -257,6 +274,40 @@ class MatchmakingApp:
 
     def runtime(self, queue_name: str) -> _QueueRuntime:
         return self._runtimes[queue_name]
+
+    # ---- checkpoint / resume (SURVEY.md §5) --------------------------------
+
+    async def save_checkpoint(self, directory: str) -> dict[str, int]:
+        """Serialize every queue's waiting pool to ``directory`` (one file
+        per queue). Holds each engine lock so no window is mid-flight."""
+        import os
+
+        from matchmaking_tpu.utils.checkpoint import save_pool
+
+        os.makedirs(directory, exist_ok=True)
+        counts: dict[str, int] = {}
+        for name, rt in self._runtimes.items():
+            async with rt._engine_lock:
+                counts[name] = save_pool(
+                    rt.engine, os.path.join(directory, f"{name}.npz"),
+                    queue_name=name)
+        return counts
+
+    async def restore_checkpoint(self, directory: str,
+                                 now: float | None = None) -> dict[str, int]:
+        """Re-admit saved pools (no matching). Missing files are skipped."""
+        import os
+
+        from matchmaking_tpu.utils.checkpoint import load_pool
+
+        counts: dict[str, int] = {}
+        for name, rt in self._runtimes.items():
+            path = os.path.join(directory, f"{name}.npz")
+            if not os.path.exists(path):
+                continue
+            async with rt._engine_lock:
+                counts[name] = load_pool(rt.engine, path, now)
+        return counts
 
 
 async def _demo() -> None:

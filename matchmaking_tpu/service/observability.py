@@ -1,0 +1,93 @@
+"""HTTP observability endpoint: /healthz + /metrics (SURVEY.md §5
+"Metrics/logging/observability").
+
+The reference leans on BEAM introspection; the rebuild exposes the service's
+counters/latencies over a tiny aiohttp server (aiohttp is in the base image —
+SURVEY.md §7 [ENV]). JSON at /metrics, Prometheus text at /metrics?format=prom,
+liveness at /healthz (includes per-queue pool occupancy + engine backend).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+try:
+    from aiohttp import web
+except ImportError:  # pragma: no cover - aiohttp is in the base image
+    web = None
+
+
+def _flatten_prom(report: dict[str, Any]) -> str:
+    """Counters + latency summaries → Prometheus exposition text."""
+    lines: list[str] = []
+    for name, value in sorted(report.get("counters", {}).items()):
+        metric = f"matchmaking_{name}"
+        lines.append(f"# TYPE {metric} counter")
+        lines.append(f"{metric} {value}")
+    for series, summary in sorted(report.get("latency", {}).items()):
+        for stat, value in sorted(summary.items()):
+            metric = f"matchmaking_{series}_{stat}"
+            lines.append(f"# TYPE {metric} gauge")
+            lines.append(f"{metric} {value}")
+    for queue, depth in sorted(report.get("pools", {}).items()):
+        lines.append(f'matchmaking_pool_size{{queue="{queue}"}} {depth}')
+    return "\n".join(lines) + "\n"
+
+
+class ObservabilityServer:
+    """Owns the aiohttp runner; start()/stop() from the app's event loop."""
+
+    def __init__(self, app, host: str = "127.0.0.1", port: int = 9100):
+        if web is None:
+            raise RuntimeError("aiohttp unavailable: observability disabled")
+        self.app = app
+        self.host = host
+        self.port = port
+        self._runner: Any = None
+        self._site: Any = None
+
+    def _report(self) -> dict[str, Any]:
+        report = self.app.metrics.report()
+        report["pools"] = {
+            name: rt.engine.pool_size()
+            for name, rt in self.app._runtimes.items()
+        }
+        report["broker"] = dict(self.app.broker.stats)
+        return report
+
+    async def _healthz(self, request) -> "web.Response":
+        body = {
+            "status": "ok",
+            "queues": {
+                name: {
+                    "backend": rt.app.cfg.engine.backend,
+                    "pool_size": rt.engine.pool_size(),
+                    "team_size": rt.queue_cfg.team_size,
+                }
+                for name, rt in self.app._runtimes.items()
+            },
+        }
+        return web.json_response(body)
+
+    async def _metrics(self, request) -> "web.Response":
+        report = self._report()
+        if request.query.get("format") == "prom":
+            return web.Response(text=_flatten_prom(report),
+                                content_type="text/plain")
+        return web.Response(text=json.dumps(report, sort_keys=True),
+                            content_type="application/json")
+
+    async def start(self) -> None:
+        http_app = web.Application()
+        http_app.router.add_get("/healthz", self._healthz)
+        http_app.router.add_get("/metrics", self._metrics)
+        self._runner = web.AppRunner(http_app)
+        await self._runner.setup()
+        self._site = web.TCPSite(self._runner, self.host, self.port)
+        await self._site.start()
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None

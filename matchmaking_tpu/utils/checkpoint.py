@@ -1,0 +1,155 @@
+"""Pool checkpoint/resume (SURVEY.md §5 "Checkpoint/resume").
+
+The reference keeps the player pool in volatile ETS and delegates durability
+to RabbitMQ redelivery; the rebuild's authoritative host mirror makes a real
+checkpoint nearly free: the waiting set is a handful of numpy columns, and
+device state is a pure function of them (restore = re-admit without
+matching).
+
+Format: numpy ``.npz`` with string columns stored as unicode arrays and
+region/game-mode stored by NAME (not interner code), so a checkpoint is
+portable across processes whose interners assigned different codes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+
+import numpy as np
+
+from matchmaking_tpu.service.contract import RequestColumns
+
+FORMAT_VERSION = 1
+
+
+def engine_waiting_columns(engine) -> tuple[RequestColumns, np.ndarray, np.ndarray]:
+    """Waiting pool as columns + region/mode NAME arrays.
+
+    Works for any engine via the object API; uses the TPU engine's columnar
+    mirror directly when available (no object materialization).
+    """
+    pool = getattr(engine, "pool", None)
+    if pool is not None and hasattr(pool, "waiting_slots"):
+        slots = pool.waiting_slots()
+        regions = np.asarray([pool.regions.name(c) for c in
+                              pool.m_region[slots].tolist()], object)
+        modes = np.asarray([pool.modes.name(c) for c in
+                            pool.m_mode[slots].tolist()], object)
+        thr = np.where(pool.m_thr_override[slots], pool.m_threshold[slots],
+                       np.nan).astype(np.float32)
+        cols = RequestColumns(
+            ids=pool.m_id[slots].copy(),
+            rating=pool.m_rating[slots].copy(),
+            rd=pool.m_rd[slots].copy(),
+            region=pool.m_region[slots].copy(),
+            mode=pool.m_mode[slots].copy(),
+            threshold=thr,
+            enqueued_at=pool.m_enqueued[slots].copy(),
+            reply_to=pool.m_reply[slots].copy(),
+            correlation_id=pool.m_corr[slots].copy(),
+        )
+        return cols, regions, modes
+    # Object-path fallback (CPU oracle / team delegates).
+    reqs = engine.waiting()
+    n = len(reqs)
+    cols = RequestColumns(
+        ids=np.fromiter((r.id for r in reqs), object, n),
+        rating=np.fromiter((r.rating for r in reqs), np.float32, n),
+        rd=np.fromiter((r.rating_deviation for r in reqs), np.float32, n),
+        region=np.zeros(n, np.int32),
+        mode=np.zeros(n, np.int32),
+        threshold=np.fromiter(
+            (np.nan if r.rating_threshold is None else r.rating_threshold
+             for r in reqs), np.float32, n),
+        enqueued_at=np.fromiter((r.enqueued_at for r in reqs), np.float64, n),
+        reply_to=np.fromiter((r.reply_to for r in reqs), object, n),
+        correlation_id=np.fromiter((r.correlation_id for r in reqs), object, n),
+    )
+    regions = np.fromiter((r.region for r in reqs), object, n)
+    modes = np.fromiter((r.game_mode for r in reqs), object, n)
+    return cols, regions, modes
+
+
+def save_pool(engine, path: str, *, queue_name: str = "") -> int:
+    """Serialize an engine's waiting pool. Returns the number of players.
+    Atomic: writes to a temp file in the target directory, then renames."""
+    cols, regions, modes = engine_waiting_columns(engine)
+    meta = {"version": FORMAT_VERSION, "queue": queue_name,
+            "saved_at": time.time(), "count": len(cols)}
+    directory = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".npz.tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez_compressed(
+                f,
+                meta=np.asarray(json.dumps(meta)),
+                ids=cols.ids.astype(str),
+                rating=cols.rating,
+                rd=cols.rd,
+                region=regions.astype(str),
+                mode=modes.astype(str),
+                threshold=cols.threshold,
+                enqueued_at=cols.enqueued_at,
+                reply_to=(cols.reply_to if cols.reply_to is not None
+                          else np.full(len(cols), "", object)).astype(str),
+                correlation_id=(cols.correlation_id if cols.correlation_id
+                                is not None else np.full(len(cols), "", object)).astype(str),
+            )
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    return len(cols)
+
+
+def load_pool(engine, path: str, now: float | None = None) -> int:
+    """Restore a checkpoint into an engine (re-admit without matching —
+    restoring MUST not form matches: nobody is listening for the outcomes).
+    Returns the number of players restored. Idempotent: players already
+    waiting are skipped by the engine's restore dedupe."""
+    with np.load(path, allow_pickle=False) as z:
+        meta = json.loads(str(z["meta"]))
+        if meta.get("version") != FORMAT_VERSION:
+            raise ValueError(f"unsupported checkpoint version: {meta.get('version')}")
+        n = meta["count"]
+        ids = z["ids"].astype(object)
+        regions = z["region"].tolist()
+        modes = z["mode"].tolist()
+        cols = RequestColumns(
+            ids=ids,
+            rating=z["rating"],
+            rd=z["rd"],
+            region=np.zeros(n, np.int32),
+            mode=np.zeros(n, np.int32),
+            threshold=z["threshold"],
+            enqueued_at=z["enqueued_at"],
+            reply_to=z["reply_to"].astype(object),
+            correlation_id=z["correlation_id"].astype(object),
+        )
+    t = time.time() if now is None else now
+    if hasattr(engine, "restore_columns") and hasattr(engine, "intern_columns"):
+        cols.region, cols.mode = engine.intern_columns(regions, modes)
+        engine.restore_columns(cols, t)
+        return n
+    # Object-path fallback.
+    from matchmaking_tpu.service.contract import SearchRequest
+
+    reqs = [
+        SearchRequest(
+            id=cols.ids[i], rating=float(cols.rating[i]),
+            rating_deviation=float(cols.rd[i]), game_mode=modes[i],
+            region=regions[i],
+            rating_threshold=(None if np.isnan(cols.threshold[i])
+                              else float(cols.threshold[i])),
+            reply_to=str(cols.reply_to[i]),
+            correlation_id=str(cols.correlation_id[i]),
+            enqueued_at=float(cols.enqueued_at[i]),
+        )
+        for i in range(n)
+    ]
+    engine.restore(reqs, t)
+    return n

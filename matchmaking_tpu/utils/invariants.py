@@ -1,0 +1,68 @@
+"""Online invariant checking (SURVEY.md §5 "Race detection/sanitizers").
+
+The BEAM reference gets safety from share-nothing processes + single-writer
+ETS; the rebuild's equivalents are kernel purity (device) and the
+single-writer mirror (host). This checker guards the END-TO-END invariants
+across outcomes, catching host/device desynchronization bugs that neither
+layer can see alone:
+
+- **No double-match**: a player id appears in at most one match until it is
+  re-queued (requeue = the id shows up as queued/restored again).
+- **Teams well-formed**: team sizes match the queue config; no id appears
+  twice within one match.
+
+Run it always-on in tests; in production wire it behind
+``Config.debug_invariants`` (cost: one dict op per matched player).
+"""
+
+from __future__ import annotations
+
+
+class InvariantViolation(AssertionError):
+    pass
+
+
+class InvariantChecker:
+    def __init__(self, team_size: int = 1):
+        self.team_size = team_size
+        #: ids currently "consumed" by a match and not re-queued since.
+        self._matched: dict[str, str] = {}  # player id → match id
+
+    def observe_queued(self, player_id: str) -> None:
+        """A player (re-)entered the waiting pool: release the match hold."""
+        self._matched.pop(player_id, None)
+
+    def observe_match(self, match_id: str, teams) -> None:
+        ids = [pid for team in teams for pid in team]
+        if len(set(ids)) != len(ids):
+            raise InvariantViolation(
+                f"match {match_id}: player appears twice {sorted(ids)}")
+        if self.team_size > 1:
+            for team in teams:
+                if len(team) != self.team_size:
+                    raise InvariantViolation(
+                        f"match {match_id}: team size {len(team)} != "
+                        f"{self.team_size}")
+        for pid in ids:
+            prev = self._matched.get(pid)
+            if prev is not None:
+                raise InvariantViolation(
+                    f"player {pid} in match {match_id} but already consumed "
+                    f"by match {prev} (no re-queue observed in between)")
+            self._matched[pid] = match_id
+
+    def observe_outcome(self, outcome) -> None:
+        """Feed a SearchOutcome or ColumnarOutcome."""
+        if hasattr(outcome, "m_id_a"):  # columnar
+            for a, b, mid in zip(outcome.m_id_a, outcome.m_id_b,
+                                 outcome.m_match_id):
+                self.observe_match(mid, ((a,), (b,)))
+            for pid in outcome.q_ids:
+                self.observe_queued(pid)
+            return
+        for match in outcome.matches:
+            self.observe_match(
+                match.match_id,
+                tuple(tuple(r.id for r in team) for team in match.teams))
+        for req in outcome.queued:
+            self.observe_queued(req.id)

@@ -1,0 +1,125 @@
+"""Bisect: why are the real KernelSet paths ~50x slower than the probe
+versions of the same algorithms? Build variants from probe → real, adding one
+ingredient at a time."""
+import sys
+import time
+
+import numpy as np
+
+
+def _block(out):
+    import jax
+    jax.tree_util.tree_map(
+        lambda x: x.block_until_ready() if hasattr(x, "block_until_ready") else x, out)
+
+
+def timeit(label, fn, *args, n=20):
+    out = fn(*args)
+    _block(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    _block(out)
+    print(f"{label:52s} {(time.perf_counter() - t0) / n * 1e3:8.2f} ms",
+          file=sys.stderr, flush=True)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from matchmaking_tpu.core.pool import PlayerPool
+    from matchmaking_tpu.engine.kernels import KernelSet, greedy_pair
+
+    print(f"devices: {jax.devices()}", file=sys.stderr)
+    P, B, BLK, K = 131_072, 1024, 8192, 8
+    NBLK = P // BLK
+    rng = np.random.default_rng(0)
+
+    pool_np = PlayerPool.empty_device_arrays(P)
+    pool_np["rating"] = rng.normal(1500, 300, P).astype(np.float32)
+    pool_np["threshold"] = np.full(P, 100.0, np.float32)
+    pool_np["active"] = np.ones(P, bool)
+    pool = jax.device_put({k: jnp.asarray(v) for k, v in pool_np.items()})
+
+    batch_np = {
+        "slot": (np.arange(B) + P).astype(np.int32),
+        "rating": rng.normal(1500, 300, B).astype(np.float32),
+        "rd": np.zeros(B, np.float32),
+        "region": np.zeros(B, np.int32),
+        "mode": np.zeros(B, np.int32),
+        "threshold": np.full(B, 100.0, np.float32),
+        "enqueue_t": np.zeros(B, np.float32),
+        "valid": np.ones(B, bool),
+    }
+    batch = jax.device_put({k: jnp.asarray(v) for k, v in batch_np.items()})
+    now = jnp.float32(1.0)
+
+    ks = KernelSet(capacity=P, top_k=K, pool_block=BLK, glicko2=False,
+                   widen_per_sec=0.0, max_threshold=400.0)
+
+    # A. real _topk_candidates as-is
+    q_thr = batch["threshold"]
+    f = jax.jit(lambda p, b: ks._topk_candidates(b, b["threshold"], p, now))
+    timeit("A real _topk_candidates", f, pool, batch)
+
+    # B. variant: replace _score_block with 1-field scoring, keep structure
+    def topk_b(p, b):
+        def body(carry, blk_i):
+            start = blk_i * BLK
+            c = lax.dynamic_slice_in_dim(p["rating"], start, BLK)
+            d = jnp.abs(b["rating"][:, None] - c[None, :])
+            scores = jnp.where(d <= 100.0, -d, -jnp.float32(jnp.inf))
+            v, i = ks._block_topk(scores)
+            return ks._merge_topk(*carry, v, i.astype(jnp.int32) + start), None
+        init = (jnp.full((B, K), -jnp.inf), jnp.full((B, K), P, jnp.int32))
+        out, _ = lax.scan(body, init, jnp.arange(NBLK, dtype=jnp.int32))
+        return out
+    timeit("B structure + 1-field score", jax.jit(topk_b), pool, batch)
+
+    # C. full _score_block but WITHOUT the scan (single block, x16 manual)
+    def topk_c(p, b):
+        best = (jnp.full((B, K), -jnp.inf), jnp.full((B, K), P, jnp.int32))
+        for i in range(NBLK):
+            start = i * BLK
+            block = {f: lax.dynamic_slice_in_dim(p[f], start, BLK)
+                     for f in ("rating", "rd", "region", "mode", "threshold",
+                               "enqueue_t", "active")}
+            scores = ks._score_block(b, b["threshold"], block, start, now)
+            v, i2 = ks._block_topk(scores)
+            best = ks._merge_topk(*best, v, i2.astype(jnp.int32) + start)
+        return best
+    timeit("C full score, UNROLLED (no scan)", jax.jit(topk_c), pool, batch)
+
+    # D. real greedy_pair jitted directly (fresh)
+    vals = jnp.asarray(rng.normal(-50, 20, (B, K)).astype(np.float32))
+    idxs = jnp.asarray(rng.integers(0, P, (B, K)).astype(np.int32))
+    slot = jnp.asarray(rng.choice(P, B, replace=False).astype(np.int32))
+    timeit("D real greedy_pair (module fn)",
+           jax.jit(lambda v, i, s: greedy_pair(v, i, s, P, 8)), vals, idxs, slot)
+
+    # E. real _admit as-is
+    timeit("E real _admit", jax.jit(lambda p, b: ks._admit(p, b)), pool, batch)
+
+    # F. _admit unrolled (no scan)
+    from matchmaking_tpu.engine.kernels import _admit_block
+    def admit_f(p, b):
+        blocks = []
+        for i in range(NBLK):
+            start = i * BLK
+            block = {f: lax.dynamic_slice_in_dim(p[f], start, BLK)
+                     for f in ("rating", "rd", "region", "mode", "threshold",
+                               "enqueue_t", "active")}
+            blocks.append(_admit_block(block, start, BLK, b))
+        return {f: jnp.concatenate([bl[f] for bl in blocks])
+                for f in blocks[0]}
+    timeit("F _admit UNROLLED (no scan)", jax.jit(admit_f), pool, batch)
+
+    # G. full search step as-is
+    timeit("G real _search_step", jax.jit(lambda p, b: ks._search_step(dict(p), b, now)),
+           pool, batch)
+
+
+if __name__ == "__main__":
+    main()

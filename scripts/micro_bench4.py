@@ -1,0 +1,97 @@
+"""Isolate the fixed ~11ms per-call overhead: arg count? device-array
+constants? output count?"""
+import sys
+import time
+
+import numpy as np
+
+
+def _block(out):
+    import jax
+    jax.tree_util.tree_map(
+        lambda x: x.block_until_ready() if hasattr(x, "block_until_ready") else x, out)
+
+
+def timeit(label, fn, *args, n=20):
+    out = fn(*args)
+    _block(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    _block(out)
+    print(f"{label:56s} {(time.perf_counter() - t0) / n * 1e3:8.2f} ms",
+          file=sys.stderr, flush=True)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    print(f"devices: {jax.devices()}", file=sys.stderr)
+    rng = np.random.default_rng(0)
+    P, B, K = 131_072, 1024, 8
+
+    x = jnp.asarray(rng.normal(size=(B,)).astype(np.float32))
+    many = {f"a{i}": jnp.asarray(rng.normal(size=(B,)).astype(np.float32))
+            for i in range(15)}
+
+    # 1. trivial fn, 1 arg 1 out
+    timeit("1 arg, 1 out, trivial", jax.jit(lambda a: a + 1), x)
+    # 2. 15 dict args (14 unused), 1 out
+    timeit("15-leaf dict arg (14 unused), 1 out",
+           jax.jit(lambda d: d["a0"] + 1), many)
+    # 3. 15-leaf dict arg, 15-leaf dict out
+    timeit("15-leaf dict arg, 15-leaf dict out",
+           jax.jit(lambda d: {k: v + 1 for k, v in d.items()}), many)
+    # 4. closed-over device-array constant
+    NEG = jnp.float32(-jnp.inf)
+    timeit("1 arg + closed-over device const", jax.jit(lambda a: a + NEG), x)
+    # 5. python float constant
+    timeit("1 arg + python const", jax.jit(lambda a: a + (-np.inf)), x)
+
+    # 6. probe-style pair (python consts) vs module pair on same data
+    from matchmaking_tpu.engine.kernels import greedy_pair
+    vals = jnp.asarray(rng.normal(-50, 20, (B, K)).astype(np.float32))
+    idxs = jnp.asarray(rng.integers(0, P, (B, K)).astype(np.int32))
+    slot = jnp.asarray(rng.choice(P, B, replace=False).astype(np.int32))
+    timeit("module greedy_pair", jax.jit(lambda v, i, s: greedy_pair(v, i, s, P, 8)),
+           vals, idxs, slot)
+
+    def pair_local(vals, idxs, self_slot):
+        cap = jnp.int32(P)
+        rid = jnp.arange(B, dtype=jnp.int32)
+        not_diag = ~jnp.eye(B, dtype=bool)
+        NEGL = -jnp.inf
+        def body(_, state):
+            row_dead, cand_dead, out_q, out_c, out_d = state
+            masked = jnp.where(cand_dead | row_dead[:, None], NEGL, vals)
+            bj = jnp.argmax(masked, axis=1)
+            bv = jnp.take_along_axis(masked, bj[:, None], axis=1)[:, 0]
+            bc = jnp.take_along_axis(idxs, bj[:, None], axis=1)[:, 0]
+            live = bv > NEGL
+            conflict = ((self_slot[:, None] == self_slot[None, :])
+                        | (self_slot[:, None] == bc[None, :])
+                        | (bc[:, None] == self_slot[None, :])
+                        | (bc[:, None] == bc[None, :])) \
+                & live[None, :] & live[:, None] & not_diag
+            better = (bv[None, :] > bv[:, None]) | (
+                (bv[None, :] == bv[:, None]) & (rid[None, :] < rid[:, None]))
+            win = live & ~(conflict & better).any(axis=1)
+            out_q = jnp.where(win, self_slot, out_q)
+            out_c = jnp.where(win, bc, out_c)
+            out_d = jnp.where(win, -bv, out_d)
+            used = jnp.concatenate([jnp.where(win, self_slot, cap),
+                                    jnp.where(win, bc, cap)])
+            cand_dead = cand_dead | (idxs[:, :, None] == used[None, None, :]).any(-1)
+            row_dead = row_dead | (self_slot[:, None] == used[None, :]).any(-1)
+            return row_dead, cand_dead, out_q, out_c, out_d
+        init = (jnp.zeros(B, bool), jnp.zeros((B, K), bool),
+                jnp.full(B, P, jnp.int32), jnp.full(B, P, jnp.int32),
+                jnp.full(B, jnp.inf))
+        return lax.fori_loop(0, 8, body, init)[2:]
+    timeit("local pair copy (python consts)", jax.jit(pair_local), vals, idxs, slot)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,89 @@
+"""Where does the per-window wall time go in the columnar engine loop?
+
+Times, per window on the real TPU: column generation, search_columns_async
+(host dispatch incl. H2D + jit call), collect wait, finalize is inside
+collect; plus a breakdown of dispatch internals (allocate, pack, _as_jnp
+H2D, jit call).
+"""
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    sys.path.insert(0, "/root/repo")
+    import jax
+    import jax.numpy as jnp
+
+    from bench import make_columns
+    from matchmaking_tpu.config import Config, EngineConfig, QueueConfig
+    from matchmaking_tpu.engine.interface import make_engine
+    from matchmaking_tpu.engine.tpu import _as_jnp
+
+    W = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+    cfg = Config(
+        queues=(QueueConfig(rating_threshold=100.0),),
+        engine=EngineConfig(backend="tpu", pool_capacity=131_072,
+                            pool_block=8192, batch_buckets=(16, 64, 256, W)),
+    )
+    eng = make_engine(cfg, cfg.queues[0])
+    rng = np.random.default_rng(0)
+    print(f"devices: {jax.devices()}  window={W}", file=sys.stderr)
+
+    # Fill pool to 100k
+    nid = 0
+    t0 = time.perf_counter()
+    while eng.pool_size() < 100_000:
+        n = min(8192, 100_000 - eng.pool_size())
+        eng.restore_columns(make_columns(rng, n, nid, 0.0), 0.0)
+        nid += n
+    print(f"fill: {time.perf_counter()-t0:.1f}s", file=sys.stderr)
+
+    # Warmup (compile)
+    for _ in range(3):
+        eng.search_columns_async(make_columns(rng, W, nid, 1.0), 1.0)
+        nid += W
+        eng.flush()
+
+    N = 30
+    gen = disp = coll = refill = 0.0
+    matches = 0
+    for i in range(N):
+        t = time.perf_counter(); cols = make_columns(rng, W, nid, 2.0 + i)
+        nid += W; gen += time.perf_counter() - t
+        t = time.perf_counter(); eng.search_columns_async(cols, 2.0 + i)
+        disp += time.perf_counter() - t
+        t = time.perf_counter(); outs = eng.flush()
+        coll += time.perf_counter() - t
+        matches += sum(o.n_matches for _, o in outs)
+        t = time.perf_counter()
+        deficit = 100_000 - eng.pool_size()
+        if deficit > 0:
+            eng.restore_columns(make_columns(rng, deficit, nid, 2.0 + i), 2.0 + i)
+            nid += deficit
+        refill += time.perf_counter() - t
+    for name, v in [("make_columns", gen), ("dispatch(search_columns_async)", disp),
+                    ("collect+finalize(flush)", coll), ("refill(restore)", refill)]:
+        print(f"{name:32s} {v / N * 1e3:8.2f} ms/window", file=sys.stderr)
+    print(f"matches/window: {matches / N:.0f}", file=sys.stderr)
+
+    # Dispatch internals, one window:
+    cols = make_columns(rng, W, nid, 99.0); nid += W
+    pool = eng.pool
+    t = time.perf_counter(); slots = pool.allocate_columns(cols); t1 = time.perf_counter() - t
+    t = time.perf_counter(); batch = pool.batch_arrays_cols(cols, slots, W, 0.0); t2 = time.perf_counter() - t
+    t = time.perf_counter(); jb = _as_jnp(batch); jax.block_until_ready(list(jb.values())); t3 = time.perf_counter() - t
+    t = time.perf_counter()
+    eng._dev_pool, q, c, d = eng.kernels.search_step(eng._dev_pool, jb, jnp.float32(99.0))
+    t4 = time.perf_counter() - t
+    t = time.perf_counter(); jax.block_until_ready(d); t5 = time.perf_counter() - t
+    t = time.perf_counter(); raw = jax.device_get((q, c, d)); t6 = time.perf_counter() - t
+    for name, v in [("allocate_columns", t1), ("batch_arrays_cols", t2),
+                    ("_as_jnp H2D (blocked)", t3), ("jit call (dispatch only)", t4),
+                    ("device exec (block)", t5), ("device_get D2H", t6)]:
+        print(f"{name:32s} {v * 1e3:8.2f} ms", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

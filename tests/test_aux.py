@@ -1,0 +1,181 @@
+"""Aux subsystems (SURVEY.md §5): checkpoint/resume, invariant checking,
+HTTP observability."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from matchmaking_tpu.config import Config, EngineConfig, QueueConfig
+from matchmaking_tpu.engine.interface import make_engine
+from matchmaking_tpu.service.contract import SearchRequest
+from matchmaking_tpu.utils.checkpoint import load_pool, save_pool
+from matchmaking_tpu.utils.invariants import InvariantChecker, InvariantViolation
+
+
+def _req(i, rating, **kw):
+    return SearchRequest(id=f"p{i}", rating=float(rating), enqueued_at=0.0,
+                         reply_to=f"rq.p{i}", correlation_id=f"c{i}", **kw)
+
+
+class TestCheckpoint:
+    @pytest.mark.parametrize("backend", ["cpu", "tpu"])
+    def test_save_load_roundtrip(self, tmp_path, backend):
+        cfg = Config(
+            queues=(QueueConfig(rating_threshold=50.0),),
+            engine=EngineConfig(backend=backend, pool_capacity=128,
+                                pool_block=64, batch_buckets=(16,)),
+        )
+        eng = make_engine(cfg, cfg.queues[0])
+        # Far-apart ratings with assorted metadata; nothing matches.
+        reqs = [
+            _req(0, 1000, region="eu", game_mode="ranked"),
+            _req(1, 2000, rating_threshold=33.0),
+            _req(2, 3000, rating_deviation=120.0),
+        ]
+        eng.restore(reqs, 0.0)
+        path = str(tmp_path / "pool.npz")
+        assert save_pool(eng, path, queue_name="q") == 3
+
+        eng2 = make_engine(cfg, cfg.queues[0])
+        assert load_pool(eng2, path, now=1.0) == 3
+        assert eng2.pool_size() == 3
+        by_id = {r.id: r for r in eng2.waiting()}
+        assert by_id["p0"].region == "eu" and by_id["p0"].game_mode == "ranked"
+        assert by_id["p1"].rating_threshold == pytest.approx(33.0)
+        assert by_id["p2"].rating_deviation == pytest.approx(120.0)
+        assert by_id["p0"].reply_to == "rq.p0"
+        assert by_id["p0"].enqueued_at == pytest.approx(0.0)
+
+    def test_load_is_idempotent_and_does_not_match(self, tmp_path):
+        cfg = Config(
+            queues=(QueueConfig(rating_threshold=100.0),),
+            engine=EngineConfig(backend="tpu", pool_capacity=64,
+                                pool_block=64, batch_buckets=(16,)),
+        )
+        eng = make_engine(cfg, cfg.queues[0])
+        # A matchable pair — restore must NOT match them.
+        eng.restore([_req(0, 1500), _req(1, 1501)], 0.0)
+        path = str(tmp_path / "pool.npz")
+        save_pool(eng, path)
+        eng2 = make_engine(cfg, cfg.queues[0])
+        load_pool(eng2, path, now=0.0)
+        load_pool(eng2, path, now=0.0)  # idempotent: dedupe on restore
+        assert eng2.pool_size() == 2
+        # They match on the next real window.
+        out = eng2.search([_req(9, 1502)], 1.0)
+        assert len(out.matches) == 1
+
+    def test_cross_backend_restore(self, tmp_path):
+        """A CPU-oracle checkpoint restores into the TPU engine (portable
+        format: region/mode by name)."""
+        cfg_c = Config(queues=(QueueConfig(),))
+        cpu = make_engine(cfg_c, cfg_c.queues[0])
+        cpu.restore([_req(0, 1200, region="na"), _req(1, 4000)], 0.0)
+        path = str(tmp_path / "pool.npz")
+        save_pool(cpu, path)
+
+        cfg_t = Config(queues=(QueueConfig(),),
+                       engine=EngineConfig(backend="tpu", pool_capacity=64,
+                                           pool_block=64, batch_buckets=(16,)))
+        tpu = make_engine(cfg_t, cfg_t.queues[0])
+        load_pool(tpu, path, now=0.0)
+        assert tpu.pool_size() == 2
+        out = tpu.search([_req(5, 1201, region="na")], 1.0)
+        assert len(out.matches) == 1
+
+
+class TestInvariantChecker:
+    def test_double_match_detected(self):
+        inv = InvariantChecker()
+        inv.observe_match("m1", (("a",), ("b",)))
+        with pytest.raises(InvariantViolation):
+            inv.observe_match("m2", (("a",), ("c",)))
+
+    def test_requeue_releases_hold(self):
+        inv = InvariantChecker()
+        inv.observe_match("m1", (("a",), ("b",)))
+        inv.observe_queued("a")
+        inv.observe_match("m2", (("a",), ("c",)))  # fine after requeue
+
+    def test_duplicate_in_one_match(self):
+        inv = InvariantChecker()
+        with pytest.raises(InvariantViolation):
+            inv.observe_match("m1", (("a",), ("a",)))
+
+    def test_team_size_enforced(self):
+        inv = InvariantChecker(team_size=2)
+        with pytest.raises(InvariantViolation):
+            inv.observe_match("m1", (("a", "b"), ("c",)))
+
+    def test_columnar_outcome_observed(self):
+        from matchmaking_tpu.engine.interface import empty_columnar_outcome
+
+        out = empty_columnar_outcome()
+        out.m_id_a = np.asarray(["a"], object)
+        out.m_id_b = np.asarray(["b"], object)
+        out.m_match_id = np.asarray(["m1"], object)
+        inv = InvariantChecker()
+        inv.observe_outcome(out)
+        with pytest.raises(InvariantViolation):
+            inv.observe_match("m2", (("b",), ("z",)))
+
+
+class TestObservability:
+    def test_healthz_and_metrics(self):
+        import aiohttp
+
+        from matchmaking_tpu.service.app import MatchmakingApp
+
+        async def run():
+            cfg = Config(metrics_port=19155, debug_invariants=True)
+            app = MatchmakingApp(cfg)
+            await app.start()
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.get("http://127.0.0.1:19155/healthz") as r:
+                        body = await r.json()
+                        assert body["status"] == "ok"
+                        assert "matchmaking.search" in body["queues"]
+                    async with s.get("http://127.0.0.1:19155/metrics") as r:
+                        report = await r.json()
+                        assert "counters" in report and "pools" in report
+                    async with s.get(
+                            "http://127.0.0.1:19155/metrics?format=prom") as r:
+                        text = await r.text()
+                        assert "matchmaking_pool_size" in text
+            finally:
+                await app.stop()
+
+        asyncio.run(run())
+
+
+class TestAppCheckpointIntegration:
+    def test_save_restore_via_app(self, tmp_path):
+        from matchmaking_tpu.service.app import MatchmakingApp
+        from matchmaking_tpu.service.client import MatchmakingClient
+
+        async def run():
+            cfg = Config(queues=(QueueConfig(rating_threshold=1.0),))
+            app = MatchmakingApp(cfg)
+            await app.start()
+            client = MatchmakingClient(app.broker, cfg.broker.request_queue)
+            # Two players that cannot match (threshold 1, distance 100).
+            rt_a = client.submit({"id": "a", "rating": 1000})
+            rt_b = client.submit({"id": "b", "rating": 1100})
+            r1 = await client.next_response(rt_a, timeout=2.0)
+            r2 = await client.next_response(rt_b, timeout=2.0)
+            assert r1.status == "queued" and r2.status == "queued"
+            counts = await app.save_checkpoint(str(tmp_path / "ckpt"))
+            assert counts == {"matchmaking.search": 2}
+            await app.stop()
+
+            app2 = MatchmakingApp(Config(queues=(QueueConfig(rating_threshold=1.0),)))
+            await app2.start()
+            counts = await app2.restore_checkpoint(str(tmp_path / "ckpt"))
+            assert counts == {"matchmaking.search": 2}
+            rt = app2.runtime("matchmaking.search")
+            assert rt.engine.pool_size() == 2
+            await app2.stop()
+
+        asyncio.run(run())
