@@ -1,0 +1,24 @@
+# matchmaking_tpu service image (SURVEY.md §2 C12 packaging parity).
+#
+# The base image must provide jax with the TPU runtime for your fleet
+# (e.g. a jax-stable-stack TPU image); for CPU-only smoke runs any
+# python:3.12 base works — tests force JAX_PLATFORMS=cpu.
+ARG BASE_IMAGE=python:3.12-slim
+FROM ${BASE_IMAGE}
+
+WORKDIR /app
+COPY matchmaking_tpu/ matchmaking_tpu/
+COPY native/ native/
+COPY bench.py README.md ./
+
+# Native codec: build ahead of time when a toolchain is present (the Python
+# binding also builds lazily at first use and falls back to pure Python).
+RUN if command -v g++ >/dev/null; then \
+      g++ -O2 -shared -fPIC -o native/libmmcodec.so native/codec.cc; \
+    fi
+
+ENV MM_BROKER_URL=amqp://rabbitmq:5672 \
+    MM_ENGINE_BACKEND=tpu \
+    PYTHONUNBUFFERED=1
+
+CMD ["python", "-m", "matchmaking_tpu.service.app", "--demo"]

@@ -190,23 +190,36 @@ def bench_tpu(args) -> dict:
     )
     engine = make_engine(cfg, cfg.queues[0])
     rng = np.random.default_rng(0)
+    # The shared TPU backend shows large multi-tenant timing variance
+    # (identical compiled steps measured 10-30x apart minutes apart), so the
+    # measured phase runs ``repeats`` times and the MEDIAN run is reported;
+    # all samples are logged for transparency.
+    runs = []
     t0 = time.perf_counter()
-    mps, lats, total = run_engine_pipelined(
-        engine, rng, pool_target=args.pool, window=args.window,
-        warmup=args.warmup, measured=args.windows, depth=args.depth,
-        label="tpu")
-    log(f"[tpu] {total} matches over {len(lats)} windows "
-        f"({time.perf_counter() - t0:.1f}s total incl. fill/compile)")
+    for rep in range(max(1, args.repeats)):
+        mps, lats, total = run_engine_pipelined(
+            engine, rng, pool_target=args.pool, window=args.window,
+            warmup=args.warmup, measured=args.windows, depth=args.depth,
+            label=f"tpu rep{rep}")
+        lat_ms = np.sort(np.asarray(lats)) * 1e3
+        runs.append({
+            "matches_per_sec": mps,
+            "p50_ms": float(np.percentile(lat_ms, 50)),
+            "p99_ms": float(np.percentile(lat_ms, 99)),
+            "total_matches": total,
+        })
+        log(f"[tpu rep{rep}] {total} matches, {mps:.0f}/s, "
+            f"p99 {runs[-1]['p99_ms']:.0f} ms")
+    log(f"[tpu] {time.perf_counter() - t0:.1f}s total incl. fill/compile")
     if hasattr(engine, "span_report"):
         log(f"[tpu] spans: {engine.span_report()}")
-    lat_ms = np.sort(np.asarray(lats)) * 1e3
+    runs.sort(key=lambda r: r["matches_per_sec"])
+    median = runs[len(runs) // 2]
     return {
-        "matches_per_sec": mps,
-        "p50_ms": float(np.percentile(lat_ms, 50)),
-        "p99_ms": float(np.percentile(lat_ms, 99)),
-        "total_matches": total,
+        **median,
         "pool": args.pool,
         "window": args.window,
+        "all_runs_mps": [round(r["matches_per_sec"], 1) for r in runs],
     }
 
 
@@ -241,6 +254,9 @@ def main() -> None:
     p.add_argument("--windows", type=int, default=50,
                    help="measured windows")
     p.add_argument("--warmup", type=int, default=5)
+    p.add_argument("--repeats", type=int, default=3,
+                   help="repeat the measured phase; report the median run "
+                        "(the shared TPU backend has multi-tenant variance)")
     p.add_argument("--depth", type=int, default=4,
                    help="max in-flight windows (pipelining hides device RTT)")
     p.add_argument("--cpu-pool", type=int, default=2000,
@@ -273,6 +289,7 @@ def main() -> None:
         "pool": tpu["pool"],
         "window": tpu["window"],
         "total_matches": tpu["total_matches"],
+        "all_runs_mps": tpu.get("all_runs_mps", []),
         "baseline": {
             "what": "CPU oracle (reference sequential-scan semantics) "
                     f"@ {args.cpu_pool}-player pool",

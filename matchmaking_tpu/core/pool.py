@@ -276,3 +276,21 @@ class PlayerPool:
     def empty_device_arrays(capacity: int) -> dict[str, np.ndarray]:
         """Initial HBM pool state (all slots inactive)."""
         return {name: np.zeros(capacity, dtype) for name, dtype in POOL_FIELDS}
+
+
+#: Row order of the packed batch (one f32[9, B] array per window — a single
+#: host→device transfer; the per-array RPC through the device tunnel is the
+#: dominant dispatch cost otherwise). All rows are exact in f32: slot ids and
+#: interner codes ≪ 2^24, valid is 0/1. Row 8 carries the rebased ``now``
+#: scalar (broadcast across the row; kernels read [8, 0]).
+PACKED_ROWS = ("slot", "rating", "rd", "region", "mode", "threshold",
+               "enqueue_t", "valid")
+
+
+def pack_batch(batch: BatchArrays, now: float = 0.0) -> np.ndarray:
+    """BatchArrays (+ rebased now) → one f32[9, B] array (unpacked in-kernel)."""
+    out = np.empty((len(PACKED_ROWS) + 1, batch.slot.shape[0]), np.float32)
+    for i, name in enumerate(PACKED_ROWS):
+        out[i] = getattr(batch, name)
+    out[8] = now
+    return out

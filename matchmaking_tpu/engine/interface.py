@@ -89,6 +89,8 @@ class ColumnarOutcome:
     m_reply_b: "np.ndarray"
     m_corr_a: "np.ndarray"    # object[M] correlation ids
     m_corr_b: "np.ndarray"
+    m_enq_a: "np.ndarray"     # f64[M] enqueue wall-clock (latency accounting)
+    m_enq_b: "np.ndarray"
     q_ids: "np.ndarray"       # object[Q] newly queued player ids
     #: (player_id, reason_code) pairs the engine refused.
     rejected: list[tuple[str, str]] = field(default_factory=list)
@@ -101,9 +103,10 @@ class ColumnarOutcome:
 def empty_columnar_outcome() -> ColumnarOutcome:
     e = np.empty(0, object)
     z = np.empty(0, np.float32)
+    t = np.empty(0, np.float64)
     return ColumnarOutcome(m_id_a=e, m_id_b=e, m_match_id=e, m_dist=z,
                            m_quality=z, m_reply_a=e, m_reply_b=e, m_corr_a=e,
-                           m_corr_b=e, q_ids=e)
+                           m_corr_b=e, m_enq_a=t, m_enq_b=t, q_ids=e)
 
 
 class Engine(abc.ABC):

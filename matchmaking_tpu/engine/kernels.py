@@ -53,6 +53,22 @@ _NEG_INF = jnp.float32(-jnp.inf)
 _ADMIT_FIELDS = ("rating", "rd", "region", "mode", "threshold", "enqueue_t")
 
 
+def unpack_batch(packed) -> dict[str, Any]:
+    """f32[8, B] (see pool.PACKED_ROWS) → the batch dict the kernels use.
+    One packed array = one H2D transfer per window; the tunnel's per-array
+    RPC cost makes 8 separate transfers the dominant dispatch latency."""
+    return {
+        "slot": packed[0].astype(jnp.int32),
+        "rating": packed[1],
+        "rd": packed[2],
+        "region": packed[3].astype(jnp.int32),
+        "mode": packed[4].astype(jnp.int32),
+        "threshold": packed[5],
+        "enqueue_t": packed[6],
+        "valid": packed[7] > 0,
+    }
+
+
 def _effective_threshold(thr, enqueue_t, now, widen_per_sec: float, max_threshold: float):
     """Config-gated threshold widening by wait time (SURVEY.md §2 C9)."""
     if widen_per_sec <= 0.0:
@@ -160,10 +176,19 @@ def greedy_pair(vals, idxs, self_slot, capacity: int, rounds: int = 8):
         out_c = jnp.where(win, bc, out_c)
         out_d = jnp.where(win, -bv, out_d)
         # Retire both endpoints of every winner (sentinel for losers).
-        used = jnp.concatenate([jnp.where(win, self_slot, cap),
-                                jnp.where(win, bc, cap)])          # (2B,)
-        cand_dead = cand_dead | (idxs[:, :, None] == used[None, None, :]).any(-1)
-        row_dead = row_dead | (self_slot[:, None] == used[None, :]).any(-1)
+        # Membership via sort + binary search: the dense (B, K, 2B) compare
+        # is O(B²K) and dominates the step at large windows (~2 GOp at
+        # B=4096); sorted search is ~BK·log B.
+        used = jnp.sort(jnp.concatenate([jnp.where(win, self_slot, cap),
+                                         jnp.where(win, bc, cap)]))  # (2B,)
+        last = used.shape[0] - 1
+
+        def member(x):
+            pos = jnp.clip(jnp.searchsorted(used, x), 0, last)
+            return jnp.take(used, pos) == x
+
+        cand_dead = cand_dead | member(idxs)
+        row_dead = row_dead | member(self_slot)
         return row_dead, cand_dead, out_q, out_c, out_d
 
     init = (
@@ -207,6 +232,24 @@ class KernelSet:
         self.admit = jax.jit(self._admit, donate_argnums=0)
         self.evict = jax.jit(self._evict, donate_argnums=0)
         self.search_step = jax.jit(self._search_step, donate_argnums=0)
+        # Packed I/O variants: one f32[8,B] in, one f32[3,B] out — a single
+        # H2D and a single D2H RPC per window through the device tunnel.
+        self.admit_packed = jax.jit(
+            lambda pool, packed: self._admit(pool, unpack_batch(packed)),
+            donate_argnums=0)
+        self.search_step_packed = jax.jit(self._search_step_packed,
+                                          donate_argnums=0)
+
+    def _search_step_packed(self, pool, packed):
+        """Packed window step: batch rows per pool.PACKED_ROWS plus a 9th row
+        whose [0] element is the rebased ``now`` scalar; output stacks
+        (q_slot, c_slot, dist) as f32[3, B] (slot ids ≪ 2^24 are f32-exact)."""
+        batch = unpack_batch(packed)
+        now = packed[8, 0]
+        pool, out_q, out_c, out_d = self._search_step(pool, batch, now)
+        out = jnp.stack([out_q.astype(jnp.float32),
+                         out_c.astype(jnp.float32), out_d])
+        return pool, out
 
     # ---- admission / eviction --------------------------------------------
 

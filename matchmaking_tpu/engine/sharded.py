@@ -42,7 +42,12 @@ try:  # jax >= 0.6 exposes shard_map at the top level
 except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map as _shard_map
 
-from matchmaking_tpu.engine.kernels import KernelSet, _effective_threshold, greedy_pair
+from matchmaking_tpu.engine.kernels import (
+    KernelSet,
+    _effective_threshold,
+    greedy_pair,
+    unpack_batch,
+)
 
 AXIS = "pool"
 
@@ -113,6 +118,32 @@ class ShardedKernelSet:
                        check_vma=False),
             donate_argnums=0,
         )
+        # Packed I/O variants (one replicated f32[9,B] in / f32[3,B] out —
+        # single H2D/D2H RPC per window; see pool.PACKED_ROWS).
+        self.search_step_packed = jax.jit(
+            _shard_map(
+                self._search_step_packed_shard, mesh=mesh,
+                in_specs=(pool_spec, rep), out_specs=(pool_spec, rep),
+                check_vma=False,
+            ),
+            donate_argnums=0,
+        )
+        self.admit_packed = jax.jit(
+            _shard_map(
+                lambda pool, packed: self._admit_shard(pool, unpack_batch(packed)),
+                mesh=mesh, in_specs=(pool_spec, rep), out_specs=pool_spec,
+                check_vma=False,
+            ),
+            donate_argnums=0,
+        )
+
+    def _search_step_packed_shard(self, pool, packed):
+        batch = unpack_batch(packed)
+        now = packed[8, 0]
+        pool, out_q, out_c, out_d = self._search_step_shard(pool, batch, now)
+        out = jnp.stack([out_q.astype(jnp.float32),
+                         out_c.astype(jnp.float32), out_d])
+        return pool, out
 
     # ---- helpers (run per shard, inside shard_map) ------------------------
 

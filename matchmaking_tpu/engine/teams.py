@@ -86,8 +86,23 @@ class TeamKernelSet:
             max_threshold=max_threshold, evict_bucket=evict_bucket,
         )
         self.admit = self._base.admit
+        self.admit_packed = self._base.admit_packed
         self.evict = self._base.evict
         self.search_step = jax.jit(self._search_step, donate_argnums=0)
+        self.search_step_packed = jax.jit(self._search_step_packed,
+                                          donate_argnums=0)
+
+    def _search_step_packed(self, pool, packed):
+        """Packed team step: f32[9,B] in (see pool.PACKED_ROWS + now row),
+        out stacked f32[need+2, M]: member slots (f32-exact), spread, limit."""
+        from matchmaking_tpu.engine.kernels import unpack_batch
+
+        batch = unpack_batch(packed)
+        now = packed[8, 0]
+        pool, slots, spread, thr = self._search_step(pool, batch, now)
+        out = jnp.concatenate([slots.T.astype(jnp.float32),
+                               spread[None, :], thr[None, :]])
+        return pool, out
 
     # ---- internals --------------------------------------------------------
 

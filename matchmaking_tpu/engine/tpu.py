@@ -35,7 +35,7 @@ import jax
 import jax.numpy as jnp
 
 from matchmaking_tpu.config import Config, QueueConfig
-from matchmaking_tpu.core.pool import BatchArrays, PlayerPool
+from matchmaking_tpu.core.pool import BatchArrays, PlayerPool, pack_batch
 from matchmaking_tpu.engine import scoring
 from matchmaking_tpu.engine.interface import (
     ColumnarOutcome,
@@ -49,6 +49,7 @@ from matchmaking_tpu.service.contract import (
     RequestColumns,
     SearchRequest,
     new_match_id,
+    new_match_ids,
 )
 
 
@@ -145,32 +146,29 @@ class TpuEngine(Engine):
             from matchmaking_tpu.engine.cpu import CpuEngine
 
             self._team_delegate = CpuEngine(cfg, queue)
-        # Pipelined windows: dispatched, not yet finalized (FIFO).
-        # Caller thread dispatches + finalizes (single-writer mirror);
-        # the collector thread ONLY does batched D2H transfers — one
-        # device_get per drain covers every pending window (per-call
-        # transfer latency through the device tunnel would otherwise put an
-        # RTT floor under every window).
-        import queue as _queue
-        import threading
+        # Pipelined windows: dispatched, not yet finalized (FIFO), all on the
+        # CALLER thread (single-writer mirror AND single client thread —
+        # a separate collector thread's blocking device reads were observed
+        # to serialize against dispatch through the device tunnel's client
+        # lock, stalling every dispatch ≈ one full step). D2H transfers are
+        # queued at dispatch time with copy_to_host_async, so by the time a
+        # window is finalized its results are usually already on host.
+        import collections
 
-        self._open = 0                      # handed off, not yet finalized
-        self._handoff: _queue.Queue[_Pending | None] = _queue.Queue()
-        self._done: _queue.Queue[_Pending] = _queue.Queue()
+        self._open = 0                      # dispatched, not yet finalized
+        self._pending: collections.deque[_Pending] = collections.deque()
         self._next_token = 0
-        #: First collector-thread failure since the last sync search();
-        #: async callers should check this after collect_ready()/flush().
+        #: First device failure since the last sync search(); async callers
+        #: should check this after collect_ready()/flush().
         self.device_error: BaseException | None = None
-        self._collector = threading.Thread(
-            target=self._collect_loop, name="tpu-engine-collector", daemon=True
-        )
-        self._collector.start()
         #: Stage spans (SURVEY.md §5 tracing): cumulative seconds + counts;
         #: read via span_report(). Written only on the caller thread.
         self.spans = {
             "windows": 0, "requests": 0, "matches": 0,
             "dispatch_s": 0.0,   # search_*_async host time (pack + H2D + jit)
             "turnaround_s": 0.0, # dispatch → finalized (device + collect)
+            "dedupe_s": 0.0, "alloc_s": 0.0, "pack_s": 0.0,
+            "h2d_s": 0.0, "jit_s": 0.0,
         }
 
     # ---- Engine API -------------------------------------------------------
@@ -200,40 +198,34 @@ class TpuEngine(Engine):
     # list only shrinks until release). Pipelining hides the host↔device
     # round trip, which otherwise puts a hard RTT floor under every window.
 
-    def _collect_loop(self) -> None:
-        """Collector thread: batched D2H of every pending window per drain."""
-        while True:
-            item = self._handoff.get()
-            if item is None:
-                return
-            batch = [item]
-            while True:
+    def _submit(self, pending: _Pending) -> None:
+        """Queue the window's D2H behind its execution and track it FIFO."""
+        for chunk in pending.chunks:
+            for h in chunk[1]:
                 try:
-                    nxt = self._handoff.get_nowait()
-                except Exception:
-                    break
-                if nxt is None:
-                    self._drain(batch)
-                    return
-                batch.append(nxt)
-            self._drain(batch)
+                    h.copy_to_host_async()
+                except AttributeError:  # pragma: no cover - non-Array types
+                    pass
+        self._open += 1
+        self._pending.append(pending)
 
-    def _drain(self, batch: list[_Pending]) -> None:
-        handles = [c[1] for p in batch for c in p.chunks]
+    @staticmethod
+    def _is_ready(pending: _Pending) -> bool:
         try:
-            # ONE device_get for every chunk of every pending window: the
-            # per-call round trip is paid once per drain, not per window.
-            flat = jax.device_get(handles)
-        except BaseException as e:  # surfaces on the caller thread
-            for p in batch:
-                p.error = e
-                self._done.put(p)
+            return all(h.is_ready() for c in pending.chunks for h in c[1])
+        except AttributeError:  # pragma: no cover - older jax arrays
+            return True
+
+    def _fetch(self, pending: _Pending) -> None:
+        """Materialize results on host (already transferred in the common
+        case); device failures are parked on the pending entry."""
+        if pending.raw is not None:
             return
-        i = 0
-        for p in batch:
-            p.raw = [tuple(flat[i + j]) for j in range(len(p.chunks))]
-            i += len(p.chunks)
-            self._done.put(p)
+        try:
+            pending.raw = [tuple(np.asarray(h) for h in c[1])
+                           for c in pending.chunks]
+        except BaseException as e:
+            pending.error = e
 
     def search_async(self, requests: Sequence[SearchRequest],
                      now: float) -> tuple[int, SearchOutcome]:
@@ -246,8 +238,7 @@ class TpuEngine(Engine):
             self._next_token += 1
             pending = _Pending(token=token, outcome=out)
             pending.raw = []
-            self._open += 1
-            self._handoff.put(pending)
+            self._submit(pending)
             return token, SearchOutcome()
 
         pending = _Pending(token=self._next_token)
@@ -266,8 +257,7 @@ class TpuEngine(Engine):
         max_bucket = self.buckets[-1]
         for start in range(0, len(fresh), max_bucket):
             self._dispatch(fresh[start:start + max_bucket], now, pending)
-        self._open += 1
-        self._handoff.put(pending)
+        self._submit(pending)
         return pending.token, SearchOutcome(
             rejected=list(pending.outcome.rejected))
 
@@ -291,6 +281,7 @@ class TpuEngine(Engine):
 
         ids = cols.ids.tolist()
         waiting = self.pool._slot_of
+        _t = time.perf_counter()
         if len(set(ids)) == len(ids):  # common case: no intra-window dups
             keep = np.fromiter((i not in waiting for i in ids), bool, len(ids))
         else:
@@ -302,12 +293,12 @@ class TpuEngine(Engine):
                     local.add(pid)
         if not keep.all():
             cols = cols.take(keep)
+        self.spans["dedupe_s"] += time.perf_counter() - _t
 
         max_bucket = self.buckets[-1]
         for start in range(0, len(cols), max_bucket):
             self._dispatch_cols(cols.slice(start, start + max_bucket), now, pending)
-        self._open += 1
-        self._handoff.put(pending)
+        self._submit(pending)
         self.spans["requests"] += len(cols)
         self.spans["dispatch_s"] += time.perf_counter() - t_start
         return pending.token
@@ -339,7 +330,8 @@ class TpuEngine(Engine):
             chunk = cols.slice(start, start + bucket)
             slots = self.pool.allocate_columns(chunk)
             batch = self.pool.batch_arrays_cols(chunk, slots, bucket, t0)
-            self._dev_pool = self.kernels.admit(self._dev_pool, _as_jnp(batch))
+            self._dev_pool = self.kernels.admit_packed(
+                self._dev_pool, jnp.asarray(pack_batch(batch)))
 
     def _dispatch_cols(self, cols: RequestColumns, now: float,
                        pending: _Pending) -> None:
@@ -354,14 +346,24 @@ class TpuEngine(Engine):
             cols = cols.slice(0, free)
             if not len(cols):
                 return
+        _t = time.perf_counter()
         slots = self.pool.allocate_columns(cols)
+        self.spans["alloc_s"] += time.perf_counter() - _t
         bucket = self._bucket_for(len(cols))
         t0 = self._rel_base(now)
+        _t = time.perf_counter()
         batch = self.pool.batch_arrays_cols(cols, slots, bucket, t0)
-        self._dev_pool, q_slot, c_slot, dist = self.kernels.search_step(
-            self._dev_pool, _as_jnp(batch), jnp.float32(now - t0)
+        packed = pack_batch(batch, now - t0)
+        self.spans["pack_s"] += time.perf_counter() - _t
+        _t = time.perf_counter()
+        packed_dev = jnp.asarray(packed)
+        self.spans["h2d_s"] += time.perf_counter() - _t
+        _t = time.perf_counter()
+        self._dev_pool, out = self.kernels.search_step_packed(
+            self._dev_pool, packed_dev
         )
-        pending.chunks.append(((cols, slots), (q_slot, c_slot, dist), now))
+        self.spans["jit_s"] += time.perf_counter() - _t
+        pending.chunks.append(((cols, slots), (out,), now))
 
     def span_report(self) -> dict[str, float]:
         """Per-window averages of the stage spans (ms)."""
@@ -372,6 +374,9 @@ class TpuEngine(Engine):
             "matches": self.spans["matches"],
             "dispatch_ms_avg": self.spans["dispatch_s"] / w * 1e3,
             "turnaround_ms_avg": self.spans["turnaround_s"] / w * 1e3,
+            **{k.replace("_s", "_ms_avg"): v / w * 1e3
+               for k, v in self.spans.items()
+               if k in ("dedupe_s", "alloc_s", "pack_s", "h2d_s", "jit_s")},
         }
 
     def inflight(self) -> int:
@@ -379,14 +384,13 @@ class TpuEngine(Engine):
         return self._open
 
     def collect_ready(self) -> list[tuple[int, SearchOutcome | ColumnarOutcome]]:
-        """Finalize every window whose results have landed (non-blocking).
+        """Finalize every window whose results have landed (non-blocking;
+        FIFO — a ready window behind an unfinished one waits its turn).
         Columnar windows yield ColumnarOutcome; object windows SearchOutcome."""
         done: list[tuple[int, SearchOutcome | ColumnarOutcome]] = []
-        while True:
-            try:
-                pending = self._done.get_nowait()
-            except Exception:
-                break
+        while self._pending and self._is_ready(self._pending[0]):
+            pending = self._pending.popleft()
+            self._fetch(pending)
             self._finalize(pending)
             done.append((pending.token,
                          pending.columnar if pending.columnar is not None
@@ -396,8 +400,9 @@ class TpuEngine(Engine):
     def flush(self) -> list[tuple[int, SearchOutcome | ColumnarOutcome]]:
         """Block until every in-flight window is collected and finalized."""
         done: list[tuple[int, SearchOutcome | ColumnarOutcome]] = []
-        while self._open > 0:
-            pending = self._done.get()
+        while self._pending:
+            pending = self._pending.popleft()
+            self._fetch(pending)
             self._finalize(pending)
             done.append((pending.token,
                          pending.columnar if pending.columnar is not None
@@ -405,8 +410,7 @@ class TpuEngine(Engine):
         return done
 
     def close(self) -> None:
-        """Stop the collector thread (used when the engine is replaced)."""
-        self._handoff.put(None)
+        """Release engine resources (nothing to stop — single-threaded)."""
 
     def remove(self, player_id: str) -> SearchRequest | None:
         if self._team_delegate is not None:
@@ -446,7 +450,8 @@ class TpuEngine(Engine):
             chunk = fresh[start:start + bucket]
             slots = self.pool.allocate(chunk)
             batch = self.pool.batch_arrays(chunk, slots, bucket, self._rel_base(now))
-            self._dev_pool = self.kernels.admit(self._dev_pool, _as_jnp(batch))
+            self._dev_pool = self.kernels.admit_packed(
+                self._dev_pool, jnp.asarray(pack_batch(batch)))
 
     # ---- internals --------------------------------------------------------
 
@@ -479,10 +484,10 @@ class TpuEngine(Engine):
         bucket = self._bucket_for(len(window))
         t0 = self._rel_base(now)
         batch = self.pool.batch_arrays(window, slots, bucket, t0)
-        self._dev_pool, q_slot, c_slot, dist = self.kernels.search_step(
-            self._dev_pool, _as_jnp(batch), jnp.float32(now - t0)
+        self._dev_pool, out = self.kernels.search_step_packed(
+            self._dev_pool, jnp.asarray(pack_batch(batch, now - t0))
         )
-        pending.chunks.append((list(window), (q_slot, c_slot, dist), now))
+        pending.chunks.append((list(window), (out,), now))
 
     def _finalize(self, pending: _Pending) -> None:
         """Map one window's collected results back to requests. Runs on the
@@ -517,8 +522,11 @@ class TpuEngine(Engine):
         if self._team_device:
             self._finalize_team(pending)
             return
-        for (window, _, now), (q_slot, c_slot, dist) in zip(
+        for (window, _, now), (packed_out,) in zip(
                 pending.chunks, pending.raw or ()):
+            q_slot = packed_out[0].astype(np.int32)
+            c_slot = packed_out[1].astype(np.int32)
+            dist = packed_out[2]
             P = self.kernels.capacity
             matched_ids: set[str] = set()
             hit = q_slot < P
@@ -563,9 +571,12 @@ class TpuEngine(Engine):
         out = pending.columnar
         assert out is not None
         pool = self.pool
-        for (payload, _, now), (q_slot, c_slot, dist) in zip(
+        for (payload, _, now), (packed_out,) in zip(
                 pending.chunks, pending.raw or ()):
             cols, slots = payload
+            q_slot = packed_out[0].astype(np.int32)
+            c_slot = packed_out[1].astype(np.int32)
+            dist = packed_out[2]
             P = self.kernels.capacity
             hit = q_slot < P
             qs, cs, d = q_slot[hit], c_slot[hit], dist[hit]
@@ -579,8 +590,7 @@ class TpuEngine(Engine):
                     np.clip(1.0 - d / np.maximum(limit, 1e-30), 0.0, 1.0),
                     0.0,
                 ).astype(np.float32)
-                match_ids = np.fromiter(
-                    (new_match_id() for _ in range(qs.size)), object, qs.size)
+                match_ids = new_match_ids(qs.size)
                 out.m_id_a = np.concatenate([out.m_id_a, ids_a])
                 out.m_id_b = np.concatenate([out.m_id_b, ids_b])
                 out.m_match_id = np.concatenate([out.m_match_id, match_ids])
@@ -590,6 +600,8 @@ class TpuEngine(Engine):
                 out.m_reply_b = np.concatenate([out.m_reply_b, pool.m_reply[cs]])
                 out.m_corr_a = np.concatenate([out.m_corr_a, pool.m_corr[qs]])
                 out.m_corr_b = np.concatenate([out.m_corr_b, pool.m_corr[cs]])
+                out.m_enq_a = np.concatenate([out.m_enq_a, pool.m_enqueued[qs]])
+                out.m_enq_b = np.concatenate([out.m_enq_b, pool.m_enqueued[cs]])
                 matched = np.concatenate([qs, cs])
                 pool.release(matched)
                 queued_ids = cols.ids[~np.isin(slots, matched)]
@@ -604,8 +616,12 @@ class TpuEngine(Engine):
         the device kernel validated the sum constraint with the same signed
         pattern, which is tie-order invariant, see teams.snake_signs)."""
         out = pending.outcome
-        for (window, _, now), (slots, spread, limit) in zip(
+        need = self.kernels.need
+        for (window, _, now), (packed_out,) in zip(
                 pending.chunks, pending.raw or ()):
+            slots = packed_out[:need].T.astype(np.int32)
+            spread = packed_out[need]
+            limit = packed_out[need + 1]
             P = self.kernels.capacity
             matched_ids: set[str] = set()
             hit = slots[:, 0] < P

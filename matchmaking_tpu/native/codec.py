@@ -1,0 +1,130 @@
+"""ctypes binding for the native batch wire decoder (native/codec.cc).
+
+One C call decodes a window of raw AMQP JSON bodies into RequestColumns
+arrays (the engine's columnar fast path); rows flagged NEEDS_PYTHON (parties,
+roles, string escapes) or invalid fall back to ``contract.decode_request`` —
+the semantic source of truth whose validation the C++ mirrors (equivalence
+pinned by tests/test_native_codec.py).
+
+The library builds lazily with g++ (no deps; ~1 s once, cached next to the
+source). Everything degrades to pure Python when g++ or the build is
+unavailable — the native layer is an accelerator, never a requirement.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "native", "codec.cc")
+_LIB = os.path.join(os.path.dirname(_SRC), "libmmcodec.so")
+
+# Status codes (keep in sync with codec.cc).
+OK = 0
+NEEDS_PYTHON = 1
+_ERROR_CODES = {
+    2: "bad_json",
+    3: "missing_field",
+    4: "bad_type",
+    5: "bad_rating",
+    6: "bad_threshold",
+}
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_build_failed = False
+
+
+def _load() -> ctypes.CDLL | None:
+    """Build (once) and load the shared library; None if unavailable."""
+    global _lib, _build_failed
+    if _lib is not None or _build_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        try:
+            if (not os.path.exists(_LIB)
+                    or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+                subprocess.run(
+                    ["g++", "-O2", "-shared", "-fPIC", "-o", _LIB, _SRC],
+                    check=True, capture_output=True, timeout=120)
+            lib = ctypes.CDLL(_LIB)
+            lib.mm_decode_requests.restype = ctypes.c_int64
+            lib.mm_decode_requests.argtypes = [
+                ctypes.POINTER(ctypes.c_char_p),          # bufs
+                np.ctypeslib.ndpointer(np.int32),         # lens
+                ctypes.c_int32,                           # n
+                np.ctypeslib.ndpointer(np.float32),       # rating
+                np.ctypeslib.ndpointer(np.float32),       # rd
+                np.ctypeslib.ndpointer(np.float32),       # threshold
+                np.ctypeslib.ndpointer(np.int32),         # status
+                ctypes.c_char_p,                          # arena
+                ctypes.c_int64,                           # cap
+                np.ctypeslib.ndpointer(np.int64),         # id_off
+                np.ctypeslib.ndpointer(np.int64),         # region_off
+                np.ctypeslib.ndpointer(np.int64),         # mode_off
+            ]
+            _lib = lib
+        except Exception:
+            log.exception("native codec unavailable; using pure-Python decode")
+            _build_failed = True
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def decode_batch(bodies: list[bytes]):
+    """Decode a window of JSON bodies natively.
+
+    Returns (ids, rating, rd, threshold, region_names, mode_names, status)
+    where string columns are object arrays ("" region/mode = wildcard) and
+    ``status`` is int32 per row (OK / NEEDS_PYTHON / error codes — map via
+    ``error_code``). Returns None when the native library is unavailable.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(bodies)
+    lens = np.fromiter((len(b) for b in bodies), np.int32, n)
+    bufs = (ctypes.c_char_p * n)(*bodies)
+    rating = np.empty(n, np.float32)
+    rd = np.empty(n, np.float32)
+    threshold = np.empty(n, np.float32)
+    status = np.empty(n, np.int32)
+    id_off = np.empty(n + 1, np.int64)
+    region_off = np.empty(n + 1, np.int64)
+    mode_off = np.empty(n + 1, np.int64)
+    cap = int(lens.sum()) + 16
+    arena = ctypes.create_string_buffer(cap)
+    used = lib.mm_decode_requests(
+        bufs, lens, n, rating, rd, threshold, status, arena, cap,
+        id_off, region_off, mode_off)
+    if used < 0:  # arena overflow cannot happen (strings ⊆ input), but guard
+        return None
+    raw = arena.raw
+    ids = np.empty(n, object)
+    regions = np.empty(n, object)
+    modes = np.empty(n, object)
+    for i in range(n):
+        if status[i] == OK:
+            ids[i] = raw[id_off[i]:region_off[i]].decode()
+            regions[i] = raw[region_off[i]:mode_off[i]].decode()
+            modes[i] = raw[mode_off[i]:id_off[i + 1]].decode()
+        else:
+            ids[i] = regions[i] = modes[i] = ""
+    return ids, rating, rd, threshold, regions, modes, status
+
+
+def error_code(status: int) -> str:
+    return _ERROR_CODES.get(int(status), "bad_json")

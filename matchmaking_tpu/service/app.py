@@ -36,6 +36,7 @@ from matchmaking_tpu.service.middleware import (
     MessageContext,
     MiddlewareReject,
     Pipeline,
+    columnar_pipeline,
     default_pipeline,
 )
 from matchmaking_tpu.utils.metrics import Metrics
@@ -50,7 +51,16 @@ class _QueueRuntime:
         self.app = app
         self.queue_cfg = queue_cfg
         self.engine: Engine = make_engine(app.cfg, queue_cfg)
-        self.pipeline: Pipeline = default_pipeline(app.cfg.auth, app.broker)
+        # Columnar ingress (1v1 queues on a columnar-capable engine): decode
+        # is deferred to the batched native codec at flush time.
+        self._columnar = (
+            queue_cfg.team_size == 1 and not queue_cfg.role_slots
+            and hasattr(self.engine, "search_columns_async")
+        )
+        self.pipeline: Pipeline = (
+            columnar_pipeline(app.cfg.auth, app.broker) if self._columnar
+            else default_pipeline(app.cfg.auth, app.broker)
+        )
         self.batcher: Batcher = Batcher(app.cfg.batcher, self._flush)
         # Serializes ALL engine access (window flushes vs the timeout
         # sweeper): engines are single-writer objects with no internal locks.
@@ -82,12 +92,20 @@ class _QueueRuntime:
             self._respond_error(delivery, e.code, e.reason)
             self.app.broker.ack(self.consumer_tag, delivery.delivery_tag)
             return
-        assert ctx.request is not None
+        if ctx.request is None:
+            # Columnar ingress: the pipeline left decoding to the batched
+            # native codec (1v1 queues) — middleware only ran auth/validity
+            # checks that need headers.
+            self.batcher.submit((None, delivery))
+            return
         self.batcher.submit((ctx.request, delivery))
 
     # ---- the window flush: THE seam into Engine.search --------------------
 
     async def _flush(self, window: list[tuple[SearchRequest, Delivery]]) -> None:
+        if self._columnar:
+            await self._flush_columnar([d for _, d in window])
+            return
         now = time.time()
         # At-least-once dedup: a redelivered copy of a request whose player
         # already reached a terminal state must not re-enter the pool (the
@@ -129,6 +147,196 @@ class _QueueRuntime:
         self.app.metrics.counters.inc("windows")
         self.app.metrics.counters.inc("requests_batched", len(window))
 
+    async def _flush_columnar(self, deliveries: list[Delivery]) -> None:
+        """Columnar window flush: batched native decode → RequestColumns →
+        pipelined columnar engine step → responses from ColumnarOutcome.
+
+        Per-delivery Python is reduced to dict lookups (dedup cache) and the
+        rows the native codec flags NEEDS_PYTHON (parties/escapes), which
+        re-decode through contract.decode_request — the semantic truth."""
+        import numpy as np
+
+        from matchmaking_tpu.native import codec
+        from matchmaking_tpu.service.contract import (
+            ContractError,
+            MatchResult,
+            RequestColumns,
+            decode_request,
+        )
+
+        now = time.time()
+        self._prune_recent(now)
+        bodies = [bytes(d.body) for d in deliveries]
+        native = codec.decode_batch(bodies) if codec.available() else None
+
+        def first_received(delivery: Delivery) -> float:
+            # Client-settable header: a non-numeric value must not crash the
+            # whole window flush (it would strand every delivery in it).
+            try:
+                return float(delivery.properties.headers.get(
+                    "x-first-received", now))
+            except (TypeError, ValueError):
+                return now
+
+        lanes: list[tuple[str, float, float, float, str, str, float, Delivery]] = []
+        for i, delivery in enumerate(deliveries):
+            if native is not None and native[6][i] == codec.OK:
+                ids, rating, rd, thr, regions, modes, _status = (
+                    native[0], native[1], native[2], native[3], native[4],
+                    native[5], native[6])
+                row = (ids[i], float(rating[i]), float(rd[i]), float(thr[i]),
+                       regions[i], modes[i], first_received(delivery), delivery)
+            elif native is not None and native[6][i] not in (codec.OK,
+                                                             codec.NEEDS_PYTHON):
+                self.app.metrics.counters.inc("rejected_by_middleware")
+                self._respond_error(delivery, codec.error_code(native[6][i]),
+                                    "malformed payload")
+                self.app.broker.ack(self.consumer_tag, delivery.delivery_tag)
+                continue
+            else:
+                # Python fallback (codec unavailable or NEEDS_PYTHON row).
+                try:
+                    req = decode_request(
+                        delivery.body,
+                        reply_to=delivery.properties.reply_to,
+                        correlation_id=delivery.properties.correlation_id,
+                        queue=self.queue_cfg.name,
+                        enqueued_at=first_received(delivery),
+                    )
+                except ContractError as e:
+                    self.app.metrics.counters.inc("rejected_by_middleware")
+                    self._respond_error(delivery, e.code, e.reason)
+                    self.app.broker.ack(self.consumer_tag, delivery.delivery_tag)
+                    continue
+                if req.party_size > 1:
+                    # 1v1 queue: parties are unservable (oracle semantics).
+                    self.app.metrics.counters.inc("rejected_by_engine")
+                    self._respond_error(delivery, "party_not_supported",
+                                        "engine rejected request: party_not_supported")
+                    self.app.broker.ack(self.consumer_tag, delivery.delivery_tag)
+                    continue
+                row = (req.id, req.rating, req.rating_deviation,
+                       (np.nan if req.rating_threshold is None
+                        else req.rating_threshold),
+                       "" if req.region == "*" else req.region,
+                       "" if req.game_mode == "*" else req.game_mode,
+                       req.enqueued_at, delivery)
+            # At-least-once dedup: replay terminal responses.
+            cached = self._recent.get(row[0])
+            if cached is not None and cached[1] <= now:
+                del self._recent[row[0]]
+                cached = None
+            if cached is not None:
+                self.app.metrics.counters.inc("deduped_replays")
+                self._respond_raw(delivery.properties.reply_to,
+                                  delivery.properties.correlation_id, cached[0])
+                self.app.broker.ack(self.consumer_tag, delivery.delivery_tag)
+                continue
+            lanes.append(row)
+
+        if not lanes:
+            return
+        n = len(lanes)
+        interner_r = self.engine.pool.regions.code
+        interner_m = self.engine.pool.modes.code
+        cols = RequestColumns(
+            ids=np.fromiter((r[0] for r in lanes), object, n),
+            rating=np.fromiter((r[1] for r in lanes), np.float32, n),
+            rd=np.fromiter((r[2] for r in lanes), np.float32, n),
+            region=np.fromiter(
+                (0 if r[4] in ("", "*") else interner_r(r[4]) for r in lanes),
+                np.int32, n),
+            mode=np.fromiter(
+                (0 if r[5] in ("", "*") else interner_m(r[5]) for r in lanes),
+                np.int32, n),
+            threshold=np.fromiter((r[3] for r in lanes), np.float32, n),
+            enqueued_at=np.fromiter((r[6] for r in lanes), np.float64, n),
+            reply_to=np.fromiter(
+                (r[7].properties.reply_to for r in lanes), object, n),
+            correlation_id=np.fromiter(
+                (r[7].properties.correlation_id for r in lanes), object, n),
+        )
+        by_id = {r[0]: r[7] for r in lanes}
+
+        def run_engine():
+            # Dispatch + flush together OFF the event loop: first-window jit
+            # compilation and per-window pack/H2D host work would otherwise
+            # freeze every other queue's consumers, sweepers, and auth RPC
+            # deadlines (same hazard the object path's to_thread comment
+            # documents).
+            self.engine.search_columns_async(cols, now)
+            return self.engine.flush()
+
+        try:
+            async with self._engine_lock:
+                outs = await asyncio.to_thread(run_engine)
+            if self.engine.device_error is not None:
+                err, self.engine.device_error = self.engine.device_error, None
+                raise err
+        except Exception:
+            log.exception("engine step crashed; reviving engine from mirror")
+            self.app.metrics.counters.inc("engine_crashes")
+            self._revive_engine(now)
+            for r in lanes:
+                self.app.broker.nack(self.consumer_tag,
+                                     r[7].delivery_tag, requeue=True)
+            return
+
+        m = self.app.metrics
+        for _tok, out in outs:
+            if self._invariants is not None:
+                self._invariants.observe_outcome(out)
+            for j in range(out.n_matches):
+                id_a, id_b = out.m_id_a[j], out.m_id_b[j]
+                result = MatchResult(
+                    match_id=out.m_match_id[j], players=(id_a, id_b),
+                    teams=((id_a,), (id_b,)),
+                    quality=float(out.m_quality[j]),
+                )
+                self._publish_matched(id_a, out.m_reply_a[j], out.m_corr_a[j],
+                                      float(out.m_enq_a[j]), result, now)
+                self._publish_matched(id_b, out.m_reply_b[j], out.m_corr_b[j],
+                                      float(out.m_enq_b[j]), result, now)
+            if self.queue_cfg.send_queued_ack:
+                for pid in out.q_ids:
+                    d = by_id.get(pid)
+                    if d is not None:
+                        self._respond_raw(
+                            d.properties.reply_to, d.properties.correlation_id,
+                            SearchResponse(status="queued", player_id=pid))
+            for pid, code in out.rejected:
+                m.counters.inc("rejected_by_engine")
+                d = by_id.get(pid)
+                if d is not None:
+                    self._respond_error(d, code,
+                                        f"engine rejected request: {code}")
+        for r in lanes:
+            self.app.broker.ack(self.consumer_tag, r[7].delivery_tag)
+        m.counters.inc("windows")
+        m.counters.inc("requests_batched", n)
+
+    def _publish_matched(self, pid: str, reply_to: str, correlation_id: str,
+                         enqueued_at: float, result, now: float) -> None:
+        """One matched player's response + metrics + dedup memory — the
+        single place the 'matched' response is built (object AND columnar
+        flush paths both come through here; keep them from diverging)."""
+        m = self.app.metrics
+        m.counters.inc("players_matched")
+        if enqueued_at:
+            m.record_latency("match_wait", now - enqueued_at)
+        resp = SearchResponse(
+            status="matched", player_id=pid, match=result,
+            latency_ms=(now - enqueued_at) * 1e3 if enqueued_at else 0.0)
+        self._remember(pid, resp, now)
+        self._respond_raw(reply_to, correlation_id, resp)
+
+    def _respond_raw(self, reply_to: str, correlation_id: str,
+                     resp: SearchResponse) -> None:
+        if not reply_to:
+            return
+        self.app.broker.publish(reply_to, encode_response(resp),
+                                Properties(correlation_id=correlation_id))
+
     def _revive_engine(self, now: float) -> None:
         """Elastic recovery: rebuild the engine and resubmit the pool from
         the authoritative host mirror (SURVEY.md §5)."""
@@ -153,15 +361,8 @@ class _QueueRuntime:
         for match in outcome.matches:
             result = match.result()
             for req in match.requests():
-                m.counters.inc("players_matched")
-                if req.enqueued_at:
-                    m.record_latency("match_wait", now - req.enqueued_at)
-                resp = SearchResponse(
-                    status="matched", player_id=req.id, match=result,
-                    latency_ms=(now - req.enqueued_at) * 1e3 if req.enqueued_at else 0.0,
-                )
-                self._remember(req.id, resp, now)
-                self._respond(req, resp)
+                self._publish_matched(req.id, req.reply_to, req.correlation_id,
+                                      req.enqueued_at, result, now)
         if self.queue_cfg.send_queued_ack:
             for req in outcome.queued:
                 self._respond(req, SearchResponse(status="queued", player_id=req.id))

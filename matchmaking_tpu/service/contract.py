@@ -40,8 +40,8 @@ Response payload:
 
 from __future__ import annotations
 
-import itertools
 import json
+import threading
 import uuid
 from dataclasses import dataclass, field
 from typing import Any, Mapping, Sequence
@@ -350,13 +350,35 @@ class RequestColumns:
 
 
 _match_id_prefix = uuid.uuid4().hex[:16]
-_match_id_counter = itertools.count(1)
+_match_id_lock = threading.Lock()
+_match_id_next = 1
+
+
+def _claim_match_ids(n: int) -> int:
+    """Atomically claim a contiguous id range; returns its start."""
+    global _match_id_next
+    with _match_id_lock:
+        start = _match_id_next
+        _match_id_next += n
+    return start
 
 
 def new_match_id() -> str:
     """Unique match id: random per-process prefix + counter. A full uuid4
     per match costs ~5 µs — measurable at >10^4 matches/sec — while the
-    prefix keeps ids unique across processes/restarts. ``next()`` on an
-    itertools.count is atomic, so concurrent queue runtimes (each finalizing
-    on its own executor thread) can't mint duplicates."""
-    return f"{_match_id_prefix}{next(_match_id_counter):012x}"
+    prefix keeps ids unique across processes/restarts. The shared lock keeps
+    concurrent queue runtimes (each finalizing on its own executor thread)
+    from minting duplicates."""
+    return f"{_match_id_prefix}{_claim_match_ids(1):012x}"
+
+
+def new_match_ids(n: int) -> "np.ndarray":
+    """Vectorized match-id mint: object[n]. One locked range claim + one
+    numpy formatting pass (a Python round per match costs ~1 ms per 10^3
+    matches — measurable in window finalize)."""
+    if n == 0:
+        return np.empty(0, object)
+    start = _claim_match_ids(n)
+    nums = np.arange(start, start + n, dtype=np.uint64)
+    hexes = np.char.rjust(np.char.mod("%x", nums.astype(object)), 12, "0")
+    return np.char.add(_match_id_prefix, hexes).astype(object)

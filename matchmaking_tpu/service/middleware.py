@@ -120,5 +120,21 @@ class AuthMiddleware(Middleware):
         await next()
 
 
+class StampMiddleware(Middleware):
+    """Columnar-ingress variant of DecodeMiddleware's clock stamping: decode
+    itself is deferred to the batched native codec at flush time (one C call
+    per window instead of json.loads per delivery), but the first-receive
+    time must still be stamped here so redelivery keeps the wait clock."""
+
+    async def call(self, ctx: MessageContext, next: Next) -> None:  # noqa: A002
+        ctx.delivery.properties.headers.setdefault(
+            "x-first-received", ctx.received_at)
+        await next()
+
+
 def default_pipeline(auth_cfg: AuthConfig, broker: InProcBroker) -> Pipeline:
     return Pipeline([DecodeMiddleware(), AuthMiddleware(auth_cfg, broker)])
+
+
+def columnar_pipeline(auth_cfg: AuthConfig, broker: InProcBroker) -> Pipeline:
+    return Pipeline([StampMiddleware(), AuthMiddleware(auth_cfg, broker)])

@@ -1,0 +1,305 @@
+// Batch wire-request decoder: raw JSON bodies -> columnar arrays.
+//
+// The rebuild's native runtime component (SURVEY.md §2: the reference's
+// native layer is the BEAM VM + Erlang AMQP stack; here the hot host-side
+// loop is the wire codec, so it is C++). One call decodes a whole window of
+// AMQP message bodies into the engine's RequestColumns layout; rows the fast
+// path cannot express (parties, roles, escaped strings) are flagged
+// NEEDS_PYTHON and re-decoded by the Python contract module (exact same
+// validation rules — contract.decode_request is the semantic source of
+// truth, and tests hold the two decoders to identical outputs).
+//
+// Build: g++ -O2 -shared -fPIC -o libmmcodec.so codec.cc   (no deps)
+// Binding: ctypes (matchmaking_tpu/native/codec.py).
+
+#include <cctype>
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+#include <cmath>
+
+namespace {
+
+enum Status : int32_t {
+  OK = 0,
+  NEEDS_PYTHON = 1,   // party/roles present, escapes, or anything exotic
+  BAD_JSON = 2,
+  MISSING_FIELD = 3,
+  BAD_TYPE = 4,
+  BAD_RATING = 5,
+  BAD_THRESHOLD = 6,
+};
+
+struct Cursor {
+  const char* p;
+  const char* end;
+  bool ok = true;
+
+  bool done() const { return p >= end; }
+  char peek() const { return p < end ? *p : '\0'; }
+  void skip_ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) ++p;
+  }
+};
+
+// Skip any JSON value (used for keys we ignore). Depth-counted, no
+// allocation. Returns false on malformed input.
+bool skip_value(Cursor& c);
+
+bool skip_string(Cursor& c) {
+  // Assumes *c.p == '"'.
+  ++c.p;
+  while (c.p < c.end) {
+    char ch = *c.p++;
+    if (ch == '\\') {
+      if (c.p < c.end) ++c.p;  // skip escaped char (incl. start of \uXXXX)
+      continue;
+    }
+    if (ch == '"') return true;
+  }
+  return false;
+}
+
+bool skip_number(Cursor& c) {
+  const char* start = c.p;
+  while (c.p < c.end && (isdigit((unsigned char)*c.p) || *c.p == '-' ||
+                         *c.p == '+' || *c.p == '.' || *c.p == 'e' ||
+                         *c.p == 'E'))
+    ++c.p;
+  return c.p > start;
+}
+
+bool skip_literal(Cursor& c, const char* lit, size_t len) {
+  if ((size_t)(c.end - c.p) < len || strncmp(c.p, lit, len) != 0) return false;
+  c.p += len;
+  return true;
+}
+
+bool skip_container(Cursor& c, char open, char close) {
+  // Assumes *c.p == open.
+  int depth = 0;
+  while (c.p < c.end) {
+    char ch = *c.p;
+    if (ch == '"') {
+      if (!skip_string(c)) return false;
+      continue;
+    }
+    ++c.p;
+    if (ch == open) ++depth;
+    else if (ch == close) {
+      if (--depth == 0) return true;
+    }
+  }
+  return false;
+}
+
+bool skip_value(Cursor& c) {
+  c.skip_ws();
+  char ch = c.peek();
+  if (ch == '"') return skip_string(c);
+  if (ch == '{') return skip_container(c, '{', '}');
+  if (ch == '[') return skip_container(c, '[', ']');
+  if (ch == 't') return skip_literal(c, "true", 4);
+  if (ch == 'f') return skip_literal(c, "false", 5);
+  if (ch == 'n') return skip_literal(c, "null", 4);
+  return skip_number(c);
+}
+
+// Parse a string value without escapes into [out, out+cap). Returns length,
+// -1 on escape/overflow (-> NEEDS_PYTHON), -2 on malformed.
+int parse_plain_string(Cursor& c, char* out, int cap) {
+  if (c.peek() != '"') return -2;
+  ++c.p;
+  int n = 0;
+  while (c.p < c.end) {
+    char ch = *c.p++;
+    if (ch == '"') return n;
+    if (ch == '\\') return -1;
+    if (n >= cap) return -1;
+    out[n++] = ch;
+  }
+  return -2;
+}
+
+struct Number {
+  double value;
+  bool is_number;
+};
+
+Number parse_number(Cursor& c) {
+  char buf[64];
+  const char* start = c.p;
+  if (!skip_number(c) || c.p - start >= (long)sizeof(buf)) return {0.0, false};
+  size_t len = c.p - start;
+  memcpy(buf, start, len);
+  buf[len] = '\0';
+  char* endp = nullptr;
+  double v = strtod(buf, &endp);
+  return {v, endp == buf + len};
+}
+
+constexpr int kMaxStr = 256;  // per-field cap for id/region/mode strings
+
+struct Row {
+  char id[kMaxStr]; int id_len = -1;
+  char region[kMaxStr]; int region_len = -1;
+  char mode[kMaxStr]; int mode_len = -1;
+  double rating = 0.0; bool has_rating = false;
+  double rd = 350.0;
+  double threshold = NAN;
+  int32_t status = OK;
+};
+
+bool key_is(const char* key, int len, const char* name) {
+  return (int)strlen(name) == len && memcmp(key, name, len) == 0;
+}
+
+void decode_one(const char* buf, int len, Row& row) {
+  Cursor c{buf, buf + len};
+  c.skip_ws();
+  if (c.peek() != '{') { row.status = BAD_JSON; return; }
+  ++c.p;
+  bool first = true;
+  while (true) {
+    c.skip_ws();
+    if (c.peek() == '}') { ++c.p; break; }
+    if (!first) {
+      if (c.peek() != ',') { row.status = BAD_JSON; return; }
+      // (comma consumed below after detecting it's not the first pair)
+    }
+    if (c.peek() == ',') ++c.p;
+    first = false;
+    c.skip_ws();
+    char key[64];
+    int klen = parse_plain_string(c, key, sizeof(key));
+    if (klen == -1) { row.status = NEEDS_PYTHON; return; }
+    if (klen < 0) { row.status = BAD_JSON; return; }
+    c.skip_ws();
+    if (c.peek() != ':') { row.status = BAD_JSON; return; }
+    ++c.p;
+    c.skip_ws();
+
+    if (key_is(key, klen, "id")) {
+      row.id_len = parse_plain_string(c, row.id, kMaxStr);
+      if (row.id_len == -1) { row.status = NEEDS_PYTHON; return; }
+      if (row.id_len < 0) {
+        // Non-string id: bools/numbers are a type error per contract.
+        if (!skip_value(c)) { row.status = BAD_JSON; return; }
+        row.status = BAD_TYPE; return;
+      }
+    } else if (key_is(key, klen, "region")) {
+      row.region_len = parse_plain_string(c, row.region, kMaxStr);
+      if (row.region_len == -1) { row.status = NEEDS_PYTHON; return; }
+      if (row.region_len < 0) {
+        // contract: str(payload.get(...)) — non-strings coerce; punt.
+        row.status = NEEDS_PYTHON;
+        if (!skip_value(c)) row.status = BAD_JSON;
+        return;
+      }
+    } else if (key_is(key, klen, "game_mode")) {
+      row.mode_len = parse_plain_string(c, row.mode, kMaxStr);
+      if (row.mode_len == -1) { row.status = NEEDS_PYTHON; return; }
+      if (row.mode_len < 0) {
+        row.status = NEEDS_PYTHON;
+        if (!skip_value(c)) row.status = BAD_JSON;
+        return;
+      }
+    } else if (key_is(key, klen, "rating")) {
+      if (c.peek() == 't' || c.peek() == 'f') { row.status = BAD_TYPE; return; }
+      Number num = parse_number(c);
+      if (!num.is_number) { row.status = BAD_TYPE; return; }
+      row.rating = num.value; row.has_rating = true;
+    } else if (key_is(key, klen, "rating_deviation")) {
+      if (c.peek() == 't' || c.peek() == 'f') { row.status = BAD_TYPE; return; }
+      Number num = parse_number(c);
+      if (!num.is_number) { row.status = BAD_TYPE; return; }
+      row.rd = num.value;
+    } else if (key_is(key, klen, "rating_threshold")) {
+      if (c.peek() == 't' || c.peek() == 'f') { row.status = BAD_TYPE; return; }
+      Number num = parse_number(c);
+      if (!num.is_number) { row.status = BAD_TYPE; return; }
+      row.threshold = num.value;
+    } else if (key_is(key, klen, "roles") || key_is(key, klen, "party")) {
+      // Non-empty arrays need the full Python decoder; [] is a no-op.
+      c.skip_ws();
+      if (c.peek() == '[') {
+        const char* probe = c.p + 1;
+        while (probe < c.end && (*probe == ' ' || *probe == '\n' ||
+                                 *probe == '\t' || *probe == '\r'))
+          ++probe;
+        if (probe < c.end && *probe == ']') {
+          c.p = probe + 1;
+        } else {
+          row.status = NEEDS_PYTHON;
+          return;
+        }
+      } else {
+        row.status = BAD_TYPE; return;
+      }
+    } else {
+      if (!skip_value(c)) { row.status = BAD_JSON; return; }
+    }
+  }
+  c.skip_ws();
+  if (!c.done()) { row.status = BAD_JSON; return; }
+
+  // Validation, mirroring contract.decode_request.
+  if (row.id_len < 0 || !row.has_rating) { row.status = MISSING_FIELD; return; }
+  if (!(row.rating > -1e5 && row.rating < 1e5)) { row.status = BAD_RATING; return; }
+  if (row.rd < 0) { row.status = BAD_RATING; return; }
+  if (!std::isnan(row.threshold) && row.threshold <= 0) {
+    row.status = BAD_THRESHOLD; return;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Decode n message bodies. Outputs (caller-allocated):
+//   rating[n] f32, rd[n] f32, threshold[n] f32 (NaN = absent),
+//   status[n] i32, arena char buffer (cap bytes) holding id/region/mode
+//   bytes back-to-back, offsets id_off/region_off/mode_off each [n+1]
+//   (empty string = region/mode absent -> wildcard).
+// Returns bytes used in arena, or -1 if the arena overflowed (caller
+// retries with a bigger arena).
+int64_t mm_decode_requests(const char** bufs, const int32_t* lens, int32_t n,
+                           float* rating, float* rd, float* threshold,
+                           int32_t* status, char* arena, int64_t cap,
+                           int64_t* id_off, int64_t* region_off,
+                           int64_t* mode_off) {
+  int64_t used = 0;
+  for (int32_t i = 0; i < n; ++i) {
+    Row row;
+    decode_one(bufs[i], lens[i], row);
+    status[i] = row.status;
+    rating[i] = (float)row.rating;
+    rd[i] = (float)row.rd;
+    threshold[i] = (float)row.threshold;
+    id_off[i] = used;
+    if (row.status == OK) {
+      if (used + row.id_len > cap) return -1;
+      memcpy(arena + used, row.id, row.id_len);
+      used += row.id_len;
+    }
+    region_off[i] = used;
+    if (row.status == OK && row.region_len > 0) {
+      if (used + row.region_len > cap) return -1;
+      memcpy(arena + used, row.region, row.region_len);
+      used += row.region_len;
+    }
+    mode_off[i] = used;
+    if (row.status == OK && row.mode_len > 0) {
+      if (used + row.mode_len > cap) return -1;
+      memcpy(arena + used, row.mode, row.mode_len);
+      used += row.mode_len;
+    }
+    // Sentinel end for row i is the next row's id_off (or final `used`).
+  }
+  id_off[n] = used;
+  region_off[n] = used;  // unused; kept for symmetric shape
+  mode_off[n] = used;
+  return used;
+}
+
+}  // extern "C"

@@ -1,0 +1,122 @@
+"""Native batch wire decoder (native/codec.cc) vs contract.decode_request —
+the Python decoder is the semantic source of truth; every native row must
+agree (value-exact for OK rows, same error class for bad rows, NEEDS_PYTHON
+rows re-decoded by Python must succeed)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from matchmaking_tpu.native import codec
+from matchmaking_tpu.service.contract import ANY, ContractError, decode_request
+
+pytestmark = pytest.mark.skipif(not codec.available(),
+                                reason="native codec unavailable (no g++?)")
+
+
+def _native_rows(bodies):
+    out = codec.decode_batch(bodies)
+    assert out is not None
+    return out
+
+
+class TestAgainstPythonDecoder:
+    def test_plain_requests_exact(self):
+        bodies = [
+            b'{"id":"alice","rating":1500}',
+            b'{"id":"bob","rating":1540.25,"rating_deviation":120.5}',
+            b'{"id":"c","rating":-300,"region":"eu","game_mode":"ranked"}',
+            b'{"id":"d","rating":0,"rating_threshold":42.5}',
+            b'{"event-name":"matchmaking.search","id":"e","rating":7}',
+            b'  {  "id" : "f" , "rating" : 12e2 }  ',
+        ]
+        ids, rating, rd, thr, regions, modes, status = _native_rows(bodies)
+        for i, body in enumerate(bodies):
+            py = decode_request(body)
+            assert status[i] == codec.OK
+            assert ids[i] == py.id
+            assert rating[i] == pytest.approx(py.rating, rel=1e-6)
+            assert rd[i] == pytest.approx(py.rating_deviation, rel=1e-6)
+            if py.rating_threshold is None:
+                assert np.isnan(thr[i])
+            else:
+                assert thr[i] == pytest.approx(py.rating_threshold, rel=1e-6)
+            assert (regions[i] or ANY) == py.region
+            assert (modes[i] or ANY) == py.game_mode
+
+    def test_error_rows_same_code(self):
+        cases = [
+            b"not json at all",
+            b"[1,2,3]",
+            b'{"rating":1500}',                       # missing id
+            b'{"id":"x"}',                           # missing rating
+            b'{"id":"x","rating":"high"}',           # bad type
+            b'{"id":"x","rating":true}',             # bool rating
+            b'{"id":7,"rating":1500}',               # non-string id
+            b'{"id":"x","rating":1e7}',              # out of range
+            b'{"id":"x","rating":1500,"rating_deviation":-1}',
+            b'{"id":"x","rating":1500,"rating_threshold":0}',
+            b'{"id":"x","rating":1500,"party":"nope"}',
+        ]
+        ids, *_rest, status = _native_rows(cases)
+        for i, body in enumerate(cases):
+            with pytest.raises(ContractError) as err:
+                decode_request(body)
+            if status[i] == codec.NEEDS_PYTHON:
+                continue  # fallback path reports the Python error — fine
+            assert status[i] != codec.OK, body
+            assert codec.error_code(status[i]) == err.value.code, body
+
+    def test_complex_rows_flagged_for_python(self):
+        bodies = [
+            b'{"id":"p","rating":1,"roles":["tank","dps"]}',
+            b'{"id":"p","rating":1,"party":[{"id":"q","rating":2}]}',
+            b'{"id":"p\\u00e9","rating":1}',          # escape in id
+            b'{"id":"p","rating":1,"region":7}',       # coerced by Python
+        ]
+        *_cols, status = _native_rows(bodies)
+        for i, body in enumerate(bodies):
+            assert status[i] == codec.NEEDS_PYTHON, body
+            decode_request(body)  # Python fallback must succeed
+
+    def test_empty_roles_party_fast_path(self):
+        bodies = [b'{"id":"p","rating":1,"roles":[],"party":[]}',
+                  b'{"id":"q","rating":2,"roles":[ ],"party": []}']
+        ids, *_rest, status = _native_rows(bodies)
+        assert list(status) == [codec.OK, codec.OK]
+        assert list(ids) == ["p", "q"]
+
+    def test_fuzz_against_python(self, rng):
+        """Random flat payloads: native OK rows must equal Python exactly."""
+        keys = ["id", "rating", "rating_deviation", "region", "game_mode",
+                "rating_threshold", "extra_junk", "nested"]
+        bodies = []
+        for i in range(300):
+            payload = {"id": f"p{i}", "rating": float(rng.normal(1500, 400))}
+            if rng.random() < 0.5:
+                payload["rating_deviation"] = float(rng.uniform(0, 350))
+            if rng.random() < 0.5:
+                payload["region"] = rng.choice(["eu", "na", "apac"])
+            if rng.random() < 0.3:
+                payload["game_mode"] = "ranked"
+            if rng.random() < 0.3:
+                payload["rating_threshold"] = float(rng.uniform(1, 200))
+            if rng.random() < 0.2:
+                payload["extra_junk"] = {"nested": [1, {"a": "b"}, None]}
+            if rng.random() < 0.2:
+                payload["flag"] = bool(rng.random() < 0.5)
+            bodies.append(json.dumps(payload).encode())
+        ids, rating, rd, thr, regions, modes, status = _native_rows(bodies)
+        n_ok = 0
+        for i, body in enumerate(bodies):
+            py = decode_request(body)
+            if status[i] != codec.OK:
+                continue
+            n_ok += 1
+            assert ids[i] == py.id
+            assert rating[i] == pytest.approx(py.rating, rel=1e-6)
+            assert rd[i] == pytest.approx(py.rating_deviation, rel=1e-6)
+            assert (regions[i] or ANY) == py.region
+            assert (modes[i] or ANY) == py.game_mode
+        assert n_ok >= 250  # fast path covers the overwhelming majority
