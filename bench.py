@@ -194,22 +194,35 @@ def bench_tpu(args) -> dict:
     # (identical compiled steps measured 10-30x apart minutes apart), so the
     # measured phase runs ``repeats`` times and the MEDIAN run is reported;
     # all samples are logged for transparency.
+    profiler_cm = None
+    if args.profile_dir:
+        import jax
+
+        profiler_cm = jax.profiler.trace(args.profile_dir)
+        profiler_cm.__enter__()
+        log(f"[tpu] jax.profiler trace → {args.profile_dir}")
+
     runs = []
     t0 = time.perf_counter()
-    for rep in range(max(1, args.repeats)):
-        mps, lats, total = run_engine_pipelined(
-            engine, rng, pool_target=args.pool, window=args.window,
-            warmup=args.warmup, measured=args.windows, depth=args.depth,
-            label=f"tpu rep{rep}")
-        lat_ms = np.sort(np.asarray(lats)) * 1e3
-        runs.append({
-            "matches_per_sec": mps,
-            "p50_ms": float(np.percentile(lat_ms, 50)),
-            "p99_ms": float(np.percentile(lat_ms, 99)),
-            "total_matches": total,
-        })
-        log(f"[tpu rep{rep}] {total} matches, {mps:.0f}/s, "
-            f"p99 {runs[-1]['p99_ms']:.0f} ms")
+    try:
+        for rep in range(max(1, args.repeats)):
+            mps, lats, total = run_engine_pipelined(
+                engine, rng, pool_target=args.pool, window=args.window,
+                warmup=args.warmup, measured=args.windows, depth=args.depth,
+                label=f"tpu rep{rep}")
+            lat_ms = np.sort(np.asarray(lats)) * 1e3
+            runs.append({
+                "matches_per_sec": mps,
+                "p50_ms": float(np.percentile(lat_ms, 50)),
+                "p99_ms": float(np.percentile(lat_ms, 99)),
+                "total_matches": total,
+            })
+            log(f"[tpu rep{rep}] {total} matches, {mps:.0f}/s, "
+                f"p99 {runs[-1]['p99_ms']:.0f} ms")
+    finally:
+        # The failing run is exactly the one whose profile matters.
+        if profiler_cm is not None:
+            profiler_cm.__exit__(None, None, None)
     log(f"[tpu] {time.perf_counter() - t0:.1f}s total incl. fill/compile")
     if hasattr(engine, "span_report"):
         log(f"[tpu] spans: {engine.span_report()}")
@@ -257,6 +270,9 @@ def main() -> None:
     p.add_argument("--repeats", type=int, default=3,
                    help="repeat the measured phase; report the median run "
                         "(the shared TPU backend has multi-tenant variance)")
+    p.add_argument("--profile-dir", default="",
+                   help="write a jax.profiler trace of the measured phase "
+                        "(view with tensorboard/xprof)")
     p.add_argument("--depth", type=int, default=4,
                    help="max in-flight windows (pipelining hides device RTT)")
     p.add_argument("--cpu-pool", type=int, default=2000,

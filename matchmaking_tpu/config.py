@@ -53,6 +53,13 @@ class QueueConfig:
     #: seconds is answered with the cached response instead of re-entering
     #: the pool (prevents one player landing in two matches).
     dedup_ttl_s: float = 30.0
+    #: Periodic rescan of the longest-waiting players (seconds; 0 = off).
+    #: Matching is otherwise arrival-triggered (reference semantics), so two
+    #: waiting players whose thresholds WIDENED into compatibility would
+    #: never match under zero traffic; the rescan re-submits the oldest
+    #: waiting window so widening can resolve. Only meaningful with
+    #: ``widen_per_sec > 0`` on 1v1 queues.
+    rescan_interval_s: float = 0.0
 
 
 @dataclass(frozen=True)

@@ -66,6 +66,24 @@ class CpuEngine(Engine):
             if req.id not in self._by_id:
                 self._insert(req)
 
+    def rescan(self, max_window: int, now: float) -> SearchOutcome:
+        """Re-run the sequential search for the longest-waiting players so
+        threshold widening can resolve between pool members (matching is
+        otherwise arrival-triggered). 1v1 only; team queues re-form on
+        arrival. Callers must not treat the outcome's ``queued`` as newly
+        queued players (they already were)."""
+        out = SearchOutcome()
+        if self.queue.team_size != 1:
+            return out
+        oldest = sorted(self._entries, key=lambda r: r.enqueued_at)[:max_window]
+        for req in oldest:
+            idx = self._by_id.get(req.id)
+            if idx is None:
+                continue  # matched by an earlier iteration of this rescan
+            self._evict(idx)
+            self._search_1v1(req, now, out)  # re-inserts on no match
+        return out
+
     # ---- internals --------------------------------------------------------
 
     def _insert(self, req: SearchRequest) -> None:

@@ -303,6 +303,60 @@ class TpuEngine(Engine):
         self.spans["dispatch_s"] += time.perf_counter() - t_start
         return pending.token
 
+    def rescan_async(self, max_window: int, now: float) -> int | None:
+        """Re-submit the longest-waiting players as a search window so that
+        threshold widening can resolve between POOL members (matching is
+        otherwise arrival-triggered). Returns a window token, or None when
+        the pool is empty / the path is unsupported (team queues).
+
+        Safe by construction: the batch carries the players' EXISTING slots,
+        so the fused admit rewrites identical values; self-masking and the
+        conflict-free pairing handle rescanned lanes exactly like fresh
+        ones. ONE device chunk per call (the window caps at the largest
+        bucket): a second chunk would re-admit — from the not-yet-finalized
+        mirror — a slot the first chunk's in-flight step may already have
+        matched and evicted, resurrecting a matched player into a double
+        match. Periodic ticks cover pools larger than a bucket. The
+        resulting ColumnarOutcome's q_ids are the unmatched rescans —
+        callers must NOT re-ack them as newly queued."""
+        if self._team_device or self._team_delegate is not None:
+            return None
+        pool = self.pool
+        if len(pool) == 0:
+            return None
+        max_window = min(max_window, self.buckets[-1])
+        slots_all = pool.waiting_slots()
+        if slots_all.size > max_window:
+            enq = pool.m_enqueued[slots_all]
+            order = np.argsort(enq, kind="stable")[:max_window]
+            slots = np.sort(slots_all[order]).astype(np.int32)
+        else:
+            slots = np.sort(slots_all).astype(np.int32)
+        pending = _Pending(token=self._next_token,
+                           created=time.perf_counter())
+        pending.columnar = empty_columnar_outcome()
+        self._next_token += 1
+
+        t0 = self._rel_base(now)
+        cols = RequestColumns(
+            ids=pool.m_id[slots].copy(),
+            rating=pool.m_rating[slots].copy(),
+            rd=pool.m_rd[slots].copy(),
+            region=pool.m_region[slots].copy(),
+            mode=pool.m_mode[slots].copy(),
+            threshold=pool.m_threshold[slots].copy(),
+            enqueued_at=pool.m_enqueued[slots].copy(),
+            reply_to=pool.m_reply[slots].copy(),
+            correlation_id=pool.m_corr[slots].copy(),
+        )
+        batch = pool.batch_arrays_cols(cols, slots, self._bucket_for(slots.size), t0)
+        self._dev_pool, out = self.kernels.search_step_packed(
+            self._dev_pool, jnp.asarray(pack_batch(batch, now - t0))
+        )
+        pending.chunks.append(((cols, slots), (out,), now))
+        self._submit(pending)
+        return pending.token
+
     def intern_columns(self, regions, modes) -> tuple[np.ndarray, np.ndarray]:
         """str sequences → interned int32 code arrays (pool-owned interners)."""
         rc, mc = self.pool.regions.code, self.pool.modes.code

@@ -74,6 +74,9 @@ class _QueueRuntime:
         self._sweeper: asyncio.Task | None = None
         if queue_cfg.request_timeout_s is not None:
             self._sweeper = asyncio.create_task(self._sweep_timeouts())
+        self._rescanner: asyncio.Task | None = None
+        if queue_cfg.rescan_interval_s > 0 and queue_cfg.team_size == 1:
+            self._rescanner = asyncio.create_task(self._rescan_loop())
         # Online invariant checking (SURVEY.md §5 "Race detection").
         self._invariants = None
         if app.cfg.debug_invariants:
@@ -159,7 +162,6 @@ class _QueueRuntime:
         from matchmaking_tpu.native import codec
         from matchmaking_tpu.service.contract import (
             ContractError,
-            MatchResult,
             RequestColumns,
             decode_request,
         )
@@ -284,19 +286,7 @@ class _QueueRuntime:
 
         m = self.app.metrics
         for _tok, out in outs:
-            if self._invariants is not None:
-                self._invariants.observe_outcome(out)
-            for j in range(out.n_matches):
-                id_a, id_b = out.m_id_a[j], out.m_id_b[j]
-                result = MatchResult(
-                    match_id=out.m_match_id[j], players=(id_a, id_b),
-                    teams=((id_a,), (id_b,)),
-                    quality=float(out.m_quality[j]),
-                )
-                self._publish_matched(id_a, out.m_reply_a[j], out.m_corr_a[j],
-                                      float(out.m_enq_a[j]), result, now)
-                self._publish_matched(id_b, out.m_reply_b[j], out.m_corr_b[j],
-                                      float(out.m_enq_b[j]), result, now)
+            self._publish_columnar_matches(out, now)
             if self.queue_cfg.send_queued_ack:
                 for pid in out.q_ids:
                     d = by_id.get(pid)
@@ -314,6 +304,25 @@ class _QueueRuntime:
             self.app.broker.ack(self.consumer_tag, r[7].delivery_tag)
         m.counters.inc("windows")
         m.counters.inc("requests_batched", n)
+
+    def _publish_columnar_matches(self, out, now: float) -> None:
+        """Matched responses for one ColumnarOutcome (window flush AND
+        rescan both come through here)."""
+        from matchmaking_tpu.service.contract import MatchResult
+
+        if self._invariants is not None:
+            self._invariants.observe_outcome(out)
+        for j in range(out.n_matches):
+            id_a, id_b = out.m_id_a[j], out.m_id_b[j]
+            result = MatchResult(
+                match_id=out.m_match_id[j], players=(id_a, id_b),
+                teams=((id_a,), (id_b,)),
+                quality=float(out.m_quality[j]),
+            )
+            self._publish_matched(id_a, out.m_reply_a[j], out.m_corr_a[j],
+                                  float(out.m_enq_a[j]), result, now)
+            self._publish_matched(id_b, out.m_reply_b[j], out.m_corr_b[j],
+                                  float(out.m_enq_b[j]), result, now)
 
     def _publish_matched(self, pid: str, reply_to: str, correlation_id: str,
                          enqueued_at: float, result, now: float) -> None:
@@ -407,6 +416,54 @@ class _QueueRuntime:
             Properties(correlation_id=delivery.properties.correlation_id),
         )
 
+    # ---- periodic rescan (threshold widening between pool members) --------
+
+    async def _rescan_loop(self) -> None:
+        interval = self.queue_cfg.rescan_interval_s
+        window = self.app.cfg.batcher.max_batch
+        while True:
+            await asyncio.sleep(interval)
+            now = time.time()
+            outs: list = []
+            try:
+                async with self._engine_lock:
+                    if hasattr(self.engine, "rescan_async"):
+                        def run():
+                            tok = self.engine.rescan_async(window, now)
+                            return self.engine.flush() if tok is not None else []
+                        outs = await asyncio.to_thread(run)
+                        if self.engine.device_error is not None:
+                            err = self.engine.device_error
+                            self.engine.device_error = None
+                            raise err
+                    elif hasattr(self.engine, "rescan"):
+                        out = await asyncio.to_thread(
+                            self.engine.rescan, window, now)
+                        outs = [(0, out)]
+            except Exception:
+                log.exception("rescan failed; reviving engine from mirror")
+                self.app.metrics.counters.inc("engine_crashes")
+                self._revive_engine(now)
+                continue
+            matched = 0
+            for _tok, out in outs:
+                if hasattr(out, "m_id_a"):  # ColumnarOutcome: matches only —
+                    # q_ids are unmatched RESCANS, not newly queued players.
+                    matched += out.n_matches
+                    self._publish_columnar_matches(out, now)
+                else:  # object outcome (CPU oracle): matches only, same rule
+                    matched += len(out.matches)
+                    if self._invariants is not None:
+                        self._invariants.observe_outcome(out)
+                    for match in out.matches:
+                        result = match.result()
+                        for req in match.requests():
+                            self._publish_matched(
+                                req.id, req.reply_to, req.correlation_id,
+                                req.enqueued_at, result, now)
+            if matched:
+                self.app.metrics.counters.inc("rescan_matches", matched)
+
     # ---- timeout sweeper --------------------------------------------------
 
     async def _sweep_timeouts(self) -> None:
@@ -435,6 +492,8 @@ class _QueueRuntime:
     async def close(self) -> None:
         if self._sweeper is not None:
             self._sweeper.cancel()
+        if self._rescanner is not None:
+            self._rescanner.cancel()
         # Drain the batcher BEFORE cancelling the consumer so the final
         # windows can still ack their deliveries.
         await self.batcher.close()

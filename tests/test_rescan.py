@@ -1,0 +1,135 @@
+"""Periodic rescan: threshold widening must resolve BETWEEN waiting pool
+members (matching is otherwise arrival-triggered — reference semantics)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from matchmaking_tpu.config import (
+    BatcherConfig,
+    Config,
+    EngineConfig,
+    QueueConfig,
+)
+from matchmaking_tpu.engine.cpu import CpuEngine
+from matchmaking_tpu.engine.interface import make_engine
+from matchmaking_tpu.service.contract import SearchRequest
+
+
+def _q(**kw):
+    return QueueConfig(rating_threshold=10.0, widen_per_sec=10.0,
+                       max_threshold=200.0, **kw)
+
+
+def _cfg(q):
+    return Config(queues=(q,), engine=EngineConfig(
+        backend="tpu", pool_capacity=64, pool_block=64, batch_buckets=(16,)))
+
+
+def _req(i, rating, t=0.0):
+    return SearchRequest(id=f"p{i}", rating=float(rating), enqueued_at=t,
+                         reply_to=f"rq.p{i}")
+
+
+class TestEngineRescan:
+    def test_widening_resolves_between_pool_members(self):
+        q = _q()
+        eng = make_engine(_cfg(q), q)
+        # Distance 40; both thresholds widen 10/s from base 10.
+        eng.restore([_req(0, 1500.0, 0.0), _req(1, 1540.0, 0.0)], 0.0)
+
+        tok = eng.rescan_async(16, now=1.0)   # eff thr 20 < 40: no match
+        assert tok is not None
+        outs = eng.flush()
+        assert sum(o.n_matches for _, o in outs) == 0
+        assert eng.pool_size() == 2
+
+        eng.rescan_async(16, now=4.0)         # eff thr 50 ≥ 40: match
+        outs = eng.flush()
+        assert sum(o.n_matches for _, o in outs) == 1
+        out = outs[0][1]
+        assert {out.m_id_a[0], out.m_id_b[0]} == {"p0", "p1"}
+        # Quality from both sides' widened thresholds: 1 - 40/min(50,50).
+        assert out.m_quality[0] == pytest.approx(1.0 - 40.0 / 50.0, abs=1e-5)
+        assert eng.pool_size() == 0
+
+    def test_empty_pool_returns_none(self):
+        q = _q()
+        eng = make_engine(_cfg(q), q)
+        assert eng.rescan_async(16, now=1.0) is None
+
+    def test_oldest_players_prioritized(self):
+        q = _q()
+        cfg = Config(queues=(q,), engine=EngineConfig(
+            backend="tpu", pool_capacity=64, pool_block=64,
+            batch_buckets=(4,)))
+        eng = make_engine(cfg, q)
+        # 6 players, only a 4-lane rescan bucket: the 4 OLDEST re-submit.
+        # Old pair (enqueued t=0) distance 60; young pair (t=9) distance 60.
+        eng.restore([_req(0, 1000.0, 0.0), _req(1, 1060.0, 0.0)], 0.0)
+        eng.restore([_req(2, 3000.0, 9.0), _req(3, 3060.0, 9.0),
+                     _req(4, 5000.0, 9.0), _req(5, 7000.0, 9.0)], 9.0)
+        # At t=10: old pair eff thr 110 ≥ 60 (can match); young pair eff
+        # thr 20 < 60 (cannot). Only the old pair may match regardless of
+        # which 4 got rescanned — but the oldest-first pick must INCLUDE
+        # the old pair.
+        eng.rescan_async(4, now=10.0)
+        outs = eng.flush()
+        assert sum(o.n_matches for _, o in outs) == 1
+        out = outs[0][1]
+        assert {out.m_id_a[0], out.m_id_b[0]} == {"p0", "p1"}
+
+    def test_cpu_oracle_rescan_equivalent(self):
+        q = _q()
+        tpu = make_engine(_cfg(q), q)
+        cpu = CpuEngine(_cfg(q), q)
+        reqs = [_req(0, 1500.0), _req(1, 1540.0), _req(2, 1800.0)]
+        tpu.restore(reqs, 0.0)
+        cpu.restore(reqs, 0.0)
+
+        out_c = cpu.rescan(16, now=4.0)
+        tpu.rescan_async(16, now=4.0)
+        outs_t = tpu.flush()
+        t_pairs = {frozenset((o.m_id_a[j], o.m_id_b[j]))
+                   for _, o in outs_t for j in range(o.n_matches)}
+        c_pairs = {frozenset(r.id for team in m.teams for r in team)
+                   for m in out_c.matches}
+        assert t_pairs == c_pairs == {frozenset(("p0", "p1"))}
+        assert tpu.pool_size() == cpu.pool_size() == 1
+
+
+class TestServiceRescan:
+    def test_service_rescan_publishes_matches(self):
+        from matchmaking_tpu.service.app import MatchmakingApp
+        from matchmaking_tpu.service.client import MatchmakingClient
+
+        async def run():
+            q = QueueConfig(rating_threshold=1.0, widen_per_sec=50.0,
+                            max_threshold=500.0, rescan_interval_s=0.1)
+            cfg = Config(
+                queues=(q,),
+                engine=EngineConfig(backend="tpu", pool_capacity=64,
+                                    pool_block=64, batch_buckets=(16,)),
+                batcher=BatcherConfig(max_batch=16, max_wait_ms=1.0),
+            )
+            app = MatchmakingApp(cfg)
+            await app.start()
+            try:
+                client = MatchmakingClient(app.broker, q.name)
+                # Distance 30 ≫ base threshold 1; widens past 30 in <1 s.
+                ra = client.submit({"id": "a", "rating": 1500})
+                rb = client.submit({"id": "b", "rating": 1530})
+                qa = await client.next_response(ra, timeout=15.0)
+                qb = await client.next_response(rb, timeout=15.0)
+                assert qa.status == "queued" and qb.status == "queued"
+                ma = await client.next_response(ra, timeout=15.0)
+                mb = await client.next_response(rb, timeout=15.0)
+                assert ma is not None and ma.status == "matched"
+                assert mb is not None and mb.status == "matched"
+                assert set(ma.match.players) == {"a", "b"}
+                assert app.metrics.counters.get("rescan_matches") >= 1
+            finally:
+                await app.stop()
+
+        asyncio.run(run())
