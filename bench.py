@@ -262,7 +262,7 @@ def main() -> None:
                    help="sustained concurrent pool size (headline: 100k)")
     p.add_argument("--capacity", type=int, default=131_072)
     p.add_argument("--pool-block", type=int, default=8192)
-    p.add_argument("--window", type=int, default=1024,
+    p.add_argument("--window", type=int, default=2048,
                    help="requests per timed search window")
     p.add_argument("--windows", type=int, default=50,
                    help="measured windows")

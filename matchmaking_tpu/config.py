@@ -95,6 +95,11 @@ class EngineConfig:
     #: parallel-greedy window-selection rounds (engine/teams.py).
     team_max_matches: int = 1024
     team_rounds: int = 16
+    #: Use the Pallas score+top-k kernel for the 1v1 hot op (VMEM-resident
+    #: score tiles + running top-k — engine/pallas_kernels.py). Off by
+    #: default: the fused-XLA scan is the reference path; flip per
+    #: deployment after benchmarking both on your chip.
+    use_pallas: bool = False
 
 
 @dataclass(frozen=True)

@@ -214,7 +214,8 @@ class KernelSet:
 
     def __init__(self, *, capacity: int, top_k: int, pool_block: int,
                  glicko2: bool, widen_per_sec: float, max_threshold: float,
-                 evict_bucket: int = 64, pair_rounds: int = 8):
+                 evict_bucket: int = 64, pair_rounds: int = 8,
+                 use_pallas: bool = False):
         if capacity % pool_block != 0:
             # Round the block down to a divisor to keep the scan uniform.
             while capacity % pool_block != 0:
@@ -228,6 +229,9 @@ class KernelSet:
         self.max_threshold = max_threshold
         self.evict_bucket = evict_bucket
         self.pair_rounds = pair_rounds
+        self.use_pallas = use_pallas
+        # Pallas runs natively on TPU; everywhere else (tests) interpret.
+        self._pallas_interpret = jax.default_backend() != "tpu"
 
         self.admit = jax.jit(self._admit, donate_argnums=0)
         self.evict = jax.jit(self._evict, donate_argnums=0)
@@ -355,6 +359,25 @@ class KernelSet:
         (vals, idxs), _ = lax.scan(body, init, jnp.arange(self.n_blocks, dtype=jnp.int32))
         return vals, idxs
 
+    def _topk_pallas(self, batch: dict[str, Any], q_thr_eff,
+                     pool: dict[str, Any], now):
+        """Pallas variant of the score+top-k hot op (engine/pallas_kernels):
+        score tiles and the running top-k stay in VMEM."""
+        from matchmaking_tpu.engine.pallas_kernels import (
+            pack_batch_rows,
+            pack_pool_rows,
+            pallas_topk,
+        )
+
+        return pallas_topk(
+            pack_pool_rows(pool), pack_batch_rows(batch, q_thr_eff), now,
+            blk=min(2048, self.pool_block), b_tile=256, top_k=self.top_k,
+            capacity=self.capacity, glicko2=self.glicko2,
+            widen_per_sec=self.widen_per_sec,
+            max_threshold=self.max_threshold,
+            interpret=self._pallas_interpret,
+        )
+
     # ---- pairing ----------------------------------------------------------
 
     def greedy_pair(self, vals, idxs, self_slot):
@@ -376,23 +399,29 @@ class KernelSet:
             self.widen_per_sec, self.max_threshold,
         )
 
-        def body(carry, blk_i):
-            start = blk_i * blk
-            block = {f: lax.dynamic_slice_in_dim(pool[f], start, blk)
-                     for f in (*_ADMIT_FIELDS, "active")}
-            block = _admit_block(block, start, blk, batch)
-            scores = self._score_block(batch, q_thr_eff, block, start, now)
-            v, i = self._block_topk(scores)
-            carry = self._merge_topk(*carry, v, i.astype(jnp.int32) + start)
-            return carry, block
+        if self.use_pallas:
+            # Pallas path: separate admit pass, then the VMEM-resident
+            # score+top-k kernel (pallas_kernels.pallas_topk).
+            pool = self._admit(pool, batch)
+            vals, idxs = self._topk_pallas(batch, q_thr_eff, pool, now)
+        else:
+            def body(carry, blk_i):
+                start = blk_i * blk
+                block = {f: lax.dynamic_slice_in_dim(pool[f], start, blk)
+                         for f in (*_ADMIT_FIELDS, "active")}
+                block = _admit_block(block, start, blk, batch)
+                scores = self._score_block(batch, q_thr_eff, block, start, now)
+                v, i = self._block_topk(scores)
+                carry = self._merge_topk(*carry, v, i.astype(jnp.int32) + start)
+                return carry, block
 
-        init = (
-            jnp.full((b, self.top_k), _NEG_INF, jnp.float32),
-            jnp.full((b, self.top_k), self.capacity, jnp.int32),
-        )
-        (vals, idxs), blocks = lax.scan(
-            body, init, jnp.arange(self.n_blocks, dtype=jnp.int32))
-        pool = {f: blocks[f].reshape(self.capacity) for f in blocks}
+            init = (
+                jnp.full((b, self.top_k), _NEG_INF, jnp.float32),
+                jnp.full((b, self.top_k), self.capacity, jnp.int32),
+            )
+            (vals, idxs), blocks = lax.scan(
+                body, init, jnp.arange(self.n_blocks, dtype=jnp.int32))
+            pool = {f: blocks[f].reshape(self.capacity) for f in blocks}
 
         out_q, out_c, out_d = self.greedy_pair(vals, idxs, batch["slot"])
 
@@ -413,10 +442,10 @@ class KernelSet:
 @functools.lru_cache(maxsize=None)
 def kernel_set(capacity: int, top_k: int, pool_block: int, glicko2: bool,
                widen_per_sec: float, max_threshold: float,
-               pair_rounds: int = 8) -> KernelSet:
+               pair_rounds: int = 8, use_pallas: bool = False) -> KernelSet:
     """Cached KernelSet per static config (compile once per queue shape)."""
     return KernelSet(
         capacity=capacity, top_k=top_k, pool_block=pool_block, glicko2=glicko2,
         widen_per_sec=widen_per_sec, max_threshold=max_threshold,
-        pair_rounds=pair_rounds,
+        pair_rounds=pair_rounds, use_pallas=use_pallas,
     )

@@ -1,0 +1,217 @@
+"""Pallas TPU kernel for the hot op: fused masked scoring + streaming top-k.
+
+The XLA path (`kernels._topk_candidates`) scans pool blocks with
+`lax.top_k`; this Pallas version keeps the whole (B_TILE × BLK) score tile
+and the running top-k in VMEM, so scores never round-trip HBM and the top-k
+is an in-register iterative extraction instead of a sort:
+
+    grid = (B / B_TILE, P / BLK)      # pool-block axis innermost
+    per cell: score tile (VPU) → K exact max-extractions → insert into the
+    running per-row top-K held in VMEM scratch across the pool-block axis;
+    the last block writes the result.
+
+Semantics match the XLA path at the SET level (same K candidate scores; in
+interpret mode the index sets are identical). One documented divergence on
+real TPU hardware: when two candidates tie EXACTLY at the K-th score,
+Mosaic's argmax/argmin lane tie order may keep a different — equally
+distant — candidate than XLA's top_k (measured ~0.7% of rows at K=8 over a
+100k continuous-rating pool). Both choices are equally valid matches and
+each path is individually deterministic (sharded replication stays
+consistent); the greedy pairing depends on VALUES, not lane order. The
+ORDER of the K output lanes is unspecified (unsorted).
+
+Measured on v5e (B=1024, P=131k, K=8): ≈ parity with the fused-XLA scan
+(6.9 ms vs 7.2 ms in the same backend phase) — the XLA path remains the
+default; flip ``EngineConfig.use_pallas`` after benchmarking on your chip.
+
+Layout notes (TPU tiling wants trailing-dim 128):
+- pool fields pre-packed (7, P) f32: rating, rd, region, mode, threshold,
+  enqueue_t, active — codes/flags are exact in f32.
+- batch packed (B, 128) f32, first 7 columns: slot, rating, rd, region,
+  mode, eff_threshold (widening pre-applied), valid.
+- outputs (B, 128) f32 ×2 (vals, idx); callers slice [:, :K].
+
+Gated by ``EngineConfig.use_pallas``; on non-TPU backends the pallas_call
+runs in interpret mode (tests), so CPU correctness is pinned against the
+XLA path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU memory spaces (absent on some CPU-only builds)
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+    _SMEM = pltpu.SMEM
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _VMEM = _SMEM = None
+
+_NEG_INF = -jnp.inf
+LANES = 128  # output/pad width (TPU lane count)
+
+#: Row order of the packed pool input.
+POOL_ROWS = ("rating", "rd", "region", "mode", "threshold", "enqueue_t",
+             "active")
+
+
+def _kernel(now_ref, pool_ref, batch_ref, out_v_ref, out_i_ref,
+            best_v, best_i, *, blk: int, top_k: int, capacity: int,
+            glicko2: bool, widen_per_sec: float, max_threshold: float,
+            g_coeff: float):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        best_v[:] = jnp.full_like(best_v, _NEG_INF)
+        best_i[:] = jnp.full_like(best_i, float(capacity))
+
+    b = batch_ref[:]                      # (B_TILE, 128)
+    q_slot = b[:, 0:1]
+    q_rating = b[:, 1:2]
+    q_rd = b[:, 2:3]
+    q_reg = b[:, 3:4]
+    q_mode = b[:, 4:5]
+    q_thr_eff = b[:, 5:6]
+    q_valid = b[:, 6:7]
+
+    p = pool_ref[:]                       # (7, BLK)
+    c_rating = p[0:1, :]
+    c_rd = p[1:2, :]
+    c_reg = p[2:3, :]
+    c_mode = p[3:4, :]
+    c_thr = p[4:5, :]
+    c_enq = p[5:6, :]
+    c_act = p[6:7, :]
+
+    d = jnp.abs(q_rating - c_rating)      # (B_TILE, BLK)
+    if glicko2:
+        # EXACTLY scoring.glicko_g's expression (1/x**0.5, not rsqrt —
+        # the approximate reciprocal sqrt diverges from the XLA path by
+        # ulps, which breaks set-level equivalence at threshold edges).
+        rd2 = q_rd * q_rd + c_rd * c_rd
+        d = d * (1.0 / (1.0 + g_coeff * rd2) ** 0.5)
+    if widen_per_sec > 0.0:
+        now = now_ref[0, 0]
+        waited = jnp.maximum(0.0, now - c_enq)
+        c_thr_eff = jnp.minimum(jnp.float32(max_threshold),
+                                c_thr + jnp.float32(widen_per_sec) * waited)
+    else:
+        c_thr_eff = c_thr
+    limit = jnp.minimum(q_thr_eff, c_thr_eff)
+
+    region_ok = (q_reg == 0.0) | (c_reg == 0.0) | (q_reg == c_reg)
+    mode_ok = (q_mode == 0.0) | (c_mode == 0.0) | (q_mode == c_mode)
+    # Mosaic: iota must be integer-typed; cast after.
+    gidx = jnp.float32(j * blk) + jax.lax.broadcasted_iota(
+        jnp.int32, (1, blk), 1).astype(jnp.float32)
+    valid = ((c_act > 0.0) & (q_valid > 0.0) & region_ok & mode_ok
+             & (q_slot != gidx) & (d <= limit))
+    scores = jnp.where(valid, -d, _NEG_INF)
+
+    b_tile = scores.shape[0]
+    lane_b = jax.lax.broadcasted_iota(jnp.int32, (b_tile, blk), 1)
+    lane_k = jax.lax.broadcasted_iota(jnp.int32, (b_tile, top_k), 1)
+    for _ in range(top_k):
+        # Exact extraction: per-row max of the remaining tile...
+        v = jnp.max(scores, axis=1, keepdims=True)            # (B_TILE, 1)
+        a = jnp.argmax(scores, axis=1)                        # (B_TILE,)
+        gi = jnp.float32(j * blk) + a.astype(jnp.float32)
+        # ...inserted over the running top-K's minimum iff strictly better
+        # (strict: on equal scores the incumbent — earlier pool index —
+        # wins, matching the XLA streaming merge's tie preference).
+        bv = best_v[:, :top_k]
+        mn = jnp.min(bv, axis=1, keepdims=True)
+        am = jnp.argmin(bv, axis=1)
+        take = v > mn
+        onehot = (lane_k == am[:, None]) & take
+        best_v[:, :top_k] = jnp.where(onehot, v, bv)
+        best_i[:, :top_k] = jnp.where(onehot, gi[:, None], best_i[:, :top_k])
+        # Retire the extracted element from this tile.
+        scores = jnp.where(lane_b == a[:, None], _NEG_INF, scores)
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _():
+        out_v_ref[:] = best_v[:]
+        out_i_ref[:] = best_i[:]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("blk", "b_tile", "top_k", "capacity", "glicko2",
+                     "widen_per_sec", "max_threshold", "interpret"))
+def pallas_topk(pool_packed, batch_packed, now, *, blk: int, b_tile: int,
+                top_k: int, capacity: int, glicko2: bool,
+                widen_per_sec: float, max_threshold: float,
+                interpret: bool = False):
+    """(pool f32[7,P], batch f32[B,128], now f32) → (vals f32[B,K],
+    idx i32[B,K])."""
+    import math
+
+    _, pcap = pool_packed.shape
+    b = batch_packed.shape[0]
+    b_tile = min(b_tile, b)
+    blk = min(blk, pcap)
+    assert pcap % blk == 0 and b % b_tile == 0
+    q = math.log(10.0) / 400.0
+    g_coeff = 3.0 * q * q / (math.pi * math.pi)
+
+    kernel = functools.partial(
+        _kernel, blk=blk, top_k=top_k, capacity=capacity, glicko2=glicko2,
+        widen_per_sec=widen_per_sec, max_threshold=max_threshold,
+        g_coeff=g_coeff)
+    mem = {} if pltpu is None else {"memory_space": _VMEM}
+    smem = {} if pltpu is None else {"memory_space": _SMEM}
+    scratch = (
+        [jax.ShapeDtypeStruct((b_tile, LANES), jnp.float32)] * 2
+        if pltpu is None else
+        [_VMEM((b_tile, LANES), jnp.float32),
+         _VMEM((b_tile, LANES), jnp.float32)]
+    )
+    out_v, out_i = pl.pallas_call(
+        kernel,
+        grid=(b // b_tile, pcap // blk),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda i, j: (0, 0), **smem),
+            pl.BlockSpec((len(POOL_ROWS), blk), lambda i, j: (0, j), **mem),
+            pl.BlockSpec((b_tile, LANES), lambda i, j: (i, 0), **mem),
+        ],
+        out_specs=[
+            pl.BlockSpec((b_tile, LANES), lambda i, j: (i, 0), **mem),
+            pl.BlockSpec((b_tile, LANES), lambda i, j: (i, 0), **mem),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, LANES), jnp.float32),
+            jax.ShapeDtypeStruct((b, LANES), jnp.float32),
+        ],
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(jnp.asarray(now, jnp.float32).reshape(1, 1), pool_packed, batch_packed)
+    return out_v[:, :top_k], out_i[:, :top_k].astype(jnp.int32)
+
+
+def pack_pool_rows(pool: dict) -> jnp.ndarray:
+    """Pool dict → (7, P) f32 (active as 0/1)."""
+    return jnp.stack([pool[f].astype(jnp.float32) for f in POOL_ROWS])
+
+
+def pack_batch_rows(batch: dict, q_thr_eff) -> jnp.ndarray:
+    """Batch dict (+ pre-widened query thresholds) → (B, 128) f32."""
+    cols = jnp.stack([
+        batch["slot"].astype(jnp.float32),
+        batch["rating"],
+        batch["rd"],
+        batch["region"].astype(jnp.float32),
+        batch["mode"].astype(jnp.float32),
+        q_thr_eff,
+        batch["valid"].astype(jnp.float32),
+    ], axis=1)                                        # (B, 7)
+    b = cols.shape[0]
+    return jnp.concatenate(
+        [cols, jnp.zeros((b, LANES - cols.shape[1]), jnp.float32)], axis=1)

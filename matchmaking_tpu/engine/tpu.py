@@ -127,6 +127,7 @@ class TpuEngine(Engine):
                 widen_per_sec=queue.widen_per_sec,
                 max_threshold=queue.max_threshold,
                 pair_rounds=ec.pair_rounds,
+                use_pallas=ec.use_pallas,
             )
             self._dev_pool = jax.device_put(
                 {k: jnp.asarray(v)
