@@ -214,3 +214,158 @@ def team_kernel_set(capacity: int, team_size: int, widen_per_sec: float,
         capacity=capacity, team_size=team_size, widen_per_sec=widen_per_sec,
         max_threshold=max_threshold, max_matches=max_matches, rounds=rounds,
     )
+
+
+class ShardedTeamKernelSet:
+    """Multi-chip team matching: pool sharded over mesh axis ``"pool"``.
+
+    Team-window formation needs a GLOBAL (group, rating) sort, which does
+    not decompose across shards the way 1v1 top-k does. The pool columns the
+    sort needs are tiny (5 × f32[P] ≈ 2.6 MB at P=131k), so each step
+    ``all_gather``s them over ICI and runs the window selection REPLICATED —
+    deterministic, so every shard extracts the identical matches — then each
+    shard evicts its local slice. Communication per step: one all_gather of
+    the column pack; no per-window host round trips.
+
+    Call surface mirrors TeamKernelSet's packed API so TpuEngine swaps it in
+    when ``mesh_pool_axis > 1`` on a plain team queue.
+    """
+
+    def __init__(self, *, capacity: int, team_size: int,
+                 widen_per_sec: float, max_threshold: float, mesh,
+                 max_matches: int = 1024, rounds: int = 16,
+                 evict_bucket: int = 64):
+        import numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from matchmaking_tpu.engine.sharded import AXIS, _shard_map
+
+        self.mesh = mesh
+        self.n_shards = mesh.devices.size
+        if capacity % self.n_shards != 0:
+            capacity += self.n_shards - capacity % self.n_shards
+        self.capacity = capacity
+        self.local_capacity = capacity // self.n_shards
+        self.team_size = team_size
+        self.need = 2 * team_size
+        self.evict_bucket = evict_bucket
+        # Global-window math on gathered columns (admit/evict unused there).
+        self._global = TeamKernelSet(
+            capacity=capacity, team_size=team_size,
+            widen_per_sec=widen_per_sec, max_threshold=max_threshold,
+            max_matches=max_matches, rounds=rounds)
+        self.max_matches = self._global.max_matches
+        # Local admit/evict on the shard slice.
+        self._local = KernelSet(
+            capacity=self.local_capacity, top_k=1,
+            pool_block=min(256, self.local_capacity), glicko2=False,
+            widen_per_sec=widen_per_sec, max_threshold=max_threshold,
+            evict_bucket=evict_bucket)
+        self._np = np
+
+        pool_spec = {k: P(AXIS) for k in
+                     ("rating", "rd", "region", "mode", "threshold",
+                      "enqueue_t", "active")}
+        rep = P()
+        self.search_step_packed = jax.jit(
+            _shard_map(self._step_shard, mesh=mesh,
+                       in_specs=(pool_spec, rep),
+                       out_specs=(pool_spec, rep), check_vma=False),
+            donate_argnums=0)
+        self.admit_packed = jax.jit(
+            _shard_map(self._admit_shard, mesh=mesh,
+                       in_specs=(pool_spec, rep), out_specs=pool_spec,
+                       check_vma=False),
+            donate_argnums=0)
+        self.evict = jax.jit(
+            _shard_map(self._evict_shard, mesh=mesh,
+                       in_specs=(pool_spec, rep), out_specs=pool_spec,
+                       check_vma=False),
+            donate_argnums=0)
+        self._sharding = NamedSharding(mesh, P(AXIS))
+
+    # ---- shard-local helpers (inside shard_map) ---------------------------
+
+    def _localize(self, batch):
+        from jax import lax
+
+        from matchmaking_tpu.engine.sharded import AXIS
+
+        offset = lax.axis_index(AXIS) * self.local_capacity
+        local = batch["slot"] - offset
+        mine = (local >= 0) & (local < self.local_capacity)
+        return dict(batch, slot=jnp.where(mine, local, self.local_capacity))
+
+    def _admit_shard(self, pool, packed):
+        from matchmaking_tpu.engine.kernels import unpack_batch
+
+        return self._local._admit(pool, self._localize(unpack_batch(packed)))
+
+    def _evict_shard(self, pool, slots):
+        from jax import lax
+
+        from matchmaking_tpu.engine.sharded import AXIS
+
+        offset = lax.axis_index(AXIS) * self.local_capacity
+        local = slots.astype(jnp.int32) - offset
+        mine = (local >= 0) & (local < self.local_capacity)
+        return self._local._evict(
+            pool, jnp.where(mine, local, self.local_capacity))
+
+    def _step_shard(self, pool, packed):
+        from jax import lax
+
+        from matchmaking_tpu.engine.kernels import unpack_batch
+        from matchmaking_tpu.engine.sharded import AXIS
+
+        batch = unpack_batch(packed)
+        now = packed[8, 0]
+        pool = self._local._admit(pool, self._localize(batch))
+
+        # Gather the window-selection columns globally (tiled → f32/i32[P]).
+        full = {f: lax.all_gather(pool[f], AXIS, tiled=True)
+                for f in ("rating", "region", "mode", "threshold",
+                          "enqueue_t", "active")}
+        g = self._global
+        order, group = g._sorted_order(full)
+        valid, spread, win_thr = g._windows(full, order, group, now)
+        won = g._select_windows(valid, spread)
+
+        score = jnp.where(won, -jnp.arange(won.shape[0], dtype=jnp.int32),
+                          -_BIG_I32)
+        topv, topi = jax.lax.top_k(score, g.max_matches)
+        is_match = topv > -_BIG_I32
+        w = jnp.where(is_match, topi, 0)
+        member_pos = w[:, None] + jnp.arange(g.need, dtype=jnp.int32)[None, :]
+        slots = order[member_pos]
+        slots = jnp.where(is_match[:, None], slots, self.capacity)
+
+        # Evict this shard's slice of every matched slot.
+        offset = lax.axis_index(AXIS) * self.local_capacity
+        flat = slots.reshape(-1) - offset
+        mine = (flat >= 0) & (flat < self.local_capacity)
+        pool = self._local._evict(
+            pool, jnp.where(mine, flat, self.local_capacity))
+
+        out = jnp.concatenate([slots.T.astype(jnp.float32),
+                               jnp.where(is_match, spread[w], _INF)[None, :],
+                               jnp.where(is_match, win_thr[w], 0.0)[None, :]])
+        return pool, out
+
+    def place_pool(self, arrays):
+        return {k: jax.device_put(jnp.asarray(v), self._sharding)
+                for k, v in arrays.items()}
+
+
+@functools.lru_cache(maxsize=None)
+def sharded_team_kernel_set(capacity: int, team_size: int,
+                            widen_per_sec: float, max_threshold: float,
+                            n_shards: int, max_matches: int = 1024,
+                            rounds: int = 16) -> ShardedTeamKernelSet:
+    from matchmaking_tpu.engine.sharded import pool_mesh
+
+    return ShardedTeamKernelSet(
+        capacity=capacity, team_size=team_size, widen_per_sec=widen_per_sec,
+        max_threshold=max_threshold, mesh=pool_mesh(n_shards),
+        max_matches=max_matches, rounds=rounds,
+    )

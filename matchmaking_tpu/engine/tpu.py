@@ -80,12 +80,25 @@ class TpuEngine(Engine):
     def __init__(self, cfg: Config, queue: QueueConfig):
         super().__init__(cfg, queue)
         ec = cfg.engine
-        # Role/party queues (config #5) and multi-chip team queues run the
-        # host oracle over the mirror; plain team queues (config #3) and all
-        # 1v1 configs run on device.
-        self._team_device = queue.team_size > 1 and not queue.role_slots \
-            and ec.mesh_pool_axis <= 1
-        if self._team_device:
+        # Role/party queues (config #5) run the host oracle over the mirror;
+        # plain team queues (config #3) and all 1v1 configs run on device,
+        # single- or multi-chip.
+        self._team_device = queue.team_size > 1 and not queue.role_slots
+        if self._team_device and ec.mesh_pool_axis > 1:
+            from matchmaking_tpu.engine.teams import sharded_team_kernel_set
+
+            self.kernels = sharded_team_kernel_set(
+                capacity=ec.pool_capacity,
+                team_size=queue.team_size,
+                widen_per_sec=queue.widen_per_sec,
+                max_threshold=queue.max_threshold,
+                n_shards=ec.mesh_pool_axis,
+                max_matches=ec.team_max_matches,
+                rounds=ec.team_rounds,
+            )
+            self._dev_pool = self.kernels.place_pool(
+                PlayerPool.empty_device_arrays(self.kernels.capacity))
+        elif self._team_device:
             from matchmaking_tpu.engine.teams import team_kernel_set
 
             self.kernels = team_kernel_set(

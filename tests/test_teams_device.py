@@ -197,6 +197,50 @@ class TestSnakeSumByConstruction:
                 assert abs(float(signs @ w)) <= w[-1] - w[0] + 1e-9
 
 
+class TestShardedTeams:
+    """Multi-chip team path (all_gather + replicated window selection) must
+    produce the same matches as the single-device team kernel."""
+
+    @pytest.mark.parametrize("team_size", [2, 5])
+    def test_sharded_equals_single_device(self, team_size):
+        def run(mesh_axis):
+            cfg = Config(
+                queues=(QueueConfig(team_size=team_size,
+                                    rating_threshold=50.0),),
+                engine=EngineConfig(backend="tpu", pool_capacity=256,
+                                    pool_block=64, batch_buckets=(16, 64),
+                                    team_max_matches=32,
+                                    mesh_pool_axis=mesh_axis),
+            )
+            eng = make_engine(cfg, cfg.queues[0])
+            rng = np.random.default_rng(21)
+            ratings = rng.permutation(700)[:90] + 1200  # distinct
+            keys = []
+            for i, r in enumerate(ratings):
+                out = eng.search([_req(i, int(r))], float(i))
+                keys.extend(_match_key(m) for m in out.matches)
+            return keys, eng.pool_size()
+
+        single_keys, single_n = run(1)
+        shard_keys, shard_n = run(8)
+        assert shard_keys == single_keys
+        assert shard_n == single_n
+        assert len(single_keys) >= 3  # matches actually formed
+
+    def test_sharded_team_widening(self):
+        q = QueueConfig(team_size=2, rating_threshold=20.0,
+                        widen_per_sec=10.0, max_threshold=200.0)
+        cfg = Config(queues=(q,), engine=EngineConfig(
+            backend="tpu", pool_capacity=64, pool_block=16,
+            batch_buckets=(16,), team_max_matches=8, mesh_pool_axis=8))
+        eng = make_engine(cfg, q)
+        # Spread 60 > base 20; widens past 60 by t=5.
+        eng.restore([_req(0, 1000), _req(1, 1020), _req(2, 1040)], 0.0)
+        out = eng.search([_req(3, 1060)], 5.0)
+        assert len(out.matches) == 1
+        assert len([p for t in out.matches[0].teams for p in t]) == 4
+
+
 class TestEngineIntegration:
     def test_remove_and_restore_roundtrip(self):
         cfg = _team_cfg(2)
