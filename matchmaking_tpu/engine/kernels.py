@@ -112,6 +112,38 @@ def _admit_block(pool_block: dict[str, Any], start, blk: int,
     return out
 
 
+def effective_pool_block(capacity: int, pool_block: int, top_k: int,
+                         *, min_blocks: bool = True) -> int:
+    """The scan block size actually used for a pool geometry.
+
+    Two constraints beyond the configured value:
+
+    - it must divide ``capacity`` (uniform ``lax.scan``) — rounded down by
+      halving;
+    - candidate lists are best-per-block (``_block_best``), so their width
+      IS ``capacity // pool_block``; a window's conflict losers need
+      fallback candidates, which means small pools must split into at least
+      ``min(top_k, capacity // 128)`` blocks (the 128 floor keeps blocks at
+      lane width — below that more fallbacks aren't worth sub-lane blocks).
+
+    Centralized so the sharded engine can derive the SAME geometry from the
+    global capacity (see ShardedKernelSet) — identical block boundaries are
+    what make sharded and single-device matches identical. The sharded
+    engine passes ``min_blocks=False`` for its LOCAL kernels: its fallback
+    width is ``n_shards × local_blocks``, already set by the global
+    derivation, and a local re-derivation would shrink blocks and break the
+    shared geometry.
+    """
+    pool_block = max(1, min(pool_block, capacity))
+    while capacity % pool_block != 0:
+        pool_block //= 2
+    if min_blocks:
+        need = min(top_k, max(1, capacity // 128))
+        while capacity // pool_block < need:
+            pool_block //= 2
+    return pool_block
+
+
 def _mask_members(active, start, blk: int, slots) -> jnp.ndarray:
     """active & (position ∉ slots) — the scatter-free eviction mask.
 
@@ -175,20 +207,19 @@ def greedy_pair(vals, idxs, self_slot, capacity: int, rounds: int = 8):
         out_q = jnp.where(win, self_slot, out_q)
         out_c = jnp.where(win, bc, out_c)
         out_d = jnp.where(win, -bv, out_d)
-        # Retire both endpoints of every winner (sentinel for losers).
-        # Membership via sort + binary search: the dense (B, K, 2B) compare
-        # is O(B²K) and dominates the step at large windows (~2 GOp at
-        # B=4096); sorted search is ~BK·log B.
-        used = jnp.sort(jnp.concatenate([jnp.where(win, self_slot, cap),
-                                         jnp.where(win, bc, cap)]))  # (2B,)
-        last = used.shape[0] - 1
-
-        def member(x):
-            pos = jnp.clip(jnp.searchsorted(used, x), 0, last)
-            return jnp.take(used, pos) == x
-
-        cand_dead = cand_dead | member(idxs)
-        row_dead = row_dead | member(self_slot)
+        # Retire both endpoints of every winner (sentinel for losers) with
+        # dense compares against THIS round's winner slots. Measured on the
+        # v5e tunnel: the sort+searchsorted+take alternative costs ~2 ms per
+        # round (the small gathers serialize); the (B,K,B)×2 broadcast
+        # compare is ~0.1 ms — big dense beats small irregular on TPU.
+        wq = jnp.where(win, self_slot, cap)
+        wc = jnp.where(win, bc, cap)
+        cand_dead = (cand_dead
+                     | (idxs[:, :, None] == wq[None, None, :]).any(-1)
+                     | (idxs[:, :, None] == wc[None, None, :]).any(-1))
+        row_dead = (row_dead
+                    | (self_slot[:, None] == wq[None, :]).any(-1)
+                    | (self_slot[:, None] == wc[None, :]).any(-1))
         return row_dead, cand_dead, out_q, out_c, out_d
 
     init = (
@@ -209,19 +240,14 @@ class KernelSet:
     data is only arrays + the ``now`` scalar.
     """
 
-    #: Sublane width of the 2-stage exact top-k (lane count of the VPU).
-    TOPK_SUB = 128
-
     def __init__(self, *, capacity: int, top_k: int, pool_block: int,
                  glicko2: bool, widen_per_sec: float, max_threshold: float,
                  evict_bucket: int = 64, pair_rounds: int = 8,
-                 use_pallas: bool = False):
-        if capacity % pool_block != 0:
-            # Round the block down to a divisor to keep the scan uniform.
-            while capacity % pool_block != 0:
-                pool_block //= 2
+                 use_pallas: bool = False, exact_block: bool = False):
+        pool_block = effective_pool_block(capacity, pool_block, top_k,
+                                          min_blocks=not exact_block)
         self.capacity = capacity
-        self.top_k = min(top_k, pool_block)  # top_k needs k ≤ block
+        self.top_k = min(top_k, pool_block)
         self.pool_block = pool_block
         self.n_blocks = capacity // pool_block
         self.glicko2 = glicko2
@@ -310,68 +336,65 @@ class KernelSet:
         )
         return jnp.where(valid, -d, _NEG_INF)
 
-    def _block_topk(self, scores):
-        """Exact top-k of f32[B, blk] via the 2-stage sublane reduction."""
-        b, blk = scores.shape
-        k, sub = self.top_k, self.TOPK_SUB
-        if blk <= sub or blk % sub != 0:
-            return lax.top_k(scores, k)
-        nsub = blk // sub
-        tiles = scores.reshape(b, nsub, sub)
-        submax = tiles.max(axis=2)                       # (B, nsub)
-        kk = min(k, nsub)
-        _, top_sub = lax.top_k(submax, kk)               # (B, kk)
-        cand = jnp.take_along_axis(tiles, top_sub[:, :, None], axis=1)
-        cand = cand.reshape(b, kk * sub)
-        v, ci = lax.top_k(cand, k)
-        sub_base = jnp.take_along_axis(top_sub, ci // sub, axis=1) * sub
-        return v, sub_base + ci % sub
+    @staticmethod
+    def _block_best(scores):
+        """Best candidate of f32[B, blk]: pure max+argmax reduces.
 
-    def _merge_topk(self, best_v, best_i, v, gi):
-        k = self.top_k
-        cat_v = jnp.concatenate([best_v, v], axis=1)
-        cat_i = jnp.concatenate([best_i, gi], axis=1)
-        nv, sel = lax.top_k(cat_v, k)
-        return nv, jnp.take_along_axis(cat_i, sel, axis=1)
+        No gathers, no sorts — XLA fuses the score computation straight
+        into the reduction, so the (B, blk) score matrix is never
+        materialized in HBM. Candidate LISTS come from stacking one best
+        per pool block: distinct blocks ⇒ distinct slots, and the global
+        best candidate (the oracle's pick) is always present, so pairing
+        retains reference semantics; conflict losers fall back to their
+        best in OTHER blocks (instead of the global runner-up), which only
+        matters under contention where the oracle order is already
+        arrival-dependent (see tests/test_oracle_equiv.py layer 2)."""
+        return scores.max(axis=1), jnp.argmax(scores, axis=1)
 
-    def _topk_candidates(self, batch: dict[str, Any], q_thr_eff,
-                         pool: dict[str, Any], now):
-        """Streaming top-k over pool blocks: (vals f32[B,K], idx i32[B,K]).
+    def _candidates(self, batch: dict[str, Any], q_thr_eff,
+                    pool: dict[str, Any], now):
+        """Best-per-block candidate lists: (vals f32[B, n_blocks],
+        idx i32[B, n_blocks]), fully fused (no score materialization).
 
         Standalone (no admission) — the sharded engine admits separately
-        per shard; the single-device hot path uses the fused scan in
-        ``_search_step``."""
-        b = batch["rating"].shape[0]
+        per shard; the single-device hot path fuses admission into the
+        same scan in ``_search_step``. Unmatched lanes hold the sentinel
+        ``capacity`` / −inf."""
         blk = self.pool_block
 
-        def body(carry, blk_i):
+        def body(_, blk_i):
             start = blk_i * blk
             block = {f: lax.dynamic_slice_in_dim(pool[f], start, blk)
                      for f in (*_ADMIT_FIELDS, "active")}
             scores = self._score_block(batch, q_thr_eff, block, start, now)
-            v, i = self._block_topk(scores)
-            return self._merge_topk(*carry, v, i.astype(jnp.int32) + start), None
+            v, i = self._block_best(scores)
+            return None, (v, (i + start).astype(jnp.int32))
 
-        init = (
-            jnp.full((b, self.top_k), _NEG_INF, jnp.float32),
-            jnp.full((b, self.top_k), self.capacity, jnp.int32),
-        )
-        (vals, idxs), _ = lax.scan(body, init, jnp.arange(self.n_blocks, dtype=jnp.int32))
+        _, (vs, is_) = lax.scan(body, None,
+                                jnp.arange(self.n_blocks, dtype=jnp.int32))
+        vals = vs.T                                       # (B, n_blocks)
+        idxs = jnp.where(vals > _NEG_INF, is_.T, self.capacity)
         return vals, idxs
 
     def _topk_pallas(self, batch: dict[str, Any], q_thr_eff,
                      pool: dict[str, Any], now):
-        """Pallas variant of the score+top-k hot op (engine/pallas_kernels):
-        score tiles and the running top-k stay in VMEM."""
+        """Pallas variant of the candidate hot op (engine/pallas_kernels):
+        score tiles stay in VMEM; same best-per-block lists as
+        ``_candidates`` (identical block geometry). Falls back to the XLA
+        scan when the geometry exceeds the kernel's 128-lane result tile."""
         from matchmaking_tpu.engine.pallas_kernels import (
+            LANES,
             pack_batch_rows,
             pack_pool_rows,
-            pallas_topk,
+            pallas_block_best,
         )
 
-        return pallas_topk(
+        if self.n_blocks > LANES:  # pragma: no cover - config-dependent
+            return self._candidates(batch, q_thr_eff, pool, now)
+
+        return pallas_block_best(
             pack_pool_rows(pool), pack_batch_rows(batch, q_thr_eff), now,
-            blk=min(2048, self.pool_block), b_tile=256, top_k=self.top_k,
+            super_blk=self.pool_block, sub_blk=2048, b_tile=256,
             capacity=self.capacity, glicko2=self.glicko2,
             widen_per_sec=self.widen_per_sec,
             max_threshold=self.max_threshold,
@@ -401,27 +424,24 @@ class KernelSet:
 
         if self.use_pallas:
             # Pallas path: separate admit pass, then the VMEM-resident
-            # score+top-k kernel (pallas_kernels.pallas_topk).
+            # best-per-block kernel (pallas_kernels.pallas_block_best).
             pool = self._admit(pool, batch)
             vals, idxs = self._topk_pallas(batch, q_thr_eff, pool, now)
         else:
-            def body(carry, blk_i):
+            def body(_, blk_i):
                 start = blk_i * blk
                 block = {f: lax.dynamic_slice_in_dim(pool[f], start, blk)
                          for f in (*_ADMIT_FIELDS, "active")}
                 block = _admit_block(block, start, blk, batch)
                 scores = self._score_block(batch, q_thr_eff, block, start, now)
-                v, i = self._block_topk(scores)
-                carry = self._merge_topk(*carry, v, i.astype(jnp.int32) + start)
-                return carry, block
+                v, i = self._block_best(scores)
+                return None, (block, v, (i + start).astype(jnp.int32))
 
-            init = (
-                jnp.full((b, self.top_k), _NEG_INF, jnp.float32),
-                jnp.full((b, self.top_k), self.capacity, jnp.int32),
-            )
-            (vals, idxs), blocks = lax.scan(
-                body, init, jnp.arange(self.n_blocks, dtype=jnp.int32))
+            _, (blocks, vs, is_) = lax.scan(
+                body, None, jnp.arange(self.n_blocks, dtype=jnp.int32))
             pool = {f: blocks[f].reshape(self.capacity) for f in blocks}
+            vals = vs.T                                   # (B, n_blocks)
+            idxs = jnp.where(vals > _NEG_INF, is_.T, self.capacity)
 
         out_q, out_c, out_d = self.greedy_pair(vals, idxs, batch["slot"])
 

@@ -1,35 +1,30 @@
-"""Pallas TPU kernel for the hot op: fused masked scoring + streaming top-k.
+"""Pallas TPU kernel for the hot op: fused masked scoring + best-per-block.
 
-The XLA path (`kernels._topk_candidates`) scans pool blocks with
-`lax.top_k`; this Pallas version keeps the whole (B_TILE × BLK) score tile
-and the running top-k in VMEM, so scores never round-trip HBM and the top-k
-is an in-register iterative extraction instead of a sort:
+Mirrors the XLA hot path (`kernels._candidates` / the fused scan in
+`kernels._search_step`): for every request row, the best candidate within
+each pool block of ``super_blk`` slots (= the engine's ``pool_block``, so the
+candidate lists are IDENTICAL to the XLA path's — same block geometry, same
+first-index tie preference). The score tile lives in VMEM and is reduced
+immediately; nothing (B × blk)-shaped ever touches HBM:
 
-    grid = (B / B_TILE, P / BLK)      # pool-block axis innermost
-    per cell: score tile (VPU) → K exact max-extractions → insert into the
-    running per-row top-K held in VMEM scratch across the pool-block axis;
-    the last block writes the result.
+    grid = (B / B_TILE, P / SUPER_BLK)    # pool-block axis innermost
+    per cell: unrolled sub-tiles of ``sub_blk`` pool slots → score (VPU)
+    → row max/argmax folded across sub-tiles (strict >, keeping the earlier
+    index like jnp.argmax) → lane j of the running (B_TILE, 128) result in
+    VMEM scratch; the last block writes the output.
 
-Semantics match the XLA path at the SET level (same K candidate scores; in
-interpret mode the index sets are identical). One documented divergence on
-real TPU hardware: when two candidates tie EXACTLY at the K-th score,
-Mosaic's argmax/argmin lane tie order may keep a different — equally
-distant — candidate than XLA's top_k (measured ~0.7% of rows at K=8 over a
-100k continuous-rating pool). Both choices are equally valid matches and
-each path is individually deterministic (sharded replication stays
-consistent); the greedy pairing depends on VALUES, not lane order. The
-ORDER of the K output lanes is unspecified (unsorted).
-
-Measured on v5e (B=1024, P=131k, K=8): ≈ parity with the fused-XLA scan
-(6.9 ms vs 7.2 ms in the same backend phase) — the XLA path remains the
-default; flip ``EngineConfig.use_pallas`` after benchmarking on your chip.
+Measured on v5e (round 2): the XLA fused scan and this kernel are within
+noise of each other once both avoid materializing scores (the round-1 top-k
+variants were 2-4× slower than either). The XLA path remains the default;
+``EngineConfig.use_pallas`` flips to this kernel after benchmarking on your
+chip (`scripts/profile_stages.py --mode device`).
 
 Layout notes (TPU tiling wants trailing-dim 128):
 - pool fields pre-packed (7, P) f32: rating, rd, region, mode, threshold,
   enqueue_t, active — codes/flags are exact in f32.
 - batch packed (B, 128) f32, first 7 columns: slot, rating, rd, region,
   mode, eff_threshold (widening pre-applied), valid.
-- outputs (B, 128) f32 ×2 (vals, idx); callers slice [:, :K].
+- outputs (B, 128) f32 ×2 (vals, idx); callers slice [:, :n_blocks].
 
 Gated by ``EngineConfig.use_pallas``; on non-TPU backends the pallas_call
 runs in interpret mode (tests), so CPU correctness is pinned against the
@@ -39,6 +34,7 @@ XLA path.
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -54,7 +50,7 @@ except ImportError:  # pragma: no cover
     _VMEM = _SMEM = None
 
 _NEG_INF = -jnp.inf
-LANES = 128  # output/pad width (TPU lane count)
+LANES = 128  # output/pad width (TPU lane count) — caps n_blocks at 128
 
 #: Row order of the packed pool input.
 POOL_ROWS = ("rating", "rd", "region", "mode", "threshold", "enqueue_t",
@@ -62,7 +58,7 @@ POOL_ROWS = ("rating", "rd", "region", "mode", "threshold", "enqueue_t",
 
 
 def _kernel(now_ref, pool_ref, batch_ref, out_v_ref, out_i_ref,
-            best_v, best_i, *, blk: int, top_k: int, capacity: int,
+            best_v, best_i, *, super_blk: int, sub_blk: int, capacity: int,
             glicko2: bool, widen_per_sec: float, max_threshold: float,
             g_coeff: float):
     j = pl.program_id(1)
@@ -81,60 +77,62 @@ def _kernel(now_ref, pool_ref, batch_ref, out_v_ref, out_i_ref,
     q_thr_eff = b[:, 5:6]
     q_valid = b[:, 6:7]
 
-    p = pool_ref[:]                       # (7, BLK)
-    c_rating = p[0:1, :]
-    c_rd = p[1:2, :]
-    c_reg = p[2:3, :]
-    c_mode = p[3:4, :]
-    c_thr = p[4:5, :]
-    c_enq = p[5:6, :]
-    c_act = p[6:7, :]
+    b_tile = b.shape[0]
+    blk_v = jnp.full((b_tile,), _NEG_INF, jnp.float32)
+    blk_i = jnp.full((b_tile,), float(capacity), jnp.float32)
 
-    d = jnp.abs(q_rating - c_rating)      # (B_TILE, BLK)
-    if glicko2:
-        # EXACTLY scoring.glicko_g's expression (1/x**0.5, not rsqrt —
-        # the approximate reciprocal sqrt diverges from the XLA path by
-        # ulps, which breaks set-level equivalence at threshold edges).
-        rd2 = q_rd * q_rd + c_rd * c_rd
-        d = d * (1.0 / (1.0 + g_coeff * rd2) ** 0.5)
-    if widen_per_sec > 0.0:
-        now = now_ref[0, 0]
-        waited = jnp.maximum(0.0, now - c_enq)
-        c_thr_eff = jnp.minimum(jnp.float32(max_threshold),
-                                c_thr + jnp.float32(widen_per_sec) * waited)
-    else:
-        c_thr_eff = c_thr
-    limit = jnp.minimum(q_thr_eff, c_thr_eff)
+    # Unrolled sub-tiles: the (B_TILE, sub_blk) score tile stays in VMEM and
+    # is reduced immediately; the fold keeps the EARLIER index on exact ties
+    # (strict >), matching jnp.argmax over the whole block.
+    for s in range(super_blk // sub_blk):
+        p = pool_ref[:, s * sub_blk:(s + 1) * sub_blk]   # (7, sub_blk)
+        c_rating = p[0:1, :]
+        c_rd = p[1:2, :]
+        c_reg = p[2:3, :]
+        c_mode = p[3:4, :]
+        c_thr = p[4:5, :]
+        c_enq = p[5:6, :]
+        c_act = p[6:7, :]
 
-    region_ok = (q_reg == 0.0) | (c_reg == 0.0) | (q_reg == c_reg)
-    mode_ok = (q_mode == 0.0) | (c_mode == 0.0) | (q_mode == c_mode)
-    # Mosaic: iota must be integer-typed; cast after.
-    gidx = jnp.float32(j * blk) + jax.lax.broadcasted_iota(
-        jnp.int32, (1, blk), 1).astype(jnp.float32)
-    valid = ((c_act > 0.0) & (q_valid > 0.0) & region_ok & mode_ok
-             & (q_slot != gidx) & (d <= limit))
-    scores = jnp.where(valid, -d, _NEG_INF)
+        d = jnp.abs(q_rating - c_rating)  # (B_TILE, sub_blk)
+        if glicko2:
+            # EXACTLY scoring.glicko_g's expression (1/x**0.5, not rsqrt —
+            # the approximate reciprocal sqrt diverges from the XLA path by
+            # ulps, which breaks equivalence at threshold edges).
+            rd2 = q_rd * q_rd + c_rd * c_rd
+            d = d * (1.0 / (1.0 + g_coeff * rd2) ** 0.5)
+        if widen_per_sec > 0.0:
+            now = now_ref[0, 0]
+            waited = jnp.maximum(0.0, now - c_enq)
+            c_thr_eff = jnp.minimum(
+                jnp.float32(max_threshold),
+                c_thr + jnp.float32(widen_per_sec) * waited)
+        else:
+            c_thr_eff = c_thr
+        limit = jnp.minimum(q_thr_eff, c_thr_eff)
 
-    b_tile = scores.shape[0]
-    lane_b = jax.lax.broadcasted_iota(jnp.int32, (b_tile, blk), 1)
-    lane_k = jax.lax.broadcasted_iota(jnp.int32, (b_tile, top_k), 1)
-    for _ in range(top_k):
-        # Exact extraction: per-row max of the remaining tile...
-        v = jnp.max(scores, axis=1, keepdims=True)            # (B_TILE, 1)
-        a = jnp.argmax(scores, axis=1)                        # (B_TILE,)
-        gi = jnp.float32(j * blk) + a.astype(jnp.float32)
-        # ...inserted over the running top-K's minimum iff strictly better
-        # (strict: on equal scores the incumbent — earlier pool index —
-        # wins, matching the XLA streaming merge's tie preference).
-        bv = best_v[:, :top_k]
-        mn = jnp.min(bv, axis=1, keepdims=True)
-        am = jnp.argmin(bv, axis=1)
-        take = v > mn
-        onehot = (lane_k == am[:, None]) & take
-        best_v[:, :top_k] = jnp.where(onehot, v, bv)
-        best_i[:, :top_k] = jnp.where(onehot, gi[:, None], best_i[:, :top_k])
-        # Retire the extracted element from this tile.
-        scores = jnp.where(lane_b == a[:, None], _NEG_INF, scores)
+        region_ok = (q_reg == 0.0) | (c_reg == 0.0) | (q_reg == c_reg)
+        mode_ok = (q_mode == 0.0) | (c_mode == 0.0) | (q_mode == c_mode)
+        # Mosaic: iota must be integer-typed; cast after.
+        base = jnp.float32(j * super_blk + s * sub_blk)
+        gidx = base + jax.lax.broadcasted_iota(
+            jnp.int32, (1, sub_blk), 1).astype(jnp.float32)
+        valid = ((c_act > 0.0) & (q_valid > 0.0) & region_ok & mode_ok
+                 & (q_slot != gidx) & (d <= limit))
+        scores = jnp.where(valid, -d, _NEG_INF)
+
+        v = jnp.max(scores, axis=1)                       # (B_TILE,)
+        a = jnp.argmax(scores, axis=1)                    # (B_TILE,)
+        gi = base + a.astype(jnp.float32)
+        take = v > blk_v
+        blk_v = jnp.where(take, v, blk_v)
+        blk_i = jnp.where(take & (v > _NEG_INF), gi, blk_i)
+
+    # Deposit this block's best into lane j of the running result.
+    lane = jax.lax.broadcasted_iota(jnp.int32, (b_tile, LANES), 1)
+    onehot = lane == j
+    best_v[:] = jnp.where(onehot, blk_v[:, None], best_v[:])
+    best_i[:] = jnp.where(onehot, blk_i[:, None], best_i[:])
 
     @pl.when(j == pl.num_programs(1) - 1)
     def _():
@@ -144,28 +142,35 @@ def _kernel(now_ref, pool_ref, batch_ref, out_v_ref, out_i_ref,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("blk", "b_tile", "top_k", "capacity", "glicko2",
+    static_argnames=("super_blk", "sub_blk", "b_tile", "capacity", "glicko2",
                      "widen_per_sec", "max_threshold", "interpret"))
-def pallas_topk(pool_packed, batch_packed, now, *, blk: int, b_tile: int,
-                top_k: int, capacity: int, glicko2: bool,
-                widen_per_sec: float, max_threshold: float,
-                interpret: bool = False):
-    """(pool f32[7,P], batch f32[B,128], now f32) → (vals f32[B,K],
-    idx i32[B,K])."""
-    import math
-
+def pallas_block_best(pool_packed, batch_packed, now, *, super_blk: int,
+                      sub_blk: int, b_tile: int, capacity: int, glicko2: bool,
+                      widen_per_sec: float, max_threshold: float,
+                      interpret: bool = False):
+    """(pool f32[7,P], batch f32[B,128], now f32) → (vals f32[B,n_blocks],
+    idx i32[B,n_blocks]) — best candidate per ``super_blk``-wide pool block,
+    identical lists to the XLA ``kernels._candidates``."""
     _, pcap = pool_packed.shape
     b = batch_packed.shape[0]
-    b_tile = min(b_tile, b)
-    blk = min(blk, pcap)
-    assert pcap % blk == 0 and b % b_tile == 0
+    # b_tile must divide b (batch buckets are arbitrary ints — round-1
+    # advisory fix: derive a divisor instead of asserting).
+    b_tile = math.gcd(b, min(b_tile, b))
+    sub_blk = min(sub_blk, super_blk)
+    while super_blk % sub_blk != 0:
+        sub_blk //= 2
+    assert pcap % super_blk == 0
+    n_blocks = pcap // super_blk
+    assert n_blocks <= LANES, (
+        f"{n_blocks} pool blocks exceed the {LANES}-lane result tile; "
+        f"raise pool_block")
     q = math.log(10.0) / 400.0
     g_coeff = 3.0 * q * q / (math.pi * math.pi)
 
     kernel = functools.partial(
-        _kernel, blk=blk, top_k=top_k, capacity=capacity, glicko2=glicko2,
-        widen_per_sec=widen_per_sec, max_threshold=max_threshold,
-        g_coeff=g_coeff)
+        _kernel, super_blk=super_blk, sub_blk=sub_blk, capacity=capacity,
+        glicko2=glicko2, widen_per_sec=widen_per_sec,
+        max_threshold=max_threshold, g_coeff=g_coeff)
     mem = {} if pltpu is None else {"memory_space": _VMEM}
     smem = {} if pltpu is None else {"memory_space": _SMEM}
     scratch = (
@@ -176,10 +181,10 @@ def pallas_topk(pool_packed, batch_packed, now, *, blk: int, b_tile: int,
     )
     out_v, out_i = pl.pallas_call(
         kernel,
-        grid=(b // b_tile, pcap // blk),
+        grid=(b // b_tile, n_blocks),
         in_specs=[
             pl.BlockSpec((1, 1), lambda i, j: (0, 0), **smem),
-            pl.BlockSpec((len(POOL_ROWS), blk), lambda i, j: (0, j), **mem),
+            pl.BlockSpec((len(POOL_ROWS), super_blk), lambda i, j: (0, j), **mem),
             pl.BlockSpec((b_tile, LANES), lambda i, j: (i, 0), **mem),
         ],
         out_specs=[
@@ -193,7 +198,7 @@ def pallas_topk(pool_packed, batch_packed, now, *, blk: int, b_tile: int,
         scratch_shapes=scratch,
         interpret=interpret,
     )(jnp.asarray(now, jnp.float32).reshape(1, 1), pool_packed, batch_packed)
-    return out_v[:, :top_k], out_i[:, :top_k].astype(jnp.int32)
+    return out_v[:, :n_blocks], out_i[:, :n_blocks].astype(jnp.int32)
 
 
 def pack_pool_rows(pool: dict) -> jnp.ndarray:

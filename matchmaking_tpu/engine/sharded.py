@@ -7,17 +7,19 @@ slot dimension is sharded over a ``jax.sharding.Mesh`` axis ``"pool"`` and
 each window is matched with XLA collectives over ICI:
 
 1. every shard scores the (replicated) request window against its local pool
-   block and keeps a local top-k — compute scales 1/n per chip;
-2. the tiny B×k candidate lists are merged across shards, either with one
-   ``all_gather`` (default; ≤ a few hundred KB) or with a ``ppermute`` ring
-   in which each hop merges a neighbor's running top-k — structurally ring
-   attention with "scores" = masked −distance and "softmax" = running top-k
-   (SURVEY.md §5's long-context analog);
+   slice and keeps the best candidate per pool block (fused max/argmax —
+   no score materialization) — compute scales 1/n per chip;
+2. the tiny B×n_blocks candidate lists are collected across shards, either
+   with one ``all_gather`` (default; ≤ a few hundred KB) or with a
+   ``ppermute`` ring in which each hop passes a neighbor's candidates —
+   structurally ring attention with "scores" = masked −distance and
+   "softmax" = the best-candidate reduction (SURVEY.md §5's long-context
+   analog);
 3. greedy pairing runs replicated on the merged lists (deterministic, so all
    shards agree), and each shard evicts its own slice of the matched slots.
 
-The merged result is EXACTLY the global top-k (the global best k candidates
-per request are each the best within their own shard), so sharded and
+The merged lists contain the global best candidate per request (the best
+per block of its own shard), in canonical block order, so sharded and
 single-device engines produce identical matches — pinned by tests on the
 8-virtual-device CPU mesh.
 
@@ -79,11 +81,23 @@ class ShardedKernelSet:
         self.evict_bucket = evict_bucket
         self.pair_rounds = pair_rounds
         # Per-shard compute reuses the single-device kernel internals on the
-        # LOCAL slice (capacity = local_capacity).
+        # LOCAL slice (capacity = local_capacity). Block geometry is derived
+        # from the GLOBAL capacity first: identical block boundaries are what
+        # make sharded and single-device candidate lists — and therefore
+        # matches — identical (test_sharded_equals_single_device). When the
+        # global block doesn't fit the local slice (pool_block >
+        # local_capacity), blocks shrink to the slice and the two engines'
+        # fallback candidates may legally differ under contention (the best
+        # candidate, and so oracle semantics, are unaffected).
+        from matchmaking_tpu.engine.kernels import effective_pool_block
+
+        global_block = effective_pool_block(capacity, pool_block, top_k)
         self.local = KernelSet(
             capacity=self.local_capacity, top_k=top_k,
-            pool_block=min(pool_block, self.local_capacity), glicko2=glicko2,
+            pool_block=min(global_block, self.local_capacity),
+            glicko2=glicko2,
             widen_per_sec=widen_per_sec, max_threshold=max_threshold,
+            exact_block=True,
         )
         self.top_k = self.local.top_k
         self.widen_per_sec = widen_per_sec
@@ -163,16 +177,19 @@ class ShardedKernelSet:
         mine = (local >= 0) & (local < self.local_capacity)
         return self.local._evict(pool, jnp.where(mine, local, self.local_capacity))
 
-    def _global_topk(self, vals, gidx):
-        """Merge per-shard top-k into the global top-k on every shard.
+    def _global_merge(self, vals, gidx):
+        """Concatenate per-shard best-per-block candidate lists on every
+        shard, in CANONICAL shard order.
 
-        Both paths assemble the n contributions in CANONICAL shard order
-        before the final top-k: lax.top_k breaks exact-score ties by input
-        position, so a shard-dependent merge order would let tied candidates
-        win on some shards and lose on others — the "replicated" pairing
-        would then diverge across shards and desynchronize device state from
-        the host mirror (exact distance ties are common with integer
-        ratings).
+        Canonical order matters: greedy pairing breaks exact-score ties by
+        candidate position, so a shard-dependent merge order would let tied
+        candidates win on some shards and lose on others — the "replicated"
+        pairing would then diverge across shards and desynchronize device
+        state from the host mirror (exact distance ties are common with
+        integer ratings). Because shard s's local blocks cover the global
+        slot range [s·localP, (s+1)·localP) in order, the merged list is
+        exactly the single-device kernel's best-per-block list whenever the
+        block geometry matches — pinned by test_sharded_equals_single_device.
         """
         n = self.n_shards
         b, k = vals.shape
@@ -180,8 +197,8 @@ class ShardedKernelSet:
             av = lax.all_gather(vals, AXIS)            # (n, B, k), axis order
             ai = lax.all_gather(gidx, AXIS)
         else:
-            # Ring collect: rotate the ORIGINAL local top-k one hop per step
-            # (the ring-attention communication pattern — each hop only
+            # Ring collect: rotate the ORIGINAL local candidates one hop per
+            # step (the ring-attention communication pattern — each hop only
             # talks to a neighbor) and scatter each received block into its
             # source shard's slot, so the final merge sees the identical
             # canonically-ordered buffer on every shard.
@@ -198,8 +215,7 @@ class ShardedKernelSet:
                 ai = ai.at[src].set(rot_i)
         av = jnp.moveaxis(av, 0, 1).reshape(b, n * k)
         ai = jnp.moveaxis(ai, 0, 1).reshape(b, n * k)
-        nv, sel = lax.top_k(av, self.top_k)
-        return nv, jnp.take_along_axis(ai, sel, axis=1)
+        return av, ai
 
     # ---- the sharded step -------------------------------------------------
 
@@ -211,8 +227,9 @@ class ShardedKernelSet:
         local_batch = self._localize_batch(batch)
         pool = lk._admit(pool, local_batch)
 
-        # 2. Local top-k against the local pool block. The batch keeps its
-        #    GLOBAL slot ids for self-masking: compare against global index.
+        # 2. Local best-per-block candidates against the local pool slice.
+        #    The batch keeps its GLOBAL slot ids for self-masking: compare
+        #    against global index.
         q_thr_eff = _effective_threshold(
             batch["threshold"], batch["enqueue_t"], now,
             self.widen_per_sec, self.max_threshold,
@@ -221,14 +238,15 @@ class ShardedKernelSet:
         # frame (non-local ids land outside [0, local_capacity) and thus
         # never self-mask, which is correct — the self slot lives on exactly
         # one shard).
-        vals, idxs_local = lk._topk_candidates(
+        vals, idxs_local = lk._candidates(
             dict(batch, slot=batch["slot"] - offset), q_thr_eff, pool, now
         )
         gidx = jnp.where(idxs_local >= self.local_capacity,
                          self.capacity, idxs_local + offset)
 
-        # 3. Global top-k on every shard (all_gather or ppermute ring).
-        mv, mi = self._global_topk(vals, gidx)
+        # 3. Canonical-order global candidate lists on every shard
+        #    (all_gather or ppermute ring).
+        mv, mi = self._global_merge(vals, gidx)
 
         # 4. Replicated greedy pairing on global ids (deterministic — every
         #    shard computes the identical pairing, no broadcast needed).

@@ -586,7 +586,9 @@ async def _demo() -> None:
         client.search_until_matched(p, timeout=5.0) for p in players
     ])
     for resp in results:
-        match_id = resp.match.match_id[:8] if resp.match else "-"
+        # Show the id TAIL: the head is the shared per-process prefix
+        # (contract.new_match_id), identical for every match in this run.
+        match_id = resp.match.match_id[-8:] if resp.match else "-"
         print(f"{resp.player_id}: {resp.status} match={match_id}")
     print("metrics:", app.metrics.report_json())
     await app.stop()

@@ -248,3 +248,40 @@ def test_admit_and_evict_roundtrip():
     pool = ks.evict(pool, jnp.asarray(ev))
     active = np.asarray(pool["active"])
     assert not active[3] and active[7] and active.sum() == 1
+
+
+def test_small_pool_still_splits_into_fallback_blocks():
+    """Candidate-list width is n_blocks (best-per-block), so a pool smaller
+    than the configured pool_block must still split into enough blocks for
+    conflict losers to have fallback candidates (round-2 review finding:
+    capacity=4096 with default pool_block=8192 used to collapse to ONE
+    block/candidate)."""
+    from matchmaking_tpu.engine.kernels import effective_pool_block
+
+    assert effective_pool_block(4096, 8192, 8) == 512       # 8 blocks
+    assert effective_pool_block(512, 128, 4) == 128         # 4 blocks kept
+    assert effective_pool_block(131072, 8192, 8) == 8192    # 16 blocks kept
+    ks = KernelSet(capacity=4096, top_k=8, pool_block=8192, glicko2=False,
+                   widen_per_sec=0.0, max_threshold=200.0)
+    assert ks.n_blocks >= 8
+
+
+def test_conflict_loser_falls_back_to_other_block():
+    """Two queries share the same best candidate; the loser must still match
+    its second-best, which lives in another pool block."""
+    ks = make_kernels(capacity=256, pool_block=64)
+    pool = empty_pool()
+    # Candidates: slot 10 (rating 1000, the shared best) and slot 200
+    # (rating 1010, the fallback, in another block). Threshold 5 on slot 10
+    # keeps the two candidates from matching each other in this window
+    # (d=10 > 5) while still accepting the d=1 queries below.
+    b1 = make_batch([10, 200], [1000.0, 1010.0], bucket=4, capacity=256,
+                    thresholds=[5.0, 50.0])
+    pool, *_ = run_step(ks, pool, b1)
+    # Queries at 999 and 1001: both prefer slot 10 (|d|=1), fallback |d|>=9.
+    b2 = make_batch([3, 4], [999.0, 1001.0], bucket=4, capacity=256,
+                    thresholds=[50.0, 50.0])
+    pool, q, c, _ = run_step(ks, pool, b2)
+    got = {(int(a), int(b)) for a, b in zip(q, c) if a < 256}
+    assert len(got) == 2                       # both queries matched
+    assert {p[1] for p in got} == {10, 200}    # winner got 10, loser got 200

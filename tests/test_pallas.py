@@ -1,6 +1,6 @@
-"""Pallas score+top-k kernel (engine/pallas_kernels.py) vs the XLA path —
-same candidate SETS (order is unspecified), same engine-level matches.
-Runs in interpret mode on the CPU test mesh."""
+"""Pallas block-best kernel (engine/pallas_kernels.py) vs the XLA path —
+identical candidate lists (same block geometry, same first-index tie rule),
+same engine-level matches. Runs in interpret mode on the CPU test mesh."""
 
 import numpy as np
 import pytest
@@ -42,7 +42,7 @@ def _batch(rng, b, capacity, start_slot, thr=100.0):
 
 @pytest.mark.parametrize("glicko2,widen", [(False, 0.0), (True, 0.0),
                                            (False, 7.0)])
-def test_pallas_topk_matches_xla_sets(rng, glicko2, widen):
+def test_pallas_matches_xla_candidates(rng, glicko2, widen):
     P, B = 1024, 64
     ks = KernelSet(capacity=P, top_k=8, pool_block=256, glicko2=glicko2,
                    widen_per_sec=widen, max_threshold=300.0, use_pallas=True)
@@ -52,19 +52,16 @@ def test_pallas_topk_matches_xla_sets(rng, glicko2, widen):
     q_thr_eff = _effective_threshold(batch["threshold"], batch["enqueue_t"],
                                      now, widen, 300.0)
 
-    xla_v, xla_i = ks._topk_candidates(batch, q_thr_eff, pool, now)
+    xla_v, xla_i = ks._candidates(batch, q_thr_eff, pool, now)
     pal_v, pal_i = ks._topk_pallas(batch, q_thr_eff, pool, now)
 
-    xla_v, xla_i = np.asarray(xla_v), np.asarray(xla_i)
-    pal_v, pal_i = np.asarray(pal_v), np.asarray(pal_i)
-    for r in range(B):
-        # Same candidate sets (order unspecified). Real candidates only —
-        # sentinel lanes carry -inf in both.
-        x = {(int(i), float(v)) for v, i in zip(xla_v[r], xla_i[r])
-             if np.isfinite(v)}
-        p = {(int(i), float(v)) for v, i in zip(pal_v[r], pal_i[r])
-             if np.isfinite(v)}
-        assert x == p, f"row {r}"
+    # Identical block geometry + identical tie rule ⇒ lists match exactly
+    # (position by position), not just as sets.
+    np.testing.assert_array_equal(np.asarray(xla_i), np.asarray(pal_i))
+    x_v, p_v = np.asarray(xla_v), np.asarray(pal_v)
+    finite = np.isfinite(x_v)
+    assert (finite == np.isfinite(p_v)).all()
+    np.testing.assert_allclose(x_v[finite], p_v[finite], rtol=0, atol=0)
 
 
 def test_pallas_engine_end_to_end_equivalence(rng):
@@ -101,7 +98,7 @@ def test_pallas_engine_end_to_end_equivalence(rng):
 
 
 def test_pallas_small_buckets(rng):
-    """Tiny buckets (B=16 < b_tile) and capacity not divisible by 2048."""
+    """Tiny buckets (B=16 < b_tile) and non-2048-divisible geometry."""
     P, B = 256, 16
     ks = KernelSet(capacity=P, top_k=4, pool_block=64, glicko2=False,
                    widen_per_sec=0.0, max_threshold=400.0, use_pallas=True)
@@ -109,11 +106,10 @@ def test_pallas_small_buckets(rng):
     batch = _batch(rng, B, P, start_slot=100)
     now = jnp.float32(1.0)
     v, i = ks._topk_pallas(batch, batch["threshold"], pool, now)
-    assert v.shape == (B, 4) and i.shape == (B, 4)
-    xv, xi = ks._topk_candidates(batch, batch["threshold"], pool, now)
-    for r in range(B):
-        x = {(int(a), float(b)) for b, a in zip(np.asarray(xv)[r], np.asarray(xi)[r])
-             if np.isfinite(b)}
-        p = {(int(a), float(b)) for b, a in zip(np.asarray(v)[r], np.asarray(i)[r])
-             if np.isfinite(b)}
-        assert x == p
+    assert v.shape == (B, 4) and i.shape == (B, 4)  # 4 blocks of 64
+    xv, xi = ks._candidates(batch, batch["threshold"], pool, now)
+    np.testing.assert_array_equal(np.asarray(xi), np.asarray(i))
+    x_v, p_v = np.asarray(xv), np.asarray(v)
+    finite = np.isfinite(x_v)
+    assert (finite == np.isfinite(p_v)).all()
+    np.testing.assert_array_equal(x_v[finite], p_v[finite])
