@@ -158,6 +158,10 @@ class Config:
     debug_invariants: bool = False
     #: Optional HTTP observability endpoint (0 disables).
     metrics_port: int = 0
+    #: Bind host for the observability endpoint. The localhost default is
+    #: safe for bare-metal; containers must set ``0.0.0.0`` (the Dockerfile
+    #: does) or docker-compose port mappings can't reach /metrics.
+    metrics_host: str = "127.0.0.1"
 
     # ---- loading -----------------------------------------------------------
 
@@ -181,7 +185,8 @@ class Config:
                     if f.name in sub and isinstance(sub[f.name], list):
                         sub[f.name] = tuple(sub[f.name])
                 kw[name] = cls(**sub)
-        for scalar in ("workers", "seed", "debug_invariants", "metrics_port"):
+        for scalar in ("workers", "seed", "debug_invariants", "metrics_port",
+                       "metrics_host"):
             if scalar in d:
                 kw[scalar] = d[scalar]
         return Config(**kw)
@@ -205,7 +210,8 @@ class Config:
                 val: Any = json.loads(raw)
             except (ValueError, json.JSONDecodeError):
                 val = raw
-            if key in ("workers", "seed", "debug_invariants", "metrics_port"):
+            if key in ("workers", "seed", "debug_invariants", "metrics_port",
+                       "metrics_host"):
                 d[key] = val
                 continue
             parts = key.split("_", 1)

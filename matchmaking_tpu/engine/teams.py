@@ -34,13 +34,16 @@ TPU-first notes:
   tightest-first sweep would keep, up to pathological chains (which stay in
   the pool for the next step — same leftover semantics as the 1v1 kernel).
 
-Grouping semantics (deviation, documented): the device path groups by EXACT
-(region, mode) code — wildcard players (code 0) form their own group and only
-match each other. The oracle expands wildcards into every concrete group
-(non-transitive pairwise compatibility); that expansion is data-dependent and
-host-shaped. Queues mixing wildcard and concrete players on team matching
-should use ``backend: "cpu"``; oracle-equivalence tests run on
-uniform-group pools where the two semantics coincide.
+Grouping semantics: the device path groups by EXACT (region, mode) code —
+wildcard players (code 0) form their own group and only match each other,
+whereas the oracle expands wildcards into every concrete group
+(non-transitive pairwise compatibility); that expansion is data-dependent
+and host-shaped. This divergence is ENFORCED away rather than documented
+away: ``TpuEngine._maybe_delegate_team`` flips a device team queue to the
+host oracle (with a one-time warning) the moment a wildcard request
+arrives, so device team matching only ever runs on all-concrete pools
+where the two semantics coincide (pinned by
+tests/test_teams_device.py::test_wildcard_requests_delegate_to_oracle).
 """
 
 from __future__ import annotations

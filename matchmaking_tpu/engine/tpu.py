@@ -24,6 +24,7 @@ path — run on device single- or multi-chip.
 
 from __future__ import annotations
 
+import logging
 import time
 from dataclasses import dataclass, field
 
@@ -51,6 +52,8 @@ from matchmaking_tpu.service.contract import (
     new_match_id,
     new_match_ids,
 )
+
+logger = logging.getLogger(__name__)
 
 
 @dataclass
@@ -254,6 +257,9 @@ class TpuEngine(Engine):
             pending.raw = []
             self._submit(pending)
             return token, SearchOutcome()
+
+        if self._maybe_delegate_team(requests, now):
+            return self.search_async(requests, now)  # re-enter via delegate
 
         pending = _Pending(token=self._next_token)
         self._next_token += 1
@@ -512,6 +518,9 @@ class TpuEngine(Engine):
         if self._team_delegate is not None:
             self._team_delegate.restore(requests, now)
             return
+        if self._maybe_delegate_team(requests, now):  # checkpoint w/ wildcards
+            self._team_delegate.restore(requests, now)
+            return
         fresh = [r for r in requests if r.id not in self.pool]
         bucket = self.buckets[-1]
         for start in range(0, len(fresh), bucket):
@@ -522,6 +531,49 @@ class TpuEngine(Engine):
                 self._dev_pool, jnp.asarray(pack_batch(batch)))
 
     # ---- internals --------------------------------------------------------
+
+    def _maybe_delegate_team(self, requests: Sequence[SearchRequest],
+                             now: float) -> bool:
+        """Wildcard guard for device team queues (one-time switch).
+
+        The device team kernel groups by EXACT (region, mode) code —
+        wildcard players would only match other wildcards, silently
+        diverging from the oracle's expand-into-every-group semantics
+        (teams.py "Grouping semantics"). Rather than let that happen, the
+        first wildcard request flips the whole queue to the host oracle:
+        waiting players transfer to a CpuEngine delegate (enqueue times
+        preserved), the device pool is dropped, and every later call
+        routes through the delegate (the same path role/party queues use).
+        """
+        if not self._team_device or self._team_delegate is not None:
+            return False
+        from matchmaking_tpu.service.contract import ANY
+
+        if not any(r.region == ANY or r.game_mode == ANY for r in requests):
+            return False
+        logger.warning(
+            "team queue %r: wildcard region/mode request received — device "
+            "team kernel matches wildcards only against wildcards, so this "
+            "queue now delegates to the host oracle (exact oracle "
+            "semantics; lower throughput). Pin region+mode on every "
+            "request to stay on the device path.", self.queue.name)
+        from matchmaking_tpu.engine.cpu import CpuEngine
+
+        assert self._open == 0, (
+            "wildcard delegation with windows in flight — team queues "
+            "dispatch synchronously, so this cannot happen"
+        )
+        delegate = CpuEngine(self.cfg, self.queue)
+        waiting = self.pool.waiting()
+        if waiting:
+            delegate.restore(waiting, now)
+        self._team_delegate = delegate
+        # Device state is now dead weight; drop the HBM arrays and reset
+        # the (no-longer-consulted) mirror.
+        self._dev_pool = None
+        self.pool = PlayerPool(self.kernels.capacity,
+                               self.queue.rating_threshold)
+        return True
 
     def _bucket_for(self, n: int) -> int:
         for b in self.buckets:

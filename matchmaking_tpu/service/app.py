@@ -520,7 +520,8 @@ class MatchmakingApp:
             from matchmaking_tpu.service.observability import ObservabilityServer
 
             self._observability = ObservabilityServer(
-                self, port=self.cfg.metrics_port)
+                self, host=self.cfg.metrics_host,
+                port=self.cfg.metrics_port)
             await self._observability.start()
         self._started = True
 
@@ -594,11 +595,51 @@ async def _demo() -> None:
     await app.stop()
 
 
+async def serve(stop: "asyncio.Event | None" = None,
+                pika_module=None) -> None:
+    """Production entrypoint: 12-factor config from ``MM_*`` env vars
+    (Config.from_env), real AMQP transport when ``MM_BROKER_URL`` points at
+    a RabbitMQ (``amqp://``/``amqps://``), in-process broker otherwise.
+    Runs until SIGTERM/SIGINT (or ``stop`` is set — the test seam, which
+    also injects ``pika_module``) — the Docker CMD."""
+    import signal
+
+    cfg = Config.from_env()
+    broker = None
+    url = cfg.broker.url
+    if url.startswith(("amqp://", "amqps://")):
+        from matchmaking_tpu.service.amqp_transport import AmqpBroker
+
+        broker = AmqpBroker(url, prefetch=cfg.broker.prefetch,
+                            pika_module=pika_module)
+        logging.getLogger(__name__).info("serving against AMQP broker %s", url)
+    else:
+        logging.getLogger(__name__).info(
+            "MM_BROKER_URL %r is not amqp:// — using the in-process broker "
+            "(demo/test semantics; clients must run in this process)", url)
+    app = MatchmakingApp(cfg, broker=broker)
+    await app.start()
+    if stop is None:
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                loop.add_signal_handler(sig, stop.set)
+            except NotImplementedError:  # pragma: no cover - non-unix
+                pass
+    try:
+        await stop.wait()
+    finally:
+        await app.stop()
+
+
 if __name__ == "__main__":
     import sys
 
     logging.basicConfig(level=logging.INFO)
     if "--demo" in sys.argv:
         asyncio.run(_demo())
+    elif "serve" in sys.argv or "--serve" in sys.argv:
+        asyncio.run(serve())
     else:
-        print("usage: python -m matchmaking_tpu.service.app --demo")
+        print("usage: python -m matchmaking_tpu.service.app [serve|--demo]")

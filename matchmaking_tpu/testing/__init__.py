@@ -1,0 +1,3 @@
+"""Test doubles shipped with the package (usable by downstream users'
+suites as well as our own CI): currently the in-memory pika fake that lets
+the AMQP adapter run without a RabbitMQ server."""

@@ -1,0 +1,403 @@
+"""In-memory pika stand-in: just enough of the BlockingConnection surface
+for `service/amqp_transport.AmqpBroker` to run without RabbitMQ.
+
+The reference's integration tests run against a real broker from
+docker-compose (SURVEY.md §4); this environment has neither RabbitMQ nor
+pika (SURVEY.md §7 [ENV]), so the adapter — the production deployment seam —
+would otherwise have zero executed coverage. This module emulates the
+broker-visible semantics the adapter depends on:
+
+- queues survive connection loss (they live on the ``FakeServer``);
+- unacked deliveries are requeued when their connection dies
+  (at-least-once, ``redelivered`` set on the second pass);
+- killing a connection makes every blocking call raise pika-shaped
+  connection errors (``exceptions.StreamLostError`` / ``AMQPConnectionError``)
+  so reconnect paths can be exercised deterministically;
+- a server can be marked ``down`` so even *new* ``BlockingConnection``
+  attempts fail, exercising retry/backoff.
+
+Threading model mirrors pika's BlockingConnection: one thread may sit in
+``start_consuming`` while others call ``add_callback_threadsafe``; the
+fake runs those callbacks on the consuming thread between deliveries (or
+inline when nobody is consuming), like pika's ioloop does.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+
+# ---- pika-shaped exception hierarchy --------------------------------------
+
+class exceptions:  # noqa: N801 - mirrors the `pika.exceptions` module path
+    class AMQPError(Exception):
+        pass
+
+    class AMQPConnectionError(AMQPError):
+        pass
+
+    class ConnectionClosed(AMQPConnectionError):
+        pass
+
+    class StreamLostError(AMQPConnectionError):
+        pass
+
+    class ConnectionWrongStateError(AMQPConnectionError):
+        pass
+
+    class AMQPChannelError(AMQPError):
+        pass
+
+    class ChannelClosed(AMQPChannelError):
+        pass
+
+    class ChannelClosedByBroker(ChannelClosed):
+        pass
+
+    class ChannelWrongStateError(AMQPChannelError):
+        pass
+
+
+# ---- server-side state ----------------------------------------------------
+
+@dataclass
+class _Message:
+    body: bytes
+    properties: Any
+    redelivered: bool = False
+
+
+@dataclass
+class _Queue:
+    messages: deque = field(default_factory=deque)
+    exclusive_owner: "BlockingConnection | None" = None
+    auto_delete: bool = False
+
+
+class FakeServer:
+    """One 'RabbitMQ' per URL; queues survive connection churn."""
+
+    _registry: dict[str, "FakeServer"] = {}
+    _registry_lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self.lock = threading.RLock()
+        self.cond = threading.Condition(self.lock)
+        self.queues: dict[str, _Queue] = {}
+        self.connections: list["BlockingConnection"] = []
+        self.down = False
+
+    @classmethod
+    def for_url(cls, url: str) -> "FakeServer":
+        with cls._registry_lock:
+            if url not in cls._registry:
+                cls._registry[url] = cls()
+            return cls._registry[url]
+
+    @classmethod
+    def reset_all(cls) -> None:
+        with cls._registry_lock:
+            cls._registry.clear()
+
+    # ---- failure injection -------------------------------------------------
+
+    def kill_connections(self) -> None:
+        """Sever every live connection (unacked messages requeue)."""
+        with self.lock:
+            for conn in list(self.connections):
+                conn._die_locked()
+            self.cond.notify_all()
+
+    def set_down(self, down: bool) -> None:
+        """While down, new BlockingConnection attempts fail too."""
+        with self.lock:
+            self.down = down
+            if down:
+                for conn in list(self.connections):
+                    conn._die_locked()
+            self.cond.notify_all()
+
+    # ---- queue ops (called by channels under self.lock) --------------------
+
+    def queue(self, name: str) -> _Queue:
+        return self.queues.setdefault(name, _Queue())
+
+    def publish(self, name: str, body: bytes, properties: Any) -> None:
+        self.queue(name).messages.append(_Message(body, properties))
+        self.cond.notify_all()
+
+    def depth(self, name: str) -> int:
+        return len(self.queues[name].messages) if name in self.queues else 0
+
+
+# ---- client objects --------------------------------------------------------
+
+class URLParameters:
+    def __init__(self, url: str):
+        self.url = url
+
+
+class BasicProperties:
+    def __init__(self, reply_to=None, correlation_id=None, headers=None):
+        self.reply_to = reply_to
+        self.correlation_id = correlation_id
+        self.headers = headers
+
+
+class _GetOk:
+    def __init__(self, delivery_tag: int, redelivered: bool,
+                 message_count: int = 0):
+        self.delivery_tag = delivery_tag
+        self.redelivered = redelivered
+        self.message_count = message_count
+
+
+class _DeclareOk:
+    def __init__(self, message_count: int):
+        self.method = self
+        self.message_count = message_count
+
+
+class BlockingConnection:
+    def __init__(self, params: URLParameters):
+        self.server = FakeServer.for_url(params.url)
+        with self.server.lock:
+            if self.server.down:
+                raise exceptions.AMQPConnectionError("fake server is down")
+            self.server.connections.append(self)
+        self._alive = True
+        self._channels: list[Channel] = []
+        self._callbacks: deque[Callable[[], None]] = deque()
+
+    @property
+    def is_open(self) -> bool:
+        return self._alive
+
+    def channel(self) -> "Channel":
+        self._check()
+        ch = Channel(self)
+        self._channels.append(ch)
+        return ch
+
+    def add_callback_threadsafe(self, cb: Callable[[], None]) -> None:
+        with self.server.lock:
+            if not self._alive:
+                raise exceptions.ConnectionWrongStateError("connection closed")
+            self._callbacks.append(cb)
+            self.server.cond.notify_all()
+
+    def process_data_events(self, time_limit: float = 0) -> None:
+        self._check()
+        self._drain_callbacks()
+
+    def close(self) -> None:
+        with self.server.lock:
+            self._close_locked(requeue=True)
+
+    # ---- internals ---------------------------------------------------------
+
+    def _drain_callbacks(self) -> None:
+        while True:
+            with self.server.lock:
+                if not self._callbacks:
+                    return
+                cb = self._callbacks.popleft()
+            cb()
+
+    def _check(self) -> None:
+        if not self._alive:
+            raise exceptions.StreamLostError("fake connection lost")
+
+    def _die_locked(self) -> None:
+        """Simulated network failure (caller holds server.lock)."""
+        self._close_locked(requeue=True)
+
+    def _close_locked(self, requeue: bool) -> None:
+        if not self._alive:
+            return
+        self._alive = False
+        for ch in self._channels:
+            ch._on_connection_dead_locked(requeue)
+        if self in self.server.connections:
+            self.server.connections.remove(self)
+        # Exclusive/auto-delete queues owned by this connection go away.
+        for name in [n for n, q in self.server.queues.items()
+                     if q.exclusive_owner is self]:
+            del self.server.queues[name]
+        self.server.cond.notify_all()
+
+
+class Channel:
+    def __init__(self, conn: BlockingConnection):
+        self.conn = conn
+        self.server = conn.server
+        self._next_tag = 1
+        self._unacked: dict[int, tuple[str, _Message]] = {}
+        self._consumers: dict[str, tuple[str, Callable]] = {}
+        self._consuming = False
+        self.prefetch = 0
+
+    # ---- declarations ------------------------------------------------------
+
+    def basic_qos(self, prefetch_count: int = 0) -> None:
+        self._check()
+        self.prefetch = prefetch_count
+
+    def queue_declare(self, queue: str, durable: bool = False,
+                      passive: bool = False, exclusive: bool = False,
+                      auto_delete: bool = False) -> _DeclareOk:
+        self._check()
+        with self.server.lock:
+            if passive:
+                if queue not in self.server.queues:
+                    raise exceptions.ChannelClosedByBroker(
+                        f"404 no queue {queue!r}")
+                return _DeclareOk(self.server.depth(queue))
+            q = self.server.queue(queue)
+            if exclusive:
+                q.exclusive_owner = self.conn
+            q.auto_delete = auto_delete
+            return _DeclareOk(self.server.depth(queue))
+
+    def queue_delete(self, queue: str) -> None:
+        self._check()
+        with self.server.lock:
+            self.server.queues.pop(queue, None)
+
+    # ---- publish / get -----------------------------------------------------
+
+    def basic_publish(self, exchange: str, routing_key: str, body: bytes,
+                      properties: BasicProperties | None = None) -> None:
+        self._check()
+        with self.server.lock:
+            self.server.publish(routing_key, body,
+                                properties or BasicProperties())
+
+    def basic_get(self, queue: str, auto_ack: bool = False):
+        self._check()
+        with self.server.lock:
+            q = self.server.queues.get(queue)
+            if q is None or not q.messages:
+                return None, None, None
+            msg = q.messages.popleft()
+            tag = self._next_tag
+            self._next_tag += 1
+            if not auto_ack:
+                self._unacked[tag] = (queue, msg)
+            return (_GetOk(tag, msg.redelivered, len(q.messages)),
+                    msg.properties, msg.body)
+
+    # ---- consume loop ------------------------------------------------------
+
+    def basic_consume(self, queue: str, on_message_callback: Callable,
+                      consumer_tag: str | None = None) -> str:
+        self._check()
+        tag = consumer_tag or f"ctag{id(self)}-{len(self._consumers)}"
+        self._consumers[tag] = (queue, on_message_callback)
+        return tag
+
+    def start_consuming(self) -> None:
+        """Blocking delivery loop (the consumer thread lives here)."""
+        self._check()
+        self._consuming = True
+        try:
+            while True:
+                cb = None
+                deliver = None
+                with self.server.lock:
+                    if not self.conn._alive:
+                        raise exceptions.StreamLostError("fake connection lost")
+                    if not self._consuming:
+                        return
+                    if self.conn._callbacks:
+                        cb = self.conn._callbacks.popleft()
+                    else:
+                        deliver = self._next_delivery_locked()
+                        if deliver is None:
+                            self.server.cond.wait(timeout=0.05)
+                            continue
+                if cb is not None:
+                    cb()
+                    continue
+                if deliver is not None:
+                    on_message, method, props, body = deliver
+                    on_message(self, method, props, body)
+        finally:
+            self._consuming = False
+
+    def _next_delivery_locked(self):
+        if self.prefetch and len(self._unacked) >= self.prefetch:
+            return None
+        for tag, (queue, on_message) in self._consumers.items():
+            q = self.server.queues.get(queue)
+            if q is None or not q.messages:
+                continue
+            msg = q.messages.popleft()
+            dtag = self._next_tag
+            self._next_tag += 1
+            self._unacked[dtag] = (queue, msg)
+            return (on_message, _GetOk(dtag, msg.redelivered),
+                    msg.properties, msg.body)
+        return None
+
+    def stop_consuming(self) -> None:
+        with self.server.lock:
+            self._consuming = False
+            self.server.cond.notify_all()
+
+    # ---- acks --------------------------------------------------------------
+
+    def basic_ack(self, delivery_tag: int = 0) -> None:
+        self._check()
+        with self.server.lock:
+            if delivery_tag not in self._unacked:
+                # Real brokers close the channel on unknown tags
+                # (PRECONDITION_FAILED) — the adapter must never let a
+                # stale-generation ack reach us.
+                raise exceptions.ChannelClosedByBroker(
+                    f"406 PRECONDITION_FAILED unknown delivery tag "
+                    f"{delivery_tag}")
+            del self._unacked[delivery_tag]
+
+    def basic_nack(self, delivery_tag: int = 0, requeue: bool = True) -> None:
+        self._check()
+        with self.server.lock:
+            entry = self._unacked.pop(delivery_tag, None)
+            if entry is None:
+                raise exceptions.ChannelClosedByBroker(
+                    f"406 PRECONDITION_FAILED unknown delivery tag "
+                    f"{delivery_tag}")
+            if requeue:
+                queue, msg = entry
+                msg.redelivered = True
+                self.server.queue(queue).messages.appendleft(msg)
+                self.server.cond.notify_all()
+
+    # ---- internals ---------------------------------------------------------
+
+    def _check(self) -> None:
+        self.conn._check()
+
+    def _on_connection_dead_locked(self, requeue: bool) -> None:
+        """Requeue unacked deliveries, redelivered=True (at-least-once)."""
+        if requeue:
+            for queue, msg in reversed(list(self._unacked.values())):
+                msg.redelivered = True
+                self.server.queue(queue).messages.appendleft(msg)
+        self._unacked.clear()
+        self._consuming = False
+
+
+def wait_until(predicate: Callable[[], bool], timeout: float = 5.0,
+               interval: float = 0.005) -> bool:
+    """Test helper: poll ``predicate`` until true or timeout."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()

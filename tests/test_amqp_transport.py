@@ -1,0 +1,201 @@
+"""AmqpBroker (the RabbitMQ deployment seam) against the in-memory pika
+fake — publish/consume/ack, rpc, and the reference's recovery semantics:
+connection death → backoff reconnect → redeclare → resubscribe, unacked
+deliveries requeued, stale-generation acks dropped (SURVEY.md §3 Entry 4)."""
+
+import asyncio
+import uuid
+
+import pytest
+
+from matchmaking_tpu.service.amqp_transport import AmqpBroker
+from matchmaking_tpu.service.broker import Properties
+from matchmaking_tpu.testing import fake_pika
+from matchmaking_tpu.testing.fake_pika import FakeServer, wait_until
+
+
+def make_broker(url=None, **kw):
+    url = url or f"amqp://fake-{uuid.uuid4().hex[:8]}"
+    kw.setdefault("reconnect_base_s", 0.01)
+    kw.setdefault("reconnect_max_s", 0.05)
+    broker = AmqpBroker(url, pika_module=fake_pika, **kw)
+    return broker, FakeServer.for_url(url)
+
+
+async def drain(seconds=0.0):
+    await asyncio.sleep(seconds)
+
+
+@pytest.mark.asyncio
+async def test_publish_consume_ack_roundtrip():
+    broker, server = make_broker()
+    got = []
+
+    async def on_delivery(d):
+        got.append(d)
+        broker.ack(tag, d.delivery_tag)
+
+    broker.declare_queue("q1")
+    tag = broker.basic_consume("q1", on_delivery)
+    broker.publish("q1", b"hello", Properties(reply_to="rq", correlation_id="c1"))
+    for _ in range(200):
+        if got:
+            break
+        await drain(0.01)
+    assert got and got[0].body == b"hello"
+    assert got[0].properties.reply_to == "rq"
+    assert got[0].properties.correlation_id == "c1"
+    # Ack is dispatched via add_callback_threadsafe; wait until applied.
+    consumer = broker._consumers[tag]
+    assert wait_until(lambda: not consumer.channel._unacked)
+    assert broker.stats["acked"] == 1
+    assert broker.queue_depth("q1") == 0
+    broker.close()
+
+
+@pytest.mark.asyncio
+async def test_rpc_roundtrip():
+    broker, server = make_broker()
+
+    async def on_request(d):
+        broker.publish(d.properties.reply_to, b"pong:" + d.body,
+                       Properties(correlation_id=d.properties.correlation_id))
+        broker.ack(tag, d.delivery_tag)
+
+    broker.declare_queue("rpc.q")
+    tag = broker.basic_consume("rpc.q", on_request)
+    reply = await broker.rpc("rpc.q", b"ping", timeout=5.0)
+    assert reply == b"pong:ping"
+    broker.close()
+
+
+@pytest.mark.asyncio
+async def test_connection_kill_reconnects_and_requeues():
+    """Kill every connection mid-stream: the consumer must reconnect,
+    resubscribe, and see the unacked delivery again (redelivered), plus
+    messages published after the outage."""
+    broker, server = make_broker()
+    got = []
+    hold_acks = True
+
+    async def on_delivery(d):
+        got.append(d)
+        if not hold_acks:
+            broker.ack(tag, d.delivery_tag)
+
+    broker.declare_queue("q2")
+    tag = broker.basic_consume("q2", on_delivery)
+    consumer = broker._consumers[tag]
+    assert consumer.connected.wait(2.0)
+
+    broker.publish("q2", b"m1")
+    for _ in range(200):
+        if got:
+            break
+        await drain(0.01)
+    assert [d.body for d in got] == [b"m1"]
+    first_tag = got[0].delivery_tag
+
+    # Sever everything while m1 is still unacked.
+    consumer.connected.clear()
+    server.kill_connections()
+    assert wait_until(lambda: consumer.connected.is_set(), timeout=5.0)
+    assert broker.stats["consumer_reconnects"] >= 1
+
+    hold_acks = False
+    broker.publish("q2", b"m2")     # main connection also reconnects
+    for _ in range(400):
+        if len(got) >= 3:
+            break
+        await drain(0.01)
+    bodies = [d.body for d in got]
+    assert bodies.count(b"m1") == 2, bodies    # requeued redelivery
+    assert b"m2" in bodies
+    redelivs = [d for d in got[1:] if d.body == b"m1"]
+    assert redelivs[0].redelivered
+    assert broker.stats["reconnects"] >= 1
+
+    # Acking the PRE-KILL delivery tag must be dropped as stale, not
+    # poison the new channel.
+    stale_before = broker.stats["stale_acks"]
+    broker.ack(tag, first_tag)
+    assert broker.stats["stale_acks"] == stale_before + 1
+    assert consumer.connected.is_set()
+
+    # The redelivered copies were acked on the new generation: queue drains.
+    assert wait_until(lambda: broker.queue_depth("q2") == 0)
+    broker.close()
+
+
+@pytest.mark.asyncio
+async def test_reconnect_waits_out_server_downtime():
+    """While the server is down even new dials fail; ops retry with
+    backoff until it returns (supervisor-restart semantics)."""
+    broker, server = make_broker()
+    broker.declare_queue("q3")
+    server.set_down(True)
+
+    async def bring_back():
+        await drain(0.05)
+        server.set_down(False)
+
+    task = asyncio.create_task(bring_back())
+    # publish() blocks through the outage and succeeds after recovery.
+    await asyncio.get_event_loop().run_in_executor(
+        None, lambda: broker.publish("q3", b"late"))
+    await task
+    assert broker.queue_depth("q3") == 1
+    assert broker.stats["reconnects"] >= 1
+    broker.close()
+
+
+@pytest.mark.asyncio
+async def test_queue_redeclared_after_reconnect():
+    """Queues this adapter declared exist again after the connection is
+    re-dialed (redeclare-on-restart), even if the fake lost them."""
+    broker, server = make_broker()
+    broker.declare_queue("q4")
+    server.kill_connections()
+    with server.lock:
+        server.queues.pop("q4", None)   # simulate a non-durable wipe
+    assert broker.queue_depth("q4") == 0   # reconnect + redeclare, no raise
+    broker.close()
+
+
+@pytest.mark.asyncio
+async def test_serve_entrypoint_end_to_end(monkeypatch):
+    """The Docker CMD path: MM_* env → Config.from_env → AmqpBroker dialing
+    MM_BROKER_URL → full service → two players matched over the 'real'
+    (fake-pika) AMQP transport from a separate client connection."""
+    from matchmaking_tpu.service.app import serve
+    from matchmaking_tpu.service.client import MatchmakingClient
+
+    url = f"amqp://serve-{uuid.uuid4().hex[:8]}"
+    monkeypatch.setenv("MM_BROKER_URL", url)
+    monkeypatch.setenv("MM_ENGINE_BACKEND", "cpu")
+    monkeypatch.setenv("MM_BATCHER_MAX_WAIT_MS", "1")
+    stop = asyncio.Event()
+    task = asyncio.create_task(serve(stop, pika_module=fake_pika))
+    try:
+        server = FakeServer.for_url(url)
+        # async-poll (wait_until would block the loop serve() runs on)
+        for _ in range(500):
+            if "matchmaking.search" in server.queues:
+                break
+            await drain(0.01)
+        assert "matchmaking.search" in server.queues
+        client = AmqpBroker(url, pika_module=fake_pika,
+                            reconnect_base_s=0.01)
+        mm = MatchmakingClient(client, "matchmaking.search")
+        r1, r2 = await asyncio.gather(
+            mm.search_until_matched({"id": "alice", "rating": 1500},
+                                    timeout=10.0),
+            mm.search_until_matched({"id": "bob", "rating": 1503},
+                                    timeout=10.0),
+        )
+        assert r1.status == "matched" and r2.status == "matched"
+        assert r1.match.match_id == r2.match.match_id
+        client.close()
+    finally:
+        stop.set()
+        await task

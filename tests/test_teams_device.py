@@ -266,3 +266,62 @@ class TestEngineIntegration:
                             party=(PartyMember("m2", 1510.0, 0.0, ()),))
         out = eng.search([req], 0.0)
         assert out.rejected and out.rejected[0][1] == "party_not_supported"
+
+
+class TestWildcardDelegation:
+    def test_wildcard_requests_delegate_to_oracle(self, caplog):
+        """Mixed wildcard/concrete 5v5 pool through the device-backed
+        engine: the first wildcard flips the queue to the host oracle
+        (one-time warning, waiting players transferred), after which the
+        engine is match-for-match identical to CpuEngine — including
+        wildcard-bridged windows the device kernel can't form."""
+        import logging
+
+        cfg = _team_cfg(2)
+        tpu = make_engine(cfg, cfg.queues[0])
+        cpu = CpuEngine(cfg, cfg.queues[0])
+        rng = np.random.default_rng(11)
+        ratings = rng.permutation(400)[:80] + 1400  # distinct
+
+        regions = ["eu", "na", "*"]
+        with caplog.at_level(logging.WARNING,
+                             logger="matchmaking_tpu.engine.tpu"):
+            for i, r in enumerate(ratings):
+                region = regions[i % 3]
+                now = float(i)
+                out_t = tpu.search([_req(i, r, region=region)], now)
+                out_c = cpu.search([_req(i, r, region=region)], now)
+                assert len(out_t.matches) == len(out_c.matches), f"step {i}"
+                for mt, mc in zip(out_t.matches, out_c.matches):
+                    assert _match_key(mt) == _match_key(mc), f"step {i}"
+                assert tpu.pool_size() == cpu.pool_size()
+        assert tpu._team_delegate is not None
+        warnings = [r for r in caplog.records if "wildcard" in r.message]
+        assert len(warnings) == 1  # one-time switch, not per-request
+
+    def test_wildcards_preserve_waiting_players_on_switch(self):
+        """Concrete players already waiting on the device survive the
+        delegation switch (enqueue times intact) and can then match a
+        wildcard partner via the oracle."""
+        cfg = _team_cfg(2)  # need = 4 players per match
+        tpu = make_engine(cfg, cfg.queues[0])
+        for i, r in enumerate([1500, 1502, 1504]):
+            out = tpu.search([_req(i, r, region="eu")], now=0.0)
+            assert not out.matches
+        assert tpu.pool_size() == 3
+        out = tpu.search([_req(99, 1506, region="*")], now=5.0)
+        assert tpu._team_delegate is not None
+        assert len(out.matches) == 1
+        ids = {p.id for t in out.matches[0].teams for p in t}
+        assert ids == {"p0", "p1", "p2", "p99"}
+        assert tpu.pool_size() == 0
+
+    def test_checkpoint_restore_with_wildcards_delegates(self):
+        """restore() (checkpoint replay) with wildcard members must also
+        trigger delegation, not silently admit them to the device pool."""
+        cfg = _team_cfg(2)
+        tpu = make_engine(cfg, cfg.queues[0])
+        reqs = [_req(0, 1500, region="eu"), _req(1, 1502, region="*")]
+        tpu.restore(reqs, now=0.0)
+        assert tpu._team_delegate is not None
+        assert tpu.pool_size() == 2
