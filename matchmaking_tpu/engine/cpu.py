@@ -227,8 +227,5 @@ class CpuEngine(Engine):
         thr = float(win_thr[w])
         players = [members[int(order[w + j])] for j in range(need)]
         # Snake split by descending rating: A B B A A B B A ... balances sums.
-        players.sort(key=lambda r: -r.rating)
-        team_a, team_b = [], []
-        for j, p in enumerate(players):
-            (team_a if (j % 4 in (0, 3)) else team_b).append(p)
-        return (tuple(team_a), tuple(team_b)), spread, thr
+        team_a, team_b = scoring.snake_split(players)
+        return (team_a, team_b), spread, thr

@@ -146,6 +146,22 @@ class Engine(abc.ABC):
         """Release engine resources (e.g. background threads) when the
         engine is replaced. Default: nothing to release."""
 
+    def expire(self, now: float, timeout: float) -> list[SearchRequest]:
+        """Evict every waiting request older than ``timeout`` and return
+        them (the timeout sweeper's one call). Default: object-path scan —
+        fine for the oracle's ~2k pools; TpuEngine overrides with a
+        vectorized mirror sweep that materializes only the expired few
+        (an object per waiting player each sweep is exactly the cost the
+        columnar fast path exists to avoid)."""
+        expired = [r for r in self.waiting()
+                   if r.enqueued_at and now - r.enqueued_at > timeout]
+        out: list[SearchRequest] = []
+        for req in expired:
+            removed = self.remove(req.id)
+            if removed is not None:
+                out.append(removed)
+        return out
+
     def effective_threshold(self, req: SearchRequest, now: float) -> float:
         """Reference knob ``rating_threshold`` + config-gated widening by
         wait time (SURVEY.md §2 C9)."""

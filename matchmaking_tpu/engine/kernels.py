@@ -138,9 +138,15 @@ def effective_pool_block(capacity: int, pool_block: int, top_k: int,
     while capacity % pool_block != 0:
         pool_block //= 2
     if min_blocks:
+        # Each halving must re-establish divisibility: halving an odd
+        # divisor (e.g. capacity=510, pool_block=255 → 127) would otherwise
+        # return a non-divisor and the scan would cover n_blocks·blk ≠
+        # capacity slots (trace-time reshape failure).
         need = min(top_k, max(1, capacity // 128))
-        while capacity // pool_block < need:
+        while capacity // pool_block < need and pool_block > 1:
             pool_block //= 2
+            while capacity % pool_block != 0:
+                pool_block //= 2
     return pool_block
 
 

@@ -79,6 +79,22 @@ def snake_signs(need: int) -> list[float]:
     return [1.0 if (need - 1 - i) % 4 in (0, 3) else -1.0 for i in range(need)]
 
 
+def snake_split(members):
+    """Split a full team window into (team_a, team_b) by the snake pattern.
+
+    The ONE implementation of the split (oracle and device finalize both call
+    it — a drifted modulus in a hand-copied loop would silently break
+    oracle/device equivalence). Sorts by DESCENDING rating (stable, so ties
+    keep caller order); descending position j goes to team A iff
+    j % 4 ∈ {0, 3} — the pattern ``snake_signs`` above proves balanced.
+    """
+    ordered = sorted(members, key=lambda r: -r.rating)
+    team_a, team_b = [], []
+    for j, p in enumerate(ordered):
+        (team_a if j % 4 in (0, 3) else team_b).append(p)
+    return tuple(team_a), tuple(team_b)
+
+
 def region_mode_compatible(region_a: str, mode_a: str, region_b: str, mode_b: str,
                            *, any_token: str = "*") -> bool:
     """Hard filters (BASELINE config #2): wildcard-or-equal on both axes."""

@@ -341,6 +341,14 @@ class TpuEngine(Engine):
         callers must NOT re-ack them as newly queued."""
         if self._team_device or self._team_delegate is not None:
             return None
+        # The engine refuses, not just the service's lock convention: a
+        # rescan while a window is in flight re-admits — from the
+        # not-yet-finalized mirror — slots that window may already have
+        # matched and evicted, resurrecting a matched player into a double
+        # match (same hazard remove() guards against).
+        assert self._open == 0, (
+            "rescan_async() with windows in flight — collect with flush() first"
+        )
         pool = self.pool
         if len(pool) == 0:
             return None
@@ -501,6 +509,34 @@ class TpuEngine(Engine):
         ev[0] = slot
         self._dev_pool = self.kernels.evict(self._dev_pool, jnp.asarray(ev))
         return req
+
+    def expire(self, now: float, timeout: float) -> list[SearchRequest]:
+        """Vectorized timeout sweep over the columnar mirror: O(expired)
+        object materialization, one batched device eviction per
+        evict_bucket chunk. The base-class default would materialize a
+        SearchRequest per WAITING player per sweep (~10-20 µs each — 1-2 s
+        of event-loop-blocking work at the 100k north-star pool)."""
+        if self._team_delegate is not None:
+            return self._team_delegate.expire(now, timeout)
+        assert self._open == 0, (
+            "expire() with windows in flight — collect with flush() first"
+        )
+        slots = self.pool.waiting_slots()
+        if slots.size == 0:
+            return []
+        enq = self.pool.m_enqueued[slots]
+        expired_slots = slots[(enq != 0.0) & (now - enq > timeout)]
+        if expired_slots.size == 0:
+            return []
+        reqs = [self.pool.request_at(int(s)) for s in expired_slots]
+        self.pool.release(expired_slots)
+        eb = self.kernels.evict_bucket
+        for start in range(0, expired_slots.size, eb):
+            chunk = expired_slots[start:start + eb]
+            ev = np.full(eb, self.kernels.capacity, np.int32)
+            ev[:chunk.size] = chunk
+            self._dev_pool = self.kernels.evict(self._dev_pool, jnp.asarray(ev))
+        return reqs
 
     def pool_size(self) -> int:
         if self._team_delegate is not None:
@@ -732,9 +768,9 @@ class TpuEngine(Engine):
 
     def _finalize_team(self, pending: _Pending) -> None:
         """Map team-kernel results (slots M×need, spread, limit) back to
-        requests and split each window into two teams (oracle's snake split —
+        requests and split each window into two teams (scoring.snake_split —
         the device kernel validated the sum constraint with the same signed
-        pattern, which is tie-order invariant, see teams.snake_signs)."""
+        pattern, which is tie-order invariant, see scoring.snake_signs)."""
         out = pending.outcome
         need = self.kernels.need
         for (window, _, now), (packed_out,) in zip(
@@ -749,10 +785,7 @@ class TpuEngine(Engine):
                 row = slots[m].tolist()
                 members = [self.pool.request_at(s) for s in row]
                 matched_ids.update(r.id for r in members)
-                members.sort(key=lambda r: -r.rating)
-                team_a, team_b = [], []
-                for j, p in enumerate(members):
-                    (team_a if (j % 4 in (0, 3)) else team_b).append(p)
+                team_a, team_b = scoring.snake_split(members)
                 thr = float(limit[m])
                 qual = max(0.0, 1.0 - float(spread[m]) / thr) if thr > 0 else 0.0
                 out.matches.append(

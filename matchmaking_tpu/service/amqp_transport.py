@@ -214,9 +214,15 @@ class AmqpBroker:
                 channel = conn.channel()
                 channel.basic_qos(prefetch_count=consumer.prefetch)
                 channel.queue_declare(queue=consumer.queue, durable=False)
-                consumer.conn, consumer.channel = conn, channel
+                # Generation FIRST, conn/channel after: an ack racing this
+                # reconnect must fail the stale-generation check in
+                # _ack_nack before it can see the new channel — the other
+                # order lets a stale tag pass the check and basic_ack on
+                # the NEW channel (the PRECONDITION_FAILED the guard
+                # exists to prevent).
                 consumer.generation += 1
                 generation = consumer.generation
+                consumer.conn, consumer.channel = conn, channel
                 if generation > 1:
                     self.stats["consumer_reconnects"] += 1
 
@@ -350,9 +356,14 @@ class AmqpBroker:
             self.delete_queue(reply_queue)
 
     def close(self) -> None:
+        # Snapshot BEFORE cancelling: basic_cancel pops each consumer from
+        # self._consumers, so joining "the remaining dict" joins nothing and
+        # the main connection could be torn down under still-draining
+        # consumer threads.
+        consumers = list(self._consumers.values())
         for tag in list(self._consumers):
             self.basic_cancel(tag)
-        for consumer in list(self._consumers.values()):
+        for consumer in consumers:
             if consumer.thread is not None:
                 consumer.thread.join(timeout=2.0)
         with self._lock:

@@ -474,20 +474,29 @@ class _QueueRuntime:
             await asyncio.sleep(interval)
             now = time.time()
             # The lock keeps evictions from racing an in-flight window's
-            # engine.search (engines have no internal locking).
-            async with self._engine_lock:
-                expired = [r for r in self.engine.waiting()
-                           if r.enqueued_at and now - r.enqueued_at > timeout]
-                for req in expired:
-                    removed = self.engine.remove(req.id)
-                    if removed is not None:
-                        self.app.metrics.counters.inc("timeouts")
-                        resp = SearchResponse(
-                            status="timeout", player_id=removed.id,
-                            latency_ms=(now - removed.enqueued_at) * 1e3,
-                        )
-                        self._remember(removed.id, resp, now)
-                        self._respond(removed, resp)
+            # engine.search (engines have no internal locking). expire() is
+            # O(expired) on the columnar mirror (TpuEngine) and runs off
+            # the event loop; only the responses happen here. Device work
+            # can fail transiently — the sweeper must survive (a dead
+            # sweeper means no request in this queue ever times out again),
+            # so failures revive the engine like the flush/rescan paths.
+            try:
+                async with self._engine_lock:
+                    expired = await asyncio.to_thread(
+                        self.engine.expire, now, timeout)
+            except Exception:
+                log.exception("timeout sweep failed; reviving engine from mirror")
+                self.app.metrics.counters.inc("engine_crashes")
+                self._revive_engine(now)
+                continue
+            for removed in expired:
+                self.app.metrics.counters.inc("timeouts")
+                resp = SearchResponse(
+                    status="timeout", player_id=removed.id,
+                    latency_ms=(now - removed.enqueued_at) * 1e3,
+                )
+                self._remember(removed.id, resp, now)
+                self._respond(removed, resp)
 
     async def close(self) -> None:
         if self._sweeper is not None:

@@ -60,13 +60,52 @@ bool skip_string(Cursor& c) {
   return false;
 }
 
-bool skip_number(Cursor& c) {
-  const char* start = c.p;
-  while (c.p < c.end && (isdigit((unsigned char)*c.p) || *c.p == '-' ||
-                         *c.p == '+' || *c.p == '.' || *c.p == 'e' ||
-                         *c.p == 'E'))
-    ++c.p;
-  return c.p > start;
+// Strict JSON number grammar (RFC 8259: -?(0|[1-9][0-9]*)(\.[0-9]+)?
+// ([eE][+-]?[0-9]+)?) plus Python json's non-standard Infinity/-Infinity/
+// NaN literals (json.loads accepts them by default — *nonstd flags their
+// use so value parsers can defer to Python instead of replicating its
+// range-check semantics). A permissive [-+0-9.eE]* scan here previously
+// let strtod accept `+5` and `5.`, which contract.decode_request (the
+// semantic source of truth) rejects as bad_json — a live wire-contract
+// divergence on the columnar hot path.
+bool scan_number(Cursor& c, bool* nonstd) {
+  *nonstd = false;
+  const char* p = c.p;
+  const char* end = c.end;
+  if (p < end && *p == 'N') {
+    if ((size_t)(end - p) >= 3 && memcmp(p, "NaN", 3) == 0) {
+      c.p = p + 3; *nonstd = true; return true;
+    }
+    return false;
+  }
+  if (p < end && *p == '-') ++p;
+  if (p < end && *p == 'I') {
+    if ((size_t)(end - p) >= 8 && memcmp(p, "Infinity", 8) == 0) {
+      c.p = p + 8; *nonstd = true; return true;
+    }
+    return false;
+  }
+  if (p >= end) return false;
+  if (*p == '0') {
+    ++p;  // a leading 0 takes no more digits (05 is malformed JSON)
+  } else if (*p >= '1' && *p <= '9') {
+    while (p < end && isdigit((unsigned char)*p)) ++p;
+  } else {
+    return false;  // covers leading '+' and bare '.'
+  }
+  if (p < end && *p == '.') {
+    ++p;
+    if (p >= end || !isdigit((unsigned char)*p)) return false;  // "5."
+    while (p < end && isdigit((unsigned char)*p)) ++p;
+  }
+  if (p < end && (*p == 'e' || *p == 'E')) {
+    ++p;
+    if (p < end && (*p == '+' || *p == '-')) ++p;
+    if (p >= end || !isdigit((unsigned char)*p)) return false;  // "5e"
+    while (p < end && isdigit((unsigned char)*p)) ++p;
+  }
+  c.p = p;
+  return true;
 }
 
 bool skip_literal(Cursor& c, const char* lit, size_t len) {
@@ -102,7 +141,8 @@ bool skip_value(Cursor& c) {
   if (ch == 't') return skip_literal(c, "true", 4);
   if (ch == 'f') return skip_literal(c, "false", 5);
   if (ch == 'n') return skip_literal(c, "null", 4);
-  return skip_number(c);
+  bool nonstd;  // ignored-key Infinity/NaN: json.loads accepts, so do we
+  return scan_number(c, &nonstd);
 }
 
 // Parse a string value without escapes into [out, out+cap). Returns length,
@@ -121,21 +161,25 @@ int parse_plain_string(Cursor& c, char* out, int cap) {
   return -2;
 }
 
-struct Number {
-  double value;
-  bool is_number;
+enum NumResult {
+  NUM_OK = 0,
+  NUM_BAD = 1,  // malformed numeric token → the whole payload is bad_json
+  NUM_PY = 2,   // Infinity/NaN/huge: valid for json.loads — let Python's
+                // own range checks decide (NEEDS_PYTHON)
 };
 
-Number parse_number(Cursor& c) {
+NumResult parse_number(Cursor& c, double* out) {
   char buf[64];
   const char* start = c.p;
-  if (!skip_number(c) || c.p - start >= (long)sizeof(buf)) return {0.0, false};
+  bool nonstd = false;
+  if (!scan_number(c, &nonstd)) return NUM_BAD;
   size_t len = c.p - start;
+  if (nonstd || len >= sizeof(buf)) return NUM_PY;
   memcpy(buf, start, len);
   buf[len] = '\0';
   char* endp = nullptr;
-  double v = strtod(buf, &endp);
-  return {v, endp == buf + len};
+  *out = strtod(buf, &endp);
+  return endp == buf + len ? NUM_OK : NUM_BAD;
 }
 
 constexpr int kMaxStr = 256;  // per-field cap for id/region/mode strings
@@ -152,6 +196,26 @@ struct Row {
 
 bool key_is(const char* key, int len, const char* name) {
   return (int)strlen(name) == len && memcmp(key, name, len) == 0;
+}
+
+// Numeric field value. Well-typed non-numbers (string/bool/null/object/
+// array) are bad_type (contract's _req_number/_opt_number); a malformed
+// numeric token means json.loads itself would have failed → bad_json;
+// Infinity/NaN/over-long → NEEDS_PYTHON (Python's checks decide).
+NumResult parse_number_field(Cursor& c, Row* row, double* out) {
+  char pk = c.peek();
+  if (pk == 't' || pk == 'f' || pk == 'n' || pk == '"' || pk == '{' ||
+      pk == '[') {
+    // Verify the token is well-formed before classifying: json.loads
+    // fails a malformed token (bad_json) before any type check can run
+    // (`nulx`, an unterminated string, ... must not report bad_type).
+    row->status = skip_value(c) ? BAD_TYPE : BAD_JSON;
+    return NUM_BAD;
+  }
+  NumResult r = parse_number(c, out);
+  if (r == NUM_PY) row->status = NEEDS_PYTHON;
+  else if (r == NUM_BAD) row->status = BAD_JSON;
+  return r;
 }
 
 void decode_one(const char* buf, int len, Row& row) {
@@ -205,20 +269,13 @@ void decode_one(const char* buf, int len, Row& row) {
         return;
       }
     } else if (key_is(key, klen, "rating")) {
-      if (c.peek() == 't' || c.peek() == 'f') { row.status = BAD_TYPE; return; }
-      Number num = parse_number(c);
-      if (!num.is_number) { row.status = BAD_TYPE; return; }
-      row.rating = num.value; row.has_rating = true;
+      NumResult r = parse_number_field(c, &row, &row.rating);
+      if (r != NUM_OK) return;
+      row.has_rating = true;
     } else if (key_is(key, klen, "rating_deviation")) {
-      if (c.peek() == 't' || c.peek() == 'f') { row.status = BAD_TYPE; return; }
-      Number num = parse_number(c);
-      if (!num.is_number) { row.status = BAD_TYPE; return; }
-      row.rd = num.value;
+      if (parse_number_field(c, &row, &row.rd) != NUM_OK) return;
     } else if (key_is(key, klen, "rating_threshold")) {
-      if (c.peek() == 't' || c.peek() == 'f') { row.status = BAD_TYPE; return; }
-      Number num = parse_number(c);
-      if (!num.is_number) { row.status = BAD_TYPE; return; }
-      row.threshold = num.value;
+      if (parse_number_field(c, &row, &row.threshold) != NUM_OK) return;
     } else if (key_is(key, klen, "roles") || key_is(key, klen, "party")) {
       // Non-empty arrays need the full Python decoder; [] is a no-op.
       c.skip_ws();

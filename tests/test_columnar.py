@@ -154,3 +154,76 @@ class TestColumnarMatchesObjectPath:
         out2 = _flush_one(eng2)
         assert out2.n_matches == 1
         assert out2.m_quality[0] == pytest.approx(1.0 - 20.0 / 30.0, abs=1e-5)
+
+
+class TestColumnarExpire:
+    """The timeout sweep must be O(expired), not O(pool): SearchRequest
+    objects (~10-20 µs each) may materialize ONLY for the expired few —
+    at the 100k north-star pool an O(pool) sweep under the engine lock is
+    1-2 s of event-loop-blocking work every timeout/4 s (a p99 killer)."""
+
+    def test_expire_materializes_only_expired(self, monkeypatch):
+        cfg = _cfg()
+        eng = make_engine(cfg, cfg.queues[0])
+        n, n_old = 256, 7
+        ids = [f"p{i}" for i in range(n)]
+        # Ratings far apart so nothing matches; the first n_old are stale.
+        cols = _cols(ids, [i * 1000.0 for i in range(n)], now=100.0)
+        cols.enqueued_at[:n_old] = 1.0
+        eng.restore_columns(cols, now=100.0)
+        assert eng.pool_size() == n
+
+        calls = {"n": 0}
+        orig = eng.pool.request_at
+
+        def counting(slot):
+            calls["n"] += 1
+            return orig(slot)
+
+        monkeypatch.setattr(eng.pool, "request_at", counting)
+        expired = eng.expire(now=100.0, timeout=50.0)
+        assert sorted(r.id for r in expired) == sorted(f"p{i}" for i in range(n_old))
+        assert calls["n"] == n_old          # O(expired) materialization
+        assert eng.pool_size() == n - n_old
+
+    def test_expire_evicts_on_device(self):
+        q = QueueConfig(rating_threshold=80.0)
+        cfg = Config(queues=(q,), engine=EngineConfig(
+            backend="tpu", pool_capacity=64, pool_block=64,
+            batch_buckets=(16,)))
+        eng = make_engine(cfg, q)
+        eng.restore_columns(_cols(["stale"], [1500.0], now=1.0), now=1.0)
+        assert [r.id for r in eng.expire(now=100.0, timeout=50.0)] == ["stale"]
+        # The expired player must be gone on DEVICE too: a perfect-distance
+        # arrival must queue, not match the ghost.
+        eng.search_columns_async(_cols(["fresh"], [1500.0], now=100.0), now=100.0)
+        out = _flush_one(eng)
+        assert out.n_matches == 0
+        assert list(out.q_ids) == ["fresh"]
+
+    def test_expire_zero_enqueued_never_expires(self):
+        cfg = _cfg()
+        eng = make_engine(cfg, cfg.queues[0])
+        cols = _cols(["a"], [1500.0], now=0.0)
+        cols.enqueued_at[:] = 0.0   # "no timestamp" sentinel
+        eng.restore_columns(cols, now=0.0)
+        assert eng.expire(now=1e9, timeout=1.0) == []
+        assert eng.pool_size() == 1
+
+    def test_expire_refuses_with_window_in_flight(self):
+        cfg = _cfg()
+        eng = make_engine(cfg, cfg.queues[0])
+        eng.search_columns_async(_cols(["a"], [1500.0], now=0.0), now=0.0)
+        with pytest.raises(AssertionError):
+            eng.expire(now=100.0, timeout=1.0)
+        eng.flush()
+
+    def test_cpu_engine_expire_matches_semantics(self):
+        cfg = Config(queues=(QueueConfig(rating_threshold=10.0,),))
+        eng = make_engine(cfg, cfg.queues[0])
+        eng.restore([SearchRequest(id="old", rating=1500.0, enqueued_at=1.0),
+                     SearchRequest(id="new", rating=9000.0, enqueued_at=90.0)],
+                    100.0)
+        expired = eng.expire(now=100.0, timeout=50.0)
+        assert [r.id for r in expired] == ["old"]
+        assert eng.pool_size() == 1

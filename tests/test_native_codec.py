@@ -68,6 +68,57 @@ class TestAgainstPythonDecoder:
             assert status[i] != codec.OK, body
             assert codec.error_code(status[i]) == err.value.code, body
 
+    def test_number_grammar_agrees_with_python(self):
+        """The JSON number grammar divergence (round-1 advisory): strtod
+        accepts forms json.loads rejects (`+5`, `5.`, `05`, ...) and
+        json.loads accepts forms strtod's caller once mapped to bad_type
+        (Infinity/NaN). Native must agree with Python on every form: same
+        error class, or NEEDS_PYTHON (re-decode by the source of truth)."""
+        cases = [
+            b'{"id":"x","rating":+5}',             # leading + → bad_json
+            b'{"id":"x","rating":5.}',             # bare trailing . → bad_json
+            b'{"id":"x","rating":.5}',             # bare leading . → bad_json
+            b'{"id":"x","rating":5e}',             # empty exponent → bad_json
+            b'{"id":"x","rating":05}',             # leading zero → bad_json
+            b'{"id":"x","rating":5e+}',            # sign-only exponent
+            b'{"id":"x","rating":--5}',            # double sign
+            b'{"id":"x","rating":1500,"rating_deviation":+1}',
+            b'{"id":"x","rating":1500,"rating_threshold":5.}',
+            b'{"id":"x","rating":Infinity}',       # json.loads: inf → bad_rating
+            b'{"id":"x","rating":-Infinity}',
+            b'{"id":"x","rating":NaN}',            # json.loads: nan → bad_rating
+            b'{"id":"x","rating":1500,"junk":+1}', # malformed in ignored key
+            b'{"id":"x","rating":nulx}',           # malformed literal → bad_json
+            b'{"id":"x","rating":"unclosed}',      # unterminated string
+            b'{"id":"x","rating":null}',           # well-formed null → bad_type
+        ]
+        *_cols, status = _native_rows(cases)
+        for i, body in enumerate(cases):
+            with pytest.raises(ContractError) as err:
+                decode_request(body)
+            if status[i] == codec.NEEDS_PYTHON:
+                continue  # fallback path reports the Python error — fine
+            assert status[i] != codec.OK, body
+            assert codec.error_code(status[i]) == err.value.code, body
+
+    def test_number_grammar_valid_forms_still_ok(self):
+        bodies = [
+            b'{"id":"a","rating":0}',
+            b'{"id":"b","rating":-0.5}',
+            b'{"id":"c","rating":1.25e2}',
+            b'{"id":"d","rating":2E+3}',
+            b'{"id":"e","rating":900e-1}',
+            b'{"id":"f","rating":0.0}',
+            b'{"id":"g","rating":1500,"rating_threshold":Infinity}',  # py: ok
+        ]
+        ids, rating, *_rest, status = _native_rows(bodies)
+        for i, body in enumerate(bodies):
+            py = decode_request(body)  # Python accepts all of these
+            if status[i] == codec.NEEDS_PYTHON:
+                continue  # Infinity threshold defers to Python — fine
+            assert status[i] == codec.OK, body
+            assert rating[i] == pytest.approx(py.rating, rel=1e-6)
+
     def test_complex_rows_flagged_for_python(self):
         bodies = [
             b'{"id":"p","rating":1,"roles":["tank","dps"]}',

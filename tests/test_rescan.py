@@ -59,6 +59,21 @@ class TestEngineRescan:
         eng = make_engine(_cfg(q), q)
         assert eng.rescan_async(16, now=1.0) is None
 
+    def test_rescan_with_window_in_flight_refuses(self):
+        """A rescan while a window is in flight could re-admit — from the
+        not-yet-finalized mirror — a slot that window already matched,
+        resurrecting a matched player into a double match. The ENGINE must
+        refuse (not just the service's lock convention)."""
+        q = _q()
+        eng = make_engine(_cfg(q), q)
+        eng.restore([_req(0, 1500.0, 0.0), _req(1, 1505.0, 0.0)], 0.0)
+        eng.search_async([_req(2, 1502.0, 0.0)], 0.0)  # in flight
+        with pytest.raises(AssertionError):
+            eng.rescan_async(16, now=1.0)
+        eng.flush()
+        assert eng.rescan_async(16, now=1.0) is not None  # fine after flush
+        eng.flush()
+
     def test_oldest_players_prioritized(self):
         q = _q()
         cfg = Config(queues=(q,), engine=EngineConfig(
