@@ -100,6 +100,13 @@ class EngineConfig:
     #: default: the fused-XLA scan is the reference path; flip per
     #: deployment after benchmarking both on your chip.
     use_pallas: bool = False
+    #: Max dispatched-but-uncollected windows the SERVICE keeps in flight on
+    #: the pipelined columnar path (1 = the old dispatch-then-block flush).
+    #: Pipelining hides the host↔device round trip — measured on the axon
+    #: tunnel: a single D2H readback has ~70 ms latency and readbacks
+    #: serialize, so depth 2 keeps the transfer channel busy while window
+    #: N+1 computes; deeper only queues latency (see BENCH_SWEEP.md).
+    pipeline_depth: int = 2
 
 
 @dataclass(frozen=True)

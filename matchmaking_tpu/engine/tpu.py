@@ -178,6 +178,11 @@ class TpuEngine(Engine):
         #: First device failure since the last sync search(); async callers
         #: should check this after collect_ready()/flush().
         self.device_error: BaseException | None = None
+        #: Tokens whose window failed on device (their outcome reports every
+        #: request as queued — true, the mirror still holds them). Pipelined
+        #: callers need the per-window attribution to nack exactly the failed
+        #: window's deliveries; callers discard entries they consume.
+        self.failed_tokens: set[int] = set()
         #: Stage spans (SURVEY.md §5 tracing): cumulative seconds + counts;
         #: read via span_report(). Written only on the caller thread.
         self.spans = {
@@ -663,6 +668,7 @@ class TpuEngine(Engine):
             self.spans["turnaround_s"] += time.perf_counter() - pending.created
         if pending.error is not None:
             self.device_error = pending.error
+            self.failed_tokens.add(pending.token)
             for payload, _, _ in pending.chunks:
                 if pending.columnar is not None:
                     cols, _slots = payload

@@ -65,6 +65,21 @@ class _QueueRuntime:
         # Serializes ALL engine access (window flushes vs the timeout
         # sweeper): engines are single-writer objects with no internal locks.
         self._engine_lock = asyncio.Lock()
+        # Pipelined columnar windows: token → (by_id, deliveries) for every
+        # dispatched-but-uncollected window. Outcomes are handled (publish +
+        # ack) at COLLECTION time, so up to ``engine.pipeline_depth`` windows
+        # overlap on device — the discipline the bench measures, now in
+        # production (round-3 verdict ask #3).
+        self._inflight_meta: dict[int, tuple[dict[str, Delivery], list[Delivery]]] = {}
+        self._pipelined = (
+            self._columnar and hasattr(self.engine, "collect_ready")
+            and app.cfg.engine.pipeline_depth > 1
+        )
+        self._collector: asyncio.Task | None = None
+        #: A collected window failed on device; revive once in-flight drains.
+        self._needs_revive = False
+        if self._pipelined:
+            self._collector = asyncio.create_task(self._collector_loop())
         # At-least-once dedup: player id → (terminal SearchResponse, expiry).
         self._recent: dict[str, tuple[SearchResponse, float]] = {}
         self._next_prune = 0.0
@@ -259,51 +274,202 @@ class _QueueRuntime:
                 (r[7].properties.correlation_id for r in lanes), object, n),
         )
         by_id = {r[0]: r[7] for r in lanes}
+        deliveries_in = [r[7] for r in lanes]
 
-        def run_engine():
-            # Dispatch + flush together OFF the event loop: first-window jit
-            # compilation and per-window pack/H2D host work would otherwise
-            # freeze every other queue's consumers, sweepers, and auth RPC
-            # deadlines (same hazard the object path's to_thread comment
-            # documents).
-            self.engine.search_columns_async(cols, now)
-            return self.engine.flush()
+        if not self._pipelined:
+            # depth-1 mode (pipeline_depth <= 1, or an engine without the
+            # pipelined API): dispatch + flush together, outcomes handled
+            # inline — the pre-round-4 behavior.
+            def run_engine():
+                # Dispatch + flush OFF the event loop: first-window jit
+                # compilation and per-window pack/H2D host work would
+                # otherwise freeze every other queue's consumers, sweepers,
+                # and auth RPC deadlines.
+                self.engine.search_columns_async(cols, now)
+                return self.engine.flush()
 
-        try:
-            async with self._engine_lock:
-                outs = await asyncio.to_thread(run_engine)
-            if self.engine.device_error is not None:
-                err, self.engine.device_error = self.engine.device_error, None
-                raise err
-        except Exception:
-            log.exception("engine step crashed; reviving engine from mirror")
-            self.app.metrics.counters.inc("engine_crashes")
-            self._revive_engine(now)
-            for r in lanes:
-                self.app.broker.nack(self.consumer_tag,
-                                     r[7].delivery_tag, requeue=True)
+            try:
+                async with self._engine_lock:
+                    outs = await asyncio.to_thread(run_engine)
+                if self.engine.device_error is not None:
+                    err, self.engine.device_error = self.engine.device_error, None
+                    raise err
+            except Exception:
+                log.exception("engine step crashed; reviving engine from mirror")
+                self.app.metrics.counters.inc("engine_crashes")
+                self._revive_engine(now)
+                for d in deliveries_in:
+                    self.app.broker.nack(self.consumer_tag,
+                                         d.delivery_tag, requeue=True)
+                return
+            for tok, out in outs:
+                self.engine.failed_tokens.discard(tok)
+                self._handle_columnar_out(out, by_id, deliveries_in, now)
             return
 
+        # Pipelined path: dispatch without waiting; outcomes (publish + ack)
+        # happen at collection — on later flushes or the collector tick.
+        recorded = False
+        try:
+            async with self._engine_lock:
+                if self._needs_revive:
+                    # A collected window failed on device: the device pool
+                    # diverged from the mirror (its step may have matched
+                    # players the mirror still holds). Dispatching into the
+                    # diverged pool would strand them — drain + revive FIRST
+                    # (under sustained traffic the collector's inflight()==0
+                    # revive may otherwise never fire).
+                    await self._drain_engine(now)
+                tok = await asyncio.to_thread(
+                    self.engine.search_columns_async, cols, now)
+                self._inflight_meta[tok] = (by_id, deliveries_in)
+                recorded = True
+                self._collect_ready_locked(time.time())
+        except Exception:
+            log.exception("engine dispatch crashed; reviving engine from mirror")
+            self.app.metrics.counters.inc("engine_crashes")
+            # Once meta is recorded the revive path settles this window
+            # exactly once (salvage-ack or stale-meta nack) — passing
+            # extra_nack too would double-settle the same delivery tags.
+            await self._revive_pipelined(
+                now, extra_nack=None if recorded else deliveries_in)
+            return
+        # Backpressure: hold THIS queue's batcher until a pipeline slot
+        # frees (windows keep arriving from other queues; the collector
+        # task keeps collecting even when no flush is running).
+        depth = self.app.cfg.engine.pipeline_depth
+        while self.engine.inflight() >= depth:
+            await asyncio.sleep(0.001)
+            async with self._engine_lock:
+                self._collect_ready_locked(time.time())
+
+    # ---- pipelined collection ---------------------------------------------
+
+    def _collect_ready_locked(self, now: float) -> None:
+        """Collect + handle every landed window. Caller holds _engine_lock.
+        Cheap on the event loop: results were D2H-copied asynchronously at
+        dispatch, so this is numpy slicing + publish/ack bookkeeping."""
+        if not hasattr(self.engine, "collect_ready"):
+            return
+        for tok, out in self.engine.collect_ready():
+            self._finish_token(tok, out, now)
+
+    def _finish_token(self, tok: int, out, now: float) -> None:
+        meta = self._inflight_meta.pop(tok, None)
+        if meta is None:  # rescan windows are handled by the rescan loop
+            return
+        by_id, deliveries = meta
+        if tok in self.engine.failed_tokens:
+            self.engine.failed_tokens.discard(tok)
+            log.error("window %d failed on device; nack + revive scheduled", tok)
+            self.app.metrics.counters.inc("engine_crashes")
+            for d in deliveries:
+                self.app.broker.nack(self.consumer_tag, d.delivery_tag,
+                                     requeue=True)
+            self._needs_revive = True
+            return
+        try:
+            self._handle_columnar_out(out, by_id, deliveries, now)
+        except Exception:
+            # A publish failure mid-handling must still settle the window's
+            # deliveries — leaving them unacked consumes broker prefetch
+            # slots until the queue stops consuming entirely. Nack-requeue
+            # is the at-least-once answer (redeliveries are deduped against
+            # the pool / _recent; a match whose response raised before its
+            # _remember ran can, rarely, be re-queued — accepted dup risk).
+            log.exception("window %d outcome handling failed; nacking", tok)
+            self.app.metrics.counters.inc("outcome_errors")
+            for d in deliveries:
+                self.app.broker.nack(self.consumer_tag, d.delivery_tag,
+                                     requeue=True)
+
+    def _handle_columnar_out(self, out, by_id: dict[str, Delivery],
+                             deliveries: list[Delivery], now: float) -> None:
+        """Publish one collected window's outcome and ack its deliveries."""
         m = self.app.metrics
-        for _tok, out in outs:
-            self._publish_columnar_matches(out, now)
-            if self.queue_cfg.send_queued_ack:
-                for pid in out.q_ids:
-                    d = by_id.get(pid)
-                    if d is not None:
-                        self._respond_raw(
-                            d.properties.reply_to, d.properties.correlation_id,
-                            SearchResponse(status="queued", player_id=pid))
-            for pid, code in out.rejected:
-                m.counters.inc("rejected_by_engine")
+        self._publish_columnar_matches(out, now)
+        if self.queue_cfg.send_queued_ack:
+            for pid in out.q_ids:
                 d = by_id.get(pid)
                 if d is not None:
-                    self._respond_error(d, code,
-                                        f"engine rejected request: {code}")
-        for r in lanes:
-            self.app.broker.ack(self.consumer_tag, r[7].delivery_tag)
+                    self._respond_raw(
+                        d.properties.reply_to, d.properties.correlation_id,
+                        SearchResponse(status="queued", player_id=pid))
+        for pid, code in out.rejected:
+            m.counters.inc("rejected_by_engine")
+            d = by_id.get(pid)
+            if d is not None:
+                self._respond_error(d, code,
+                                    f"engine rejected request: {code}")
+        for d in deliveries:
+            self.app.broker.ack(self.consumer_tag, d.delivery_tag)
         m.counters.inc("windows")
-        m.counters.inc("requests_batched", n)
+        m.counters.inc("requests_batched", len(deliveries))
+
+    async def _drain_engine(self, now: float) -> None:
+        """Flush every in-flight window and handle its outcome. Caller holds
+        _engine_lock. Restores the ``_open == 0`` invariant rescan/expire/
+        remove/checkpoint require."""
+        if not self._pipelined:
+            return
+        if self.engine.inflight() > 0:
+            outs = await asyncio.to_thread(self.engine.flush)
+            for tok, out in outs:
+                self._finish_token(tok, out, now)
+        if self._needs_revive:
+            self._revive_locked(now)
+
+    def _revive_locked(self, now: float) -> None:
+        """Complete a deferred revive (caller holds _engine_lock): clear the
+        failure flags, then rebuild from the mirror. The single place the
+        revive-completion sequence lives — three paths (drain, dispatch
+        crash, collector tick) all come through here."""
+        self._needs_revive = False
+        self.engine.device_error = None
+        self._revive_engine(now)
+
+    async def _revive_pipelined(self, now: float,
+                                extra_nack: list[Delivery] | None = None) -> None:
+        """Dispatch-path crash with windows possibly in flight: salvage what
+        landed, nack the rest, rebuild the engine from the mirror."""
+        async with self._engine_lock:
+            try:
+                outs = await asyncio.to_thread(self.engine.flush)
+            except Exception:
+                log.exception("flush during revive failed; all in-flight nacked")
+                outs = []
+            for tok, out in outs:
+                self._finish_token(tok, out, now)
+            for d in extra_nack or ():
+                self.app.broker.nack(self.consumer_tag, d.delivery_tag,
+                                     requeue=True)
+            # _revive_engine nacks + clears whatever meta the salvage flush
+            # could not finish.
+            self._revive_locked(now)
+
+    async def _collector_loop(self) -> None:
+        """Collect landed windows even when no new flush is running (traffic
+        stops → in-flight windows must still complete promptly). Supervised:
+        a publish/revive failure on one tick must not kill the task — a dead
+        collector means windows dispatched just before a traffic pause are
+        NEVER collected (matches unpublished, deliveries unacked)."""
+        while True:
+            try:
+                if self.engine.inflight() > 0 or self._needs_revive:
+                    now = time.time()
+                    async with self._engine_lock:
+                        self._collect_ready_locked(now)
+                        if self._needs_revive and self.engine.inflight() == 0:
+                            self._revive_locked(now)
+                    await asyncio.sleep(0.001)
+                else:
+                    await asyncio.sleep(0.01)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("collector tick failed; retrying")
+                self.app.metrics.counters.inc("collector_errors")
+                await asyncio.sleep(0.05)
 
     def _publish_columnar_matches(self, out, now: float) -> None:
         """Matched responses for one ColumnarOutcome (window flush AND
@@ -348,7 +514,18 @@ class _QueueRuntime:
 
     def _revive_engine(self, now: float) -> None:
         """Elastic recovery: rebuild the engine and resubmit the pool from
-        the authoritative host mirror (SURVEY.md §5)."""
+        the authoritative host mirror (SURVEY.md §5).
+
+        Any window meta still tracked is nacked HERE, whichever path led to
+        the revive (flush, sweeper drain, rescan drain, collector): the old
+        engine's windows are gone, and the fresh engine reissues tokens from
+        0 — stale entries would strand their deliveries unacked AND collide
+        with the new engine's token numbering."""
+        for tok, (_by_id, deliveries) in list(self._inflight_meta.items()):
+            for d in deliveries:
+                self.app.broker.nack(self.consumer_tag, d.delivery_tag,
+                                     requeue=True)
+            del self._inflight_meta[tok]
         try:
             snapshot = self.engine.waiting()
         except Exception:
@@ -427,6 +604,9 @@ class _QueueRuntime:
             outs: list = []
             try:
                 async with self._engine_lock:
+                    # rescan_async requires _open == 0 (double-match hazard
+                    # re-admitting slots an in-flight window may evict).
+                    await self._drain_engine(now)
                     if hasattr(self.engine, "rescan_async"):
                         def run():
                             tok = self.engine.rescan_async(window, now)
@@ -482,6 +662,9 @@ class _QueueRuntime:
             # so failures revive the engine like the flush/rescan paths.
             try:
                 async with self._engine_lock:
+                    # expire() requires _open == 0 (same re-admission hazard
+                    # as rescan) — collect in-flight windows first.
+                    await self._drain_engine(now)
                     expired = await asyncio.to_thread(
                         self.engine.expire, now, timeout)
             except Exception:
@@ -504,8 +687,13 @@ class _QueueRuntime:
         if self._rescanner is not None:
             self._rescanner.cancel()
         # Drain the batcher BEFORE cancelling the consumer so the final
-        # windows can still ack their deliveries.
+        # windows can still ack their deliveries; then collect any windows
+        # the final flush left in flight.
         await self.batcher.close()
+        if self._collector is not None:
+            self._collector.cancel()
+        async with self._engine_lock:
+            await self._drain_engine(time.time())
         self.app.broker.basic_cancel(self.consumer_tag)
 
 
@@ -558,6 +746,9 @@ class MatchmakingApp:
         counts: dict[str, int] = {}
         for name, rt in self._runtimes.items():
             async with rt._engine_lock:
+                # In-flight windows may still match (and release) mirror
+                # entries; collect them so the snapshot is post-match.
+                await rt._drain_engine(time.time())
                 counts[name] = save_pool(
                     rt.engine, os.path.join(directory, f"{name}.npz"),
                     queue_name=name)
@@ -576,6 +767,7 @@ class MatchmakingApp:
             if not os.path.exists(path):
                 continue
             async with rt._engine_lock:
+                await rt._drain_engine(now if now is not None else time.time())
                 counts[name] = load_pool(rt.engine, path, now)
         return counts
 

@@ -190,8 +190,10 @@ def run_point(args, window, depth, reps, iters):
 
 def mode_sweep(args):
     table = {}
-    for window in (512, 1024, 2048, 4096):
-        for depth in (1, 2, 4):
+    windows = [int(w) for w in args.sweep_windows.split(",")]
+    depths = [int(d) for d in args.sweep_depths.split(",")]
+    for window in windows:
+        for depth in depths:
             table[(window, depth)] = run_point(
                 args, window, depth, reps=args.reps, iters=args.iters)
     log("window depth mps p50 p99")
@@ -209,6 +211,8 @@ def main():
     p.add_argument("--depth", type=int, default=4)
     p.add_argument("--iters", type=int, default=30)
     p.add_argument("--reps", type=int, default=3)
+    p.add_argument("--sweep-windows", default="256,512,1024,2048")
+    p.add_argument("--sweep-depths", default="1,2,3,4")
     args = p.parse_args()
     import jax
 

@@ -1,0 +1,61 @@
+"""bench.py harness robustness (round-4 verdict ask #2).
+
+Round 2 lost ALL perf evidence to a single transient backend-init failure
+(`BENCH_r02.json` rc=1 at `jax.devices()`); the harness must retry bounded
+and, on persistent failure, still print ONE parseable JSON line with
+``"error": "backend_unavailable"`` and exit 0 so the driver records the
+outage instead of a crash.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def test_backend_unavailable_prints_diagnostic_json_line():
+    env = dict(os.environ)
+    # Force backend init to fail fast and deterministically: an unknown
+    # platform makes jax.devices() raise in both the probe subprocess and
+    # (hypothetically) in-process. PALLAS_AXON_POOL_IPS must go too —
+    # with it set, the machine's sitecustomize dials the TPU relay at
+    # INTERPRETER START of every subprocess, which hangs when the shared
+    # backend is down (observed this round) and would hang this test.
+    env["JAX_PLATFORMS"] = "definitely_not_a_backend"
+    env.pop("XLA_FLAGS", None)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run(
+        [sys.executable, BENCH, "--init-retries", "2", "--init-delay", "0"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    assert len(lines) == 1, f"expected exactly one stdout line, got {lines!r}"
+    payload = json.loads(lines[0])
+    assert payload["error"] == "backend_unavailable"
+    assert payload["value"] is None
+    assert payload["unit"] == "matches/sec"
+    # Retry really was bounded: stderr shows the retry log line.
+    assert "retry 1/1" in proc.stderr
+
+
+def test_init_backend_happy_path_unchanged():
+    """On a working backend (CPU here), init_backend returns devices on the
+    first attempt with no retries."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # see above: no relay dial in tests
+    code = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "import bench\n"
+        "devs = bench.init_backend(attempts=1, delay_s=0)\n"
+        "assert devs, devs\n"
+        "print('OK', len(devs))\n" % REPO
+    )
+    proc = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                          text=True, timeout=300, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert proc.stdout.startswith("OK")
