@@ -236,6 +236,57 @@ def run_engine_pipelined(engine, rng: np.random.Generator, *, pool_target: int,
     return mps, latencies, total_matches
 
 
+def roofline(engine, rng: np.random.Generator, *, window: int,
+             iters: int = 30) -> dict:
+    """Pure device-step cost + achieved-bandwidth roofline (no per-step D2H:
+    steps chain on the donated pool, one sync at the end — isolates device
+    time from the tunnel's ~70 ms serialized readback latency).
+
+    The blockwise score scan reads every pool column once per window, so
+    pool-bytes/step is the HBM traffic floor; utilization is reported against
+    the TPU v5e's ~819 GB/s peak. Low utilization at a small window means the
+    step is latency/compute-bound, not bandwidth-bound — both numbers plus
+    pair-scores/s are recorded so regressions are attributable."""
+    import jax
+    import jax.numpy as jnp
+
+    from matchmaking_tpu.core.pool import pack_batch
+
+    cols = make_columns(rng, window, 10_000_000, 0.0)
+    slots = engine.pool.allocate_columns(cols)
+    batch = engine.pool.batch_arrays_cols(cols, slots, window, 0.0)
+    packed = jnp.asarray(pack_batch(batch, 0.0))
+    pool_dev = engine._dev_pool
+    pool_bytes = sum(x.nbytes for x in jax.tree.leaves(pool_dev))
+    step_bytes = pool_bytes + packed.nbytes
+    k = engine.kernels
+    pool_dev, out = k.search_step_packed(pool_dev, packed)  # warm/compile
+    out.block_until_ready()
+    t0 = time.perf_counter()
+    outs = []
+    for _ in range(iters):
+        pool_dev, out = k.search_step_packed(pool_dev, packed)
+        outs.append(out)
+    outs[-1].block_until_ready()
+    dt = (time.perf_counter() - t0) / iters
+    # The chained steps MATCH (retiring resident device-pool players the
+    # host mirror still holds) and the donated pool buffers were consumed,
+    # so the engine's mirror and device state have diverged: roofline must
+    # be the engine's LAST use (bench_tpu calls it after the measured reps
+    # and then discards the engine). Write the pool back + release the
+    # scratch slots only so teardown paths stay functional.
+    engine.pool.release(slots)
+    engine._dev_pool = pool_dev
+    peak = 819e9  # TPU v5e HBM bandwidth
+    return {
+        "device_step_ms": round(dt * 1e3, 3),
+        "hbm_bytes_per_step": step_bytes,
+        "hbm_bytes_per_s": round(step_bytes / dt, 1),
+        "hbm_util_vs_819GBps": round(step_bytes / dt / peak, 4),
+        "pair_scores_per_s": round(window * k.capacity / dt, 1),
+    }
+
+
 def bench_tpu(args) -> dict:
     from matchmaking_tpu.config import Config, EngineConfig, QueueConfig
     from matchmaking_tpu.engine.interface import make_engine
@@ -264,10 +315,18 @@ def bench_tpu(args) -> dict:
         profiler_cm.__enter__()
         log(f"[tpu] jax.profiler trace → {args.profile_dir}")
 
+    from matchmaking_tpu.utils.metrics import CompileCounter
+
     runs = []
+    compiles_after_warmup: int | None = None
     t0 = time.perf_counter()
     try:
         for rep in range(max(1, args.repeats)):
+            if rep == 1:
+                # Every bucket shape compiled during rep 0; any further
+                # compile is a hot-path recompile (the p99 cliff SURVEY §5's
+                # recompile counter exists to expose).
+                compiles_after_warmup = CompileCounter.count()
             mps, lats, total = run_engine_pipelined(
                 engine, rng, pool_target=args.pool, window=args.window,
                 warmup=args.warmup, measured=args.windows, depth=args.depth,
@@ -288,6 +347,17 @@ def bench_tpu(args) -> dict:
     log(f"[tpu] {time.perf_counter() - t0:.1f}s total incl. fill/compile")
     if hasattr(engine, "span_report"):
         log(f"[tpu] spans: {engine.span_report()}")
+    recompiles = (CompileCounter.count() - compiles_after_warmup
+                  if compiles_after_warmup is not None else None)
+    log(f"[tpu] xla compiles total={CompileCounter.count()} "
+        f"hot-path recompiles={recompiles}")
+    roof = {}
+    if not args.skip_roofline:
+        try:
+            roof = roofline(engine, rng, window=args.window)
+            log(f"[tpu] roofline: {roof}")
+        except Exception as e:  # pragma: no cover - perf metadata only
+            log(f"[tpu] roofline failed: {e!r}")
     runs.sort(key=lambda r: r["matches_per_sec"])
     median = runs[len(runs) // 2]
     return {
@@ -295,6 +365,8 @@ def bench_tpu(args) -> dict:
         "pool": args.pool,
         "window": args.window,
         "all_runs_mps": [round(r["matches_per_sec"], 1) for r in runs],
+        "hot_path_recompiles": recompiles,
+        **roof,
     }
 
 
@@ -346,6 +418,8 @@ def main() -> None:
                         "backend_unavailable (the tunnel has outages)")
     p.add_argument("--init-delay", type=float, default=60.0,
                    help="seconds between backend-init attempts")
+    p.add_argument("--skip-roofline", action="store_true",
+                   help="skip the chained device-step roofline phase")
     args = p.parse_args()
 
     devices = init_backend(attempts=args.init_retries, delay_s=args.init_delay)
@@ -367,18 +441,20 @@ def main() -> None:
 
     tpu = bench_tpu(args)
     if args.skip_cpu:
-        cpu = {"matches_per_sec": float("nan")}
-        vs = float("nan")
+        # None, not NaN: NaN is not valid RFC 8259 JSON and breaks strict
+        # parsers on the driver side.
+        cpu = {"matches_per_sec": None}
+        vs = None
     else:
         cpu = bench_cpu_oracle(args)
-        vs = (tpu["matches_per_sec"] / cpu["matches_per_sec"]
-              if cpu["matches_per_sec"] > 0 else float("inf"))
+        vs = (round(tpu["matches_per_sec"] / cpu["matches_per_sec"], 2)
+              if cpu["matches_per_sec"] > 0 else None)
 
     result = {
         "metric": f"matches/sec @ {args.pool}-player pool (1v1 ELO)",
         "value": round(tpu["matches_per_sec"], 1),
         "unit": "matches/sec",
-        "vs_baseline": round(vs, 2),
+        "vs_baseline": vs,
         "p50_ms": round(tpu["p50_ms"], 3),
         "p99_ms": round(tpu["p99_ms"], 3),
         "p99_target_ms": 50.0,
@@ -386,10 +462,16 @@ def main() -> None:
         "window": tpu["window"],
         "total_matches": tpu["total_matches"],
         "all_runs_mps": tpu.get("all_runs_mps", []),
+        "hot_path_recompiles": tpu.get("hot_path_recompiles"),
+        "device_step_ms": tpu.get("device_step_ms"),
+        "hbm_bytes_per_s": tpu.get("hbm_bytes_per_s"),
+        "hbm_util_vs_819GBps": tpu.get("hbm_util_vs_819GBps"),
+        "pair_scores_per_s": tpu.get("pair_scores_per_s"),
         "baseline": {
             "what": "CPU oracle (reference sequential-scan semantics) "
                     f"@ {args.cpu_pool}-player pool",
-            "matches_per_sec": round(cpu["matches_per_sec"], 1),
+            "matches_per_sec": (None if cpu["matches_per_sec"] is None
+                                else round(cpu["matches_per_sec"], 1)),
         },
     }
     print(json.dumps(result), flush=True)

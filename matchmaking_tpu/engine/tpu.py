@@ -82,6 +82,12 @@ class _Pending:
 class TpuEngine(Engine):
     def __init__(self, cfg: Config, queue: QueueConfig):
         super().__init__(cfg, queue)
+        # Recompile visibility (SURVEY.md §5): every engine-owning process
+        # counts XLA backend compiles; a hot-path recompile is a latency
+        # cliff that must show in /metrics and the bench JSON.
+        from matchmaking_tpu.utils.metrics import CompileCounter
+
+        CompileCounter.install()
         ec = cfg.engine
         # Role/party queues (config #5) run the host oracle over the mirror;
         # plain team queues (config #3) and all 1v1 configs run on device,

@@ -805,6 +805,17 @@ async def serve(stop: "asyncio.Event | None" = None,
     also injects ``pika_module``) — the Docker CMD."""
     import signal
 
+    # Multi-host (DCN): when MM_DCN_* names a topology, join the jax
+    # multi-host runtime BEFORE any backend touch so jax.devices() is the
+    # global list and mesh_pool_axis can span hosts (engine/distributed.py;
+    # 2-process path exercised by tests/test_dcn.py).
+    from matchmaking_tpu.engine.distributed import dcn_configured, init_distributed
+
+    if dcn_configured():
+        rank, nprocs = init_distributed()
+        logging.getLogger(__name__).info(
+            "joined DCN topology: process %d of %d", rank, nprocs)
+
     cfg = Config.from_env()
     broker = None
     url = cfg.broker.url

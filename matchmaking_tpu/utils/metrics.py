@@ -89,17 +89,60 @@ class Span:
         return self.elapsed
 
 
+class CompileCounter:
+    """Process-wide XLA compilation counter (SURVEY.md §5 names "recompile
+    count" explicitly). The whole p99 story rests on bucketed static shapes —
+    a config typo that un-buckets one queue would silently add multi-hundred-
+    ms compiles to the hot path; this makes that visible in /metrics and
+    assertable in tests (soak asserts zero after warmup).
+
+    Counts ``/jax/core/compile/backend_compile_duration`` events via
+    jax.monitoring — one per actual XLA backend compile (cache hits don't
+    fire it). Process-wide by nature (the monitoring hook is global), which
+    matches the hazard: ANY unexpected compile in the serving process is a
+    latency cliff."""
+
+    _registered = False
+    _count = 0
+
+    @classmethod
+    def install(cls) -> None:
+        if cls._registered:
+            return
+        try:
+            import jax.monitoring as mon
+        except Exception:  # pragma: no cover - jax always present in practice
+            return
+
+        def on_event(name: str, duration: float, **kw) -> None:
+            if name == "/jax/core/compile/backend_compile_duration":
+                cls._count += 1
+
+        mon.register_event_duration_secs_listener(on_event)
+        cls._registered = True
+
+    @classmethod
+    def count(cls) -> int:
+        return cls._count
+
+
 class Metrics:
     def __init__(self) -> None:
         self.counters = Counter()
         self.latency: dict[str, LatencyRecorder] = defaultdict(LatencyRecorder)
+        # No CompileCounter.install() here: installing imports jax, which a
+        # pure-CPU deployment (CpuEngine = numpy oracle) otherwise never
+        # pays for. TpuEngine.__init__ installs it — exactly the processes
+        # where a compile can happen; count() reads 0 elsewhere.
 
     def record_latency(self, name: str, seconds: float) -> None:
         self.latency[name].record(seconds)
 
     def report(self) -> dict:
+        counters = self.counters.snapshot()
+        counters["xla_compiles"] = float(CompileCounter.count())
         return {
-            "counters": self.counters.snapshot(),
+            "counters": counters,
             "latency": {k: v.summary_ms() for k, v in self.latency.items()},
         }
 

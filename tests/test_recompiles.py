@@ -1,0 +1,64 @@
+"""Recompile discipline (SURVEY.md §5 "recompile count"): after every batch
+bucket and auxiliary path (admit/evict/rescan/expire) has been exercised
+once, further traffic of ANY size within the buckets must trigger ZERO new
+XLA compiles — a hot-path recompile is a multi-hundred-ms latency cliff that
+the bucketing exists to prevent.
+"""
+
+import numpy as np
+
+from matchmaking_tpu.config import Config, EngineConfig, QueueConfig
+from matchmaking_tpu.engine.interface import make_engine
+from matchmaking_tpu.service.contract import SearchRequest
+from matchmaking_tpu.utils.metrics import CompileCounter
+
+
+def _reqs(rng, n, start, now=0.0):
+    return [SearchRequest(id=f"r{start + i}",
+                          rating=float(rng.normal(1500, 150)),
+                          enqueued_at=now)
+            for i in range(n)]
+
+
+def test_zero_recompiles_after_buckets_warm(rng):
+    cfg = Config(
+        queues=(QueueConfig(rating_threshold=80.0, widen_per_sec=5.0),),
+        engine=EngineConfig(backend="tpu", pool_capacity=512, pool_block=128,
+                            batch_buckets=(16, 64), top_k=4),
+    )
+    engine = make_engine(cfg, cfg.queues[0])
+    next_id = 0
+
+    # Warmup: exercise every compiled entry point once per static shape —
+    # both buckets, restore/admit, remove/evict, rescan, expire.
+    for size in (8, 16, 40, 64):  # pads to bucket 16, 16, 64, 64
+        # enqueued_at must be nonzero: expire() treats 0.0 as "no timestamp"
+        # and never expires those players.
+        now = float(next_id + 1)
+        engine.search(_reqs(rng, size, next_id, now=now), now=now)
+        next_id += size
+    engine.restore(_reqs(rng, 10, next_id, now=float(next_id)), now=float(next_id))
+    next_id += 10
+    engine.remove(f"r{next_id - 1}")
+    engine.rescan_async(16, float(next_id))
+    engine.flush()
+    engine.expire(now=1e9, timeout=1.0)  # everything expires: evict path
+    assert engine.pool_size() == 0
+
+    warm = CompileCounter.count()
+    assert warm > 0, "warmup must have compiled something"
+
+    # Steady state: varied window sizes within the buckets, restores,
+    # rescans, expiries — zero new compiles allowed.
+    for i, size in enumerate((3, 16, 64, 1, 30, 64, 13, 50)):
+        engine.search(_reqs(rng, size, next_id), now=1e9 + i)
+        next_id += size
+    engine.restore(_reqs(rng, 5, next_id), now=1e9 + 20)
+    next_id += 5
+    engine.rescan_async(16, 1e9 + 21)
+    engine.flush()
+    engine.expire(now=2e9, timeout=1.0)
+
+    assert CompileCounter.count() == warm, (
+        f"hot-path recompiles: {CompileCounter.count() - warm} new XLA "
+        f"compiles after all buckets were warm")
