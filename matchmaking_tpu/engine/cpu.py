@@ -29,6 +29,10 @@ class CpuEngine(Engine):
         # Waiting pool: insertion-ordered parallel lists (the ETS table analog).
         self._entries: list[SearchRequest] = []
         self._by_id: dict[str, int] = {}  # player id -> index in _entries
+        # Role/party fast path (roles.try_party_match focus): sound only
+        # under the greedy invariant; restore() breaks it (a checkpoint can
+        # hold latent matches), so scans run unfocused until quiescent.
+        self._team_full_scan = False
 
     # ---- Engine API -------------------------------------------------------
 
@@ -65,6 +69,8 @@ class CpuEngine(Engine):
         for req in requests:
             if req.id not in self._by_id:
                 self._insert(req)
+        if requests and self.queue.team_size > 1:
+            self._team_full_scan = True
 
     def rescan(self, max_window: int, now: float) -> SearchOutcome:
         """Re-run the sequential search for the longest-waiting players so
@@ -101,6 +107,15 @@ class CpuEngine(Engine):
         if idx < len(self._entries):
             self._entries[idx] = last
             self._by_id[last.id] = idx
+        # Role/party queues: ANY removal (cancel, expiry, match harvest) can
+        # create a match among the REMAINING units — deleting a unit from
+        # the middle of a rating-sorted span makes its neighbors contiguous,
+        # and a window that previously failed (spread via a tight-threshold
+        # middle unit, role slots grabbed by evicted members) can now pack.
+        # The focused fast path only tries windows containing the newest
+        # arrival, so force one full scan; it self-clears at quiescence.
+        if self.queue.role_slots and self.queue.team_size > 1:
+            self._team_full_scan = True
         return req
 
     def _compatible(self, a: SearchRequest, b: SearchRequest) -> bool:
@@ -167,15 +182,29 @@ class CpuEngine(Engine):
             from matchmaking_tpu.engine.roles import try_party_match
 
             # Parties occupy multiple slots; delegate to the role/party
-            # oracle, one pairwise-compatible group at a time.
+            # oracle, one pairwise-compatible group at a time. Focused
+            # (windows containing the new arrival only) when the greedy
+            # invariant holds; full scan after restore() or with widening
+            # (old windows can become valid by waiting).
+            use_focus = (self.queue.widen_per_sec <= 0.0
+                         and not self._team_full_scan)
+            matched_here = False
             for _, members in self._compat_groups(list(self._entries)):
-                formed = try_party_match(members, self.queue, now, self)
+                if use_focus and all(m.id != req.id for m in members):
+                    continue  # no new unit → no new match possible
+                formed = try_party_match(members, self.queue, now, self,
+                                         focus=req if use_focus else None)
                 if formed is not None:
                     teams, qual = formed
                     for r in (r for team in teams for r in team):
                         self._evict(self._by_id[r.id])
                     out.matches.append(Match(new_match_id(), teams, qual))
+                    matched_here = True
                     break
+            if self._team_full_scan and not matched_here:
+                # Quiescent: the restored pool holds no latent match; the
+                # greedy invariant is re-established.
+                self._team_full_scan = False
         else:
             solos = [e for e in self._entries if e.party_size == 1]
             for _, members in self._compat_groups(solos):

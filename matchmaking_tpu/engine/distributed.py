@@ -71,8 +71,9 @@ def dcn_configured() -> bool:
     ``MM_DCN_AUTO=1`` (TPU pods — ``jax.distributed.initialize()`` bare,
     auto-detected from the TPU metadata server). Auto-detection needs the
     explicit opt-in because a bare initialize() on a non-pod host fails."""
+    auto = os.environ.get("MM_DCN_AUTO", "").strip().lower()
     return bool(os.environ.get("MM_DCN_COORDINATOR")
-                or os.environ.get("MM_DCN_AUTO"))
+                or auto in ("1", "true", "yes", "on"))
 
 
 def global_pool_mesh():

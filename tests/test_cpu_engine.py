@@ -285,3 +285,28 @@ def test_role_queue_respects_pairwise_region_filters():
     if out.matches:
         all_regions = {r.region for t in out.matches[0].teams for r in t} - {"*"}
         assert len(all_regions) <= 1
+
+
+def test_role_queue_removal_enables_match_among_old_units():
+    """A removal (cancel/expiry) from the middle of a rating-sorted span can
+    make the REMAINING units a valid window. The focused arrival scan alone
+    would never retry old-units-only windows — _evict must force one full
+    scan (regression for the round-4 review finding)."""
+    slots = ("tank", "dps")
+    eng = make_engine(team_size=2, rating_threshold=100, role_slots=slots)
+    # B's tiny per-request threshold poisons every window spanning A..F
+    # while B waits (windows are contiguous in rating order).
+    eng.search([req("A", 1500, roles=("tank",))], now=0.0)
+    eng.search([req("B", 1520, roles=("dps",), rating_threshold=5.0)], now=0.0)
+    eng.search([req("C", 1540, roles=("dps",))], now=0.0)
+    eng.search([req("D", 1545, roles=("tank",))], now=0.0)
+    out = eng.search([req("F", 1550, roles=("dps",))], now=0.0)
+    assert not out.matches and eng.pool_size() == 5
+    # B cancels: [A,C,D,F] (spread 50 <= 100, 2 tanks + 2 dps) is now valid.
+    assert eng.remove("B") is not None
+    # The next arrival is rating-distant (its own windows can't match), so
+    # ONLY a full scan finds the old-units match.
+    out = eng.search([req("Z", 3000, roles=("tank",))], now=0.0)
+    assert len(out.matches) == 1
+    ids = {p for t in out.matches[0].teams for r in t for p in r.all_ids()}
+    assert ids == {"A", "C", "D", "F"}
