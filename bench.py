@@ -186,7 +186,7 @@ def run_engine(engine, rng: np.random.Generator, *, pool_target: int,
 
 def run_engine_pipelined(engine, rng: np.random.Generator, *, pool_target: int,
                          window: int, warmup: int, measured: int, depth: int,
-                         label: str):
+                         label: str, gen=None):
     """Stream windows through the pipelined API (``search_async`` +
     ``collect_ready``) keeping ≤ ``depth`` windows in flight.
 
@@ -194,6 +194,7 @@ def run_engine_pipelined(engine, rng: np.random.Generator, *, pool_target: int,
     end-to-end path a request sees past the batcher). Throughput is counted
     over the measured tokens' span.
     """
+    gen = gen or make_columns
     next_id = 0
     wall0 = time.perf_counter()
 
@@ -205,7 +206,7 @@ def run_engine_pipelined(engine, rng: np.random.Generator, *, pool_target: int,
         deficit = pool_target - engine.pool_size()
         while deficit > 0:
             chunk = min(deficit, 8192)
-            engine.restore_columns(make_columns(rng, chunk, next_id, wall()), wall())
+            engine.restore_columns(gen(rng, chunk, next_id, wall()), wall())
             next_id += chunk
             deficit -= chunk
 
@@ -228,7 +229,7 @@ def run_engine_pipelined(engine, rng: np.random.Generator, *, pool_target: int,
             t_last = time.perf_counter()
 
     for i in range(warmup + measured):
-        cols = make_columns(rng, window, next_id, wall())
+        cols = gen(rng, window, next_id, wall())
         next_id += window
         if i == warmup:
             t_start = time.perf_counter()
@@ -315,6 +316,7 @@ def bench_tpu(args) -> dict:
             pool_block=args.pool_block,
             batch_buckets=(16, 64, 256, args.window),
             top_k=8,
+            readback_group=args.readback_group,
         ),
     )
     engine = make_engine(cfg, cfg.queues[0])
@@ -574,6 +576,11 @@ def main() -> None:
                         "(view with tensorboard/xprof)")
     p.add_argument("--depth", type=int, default=4,
                    help="max in-flight windows (pipelining hides device RTT)")
+    p.add_argument("--readback-group", type=int, default=1,
+                   help="stack k windows' results on device and transfer "
+                        "them as ONE D2H (the tunnel serializes transfers "
+                        "at ~12-14/s; grouping multiplies result "
+                        "throughput per transfer slot)")
     p.add_argument("--cpu-pool", type=int, default=2000,
                    help="CPU-oracle pool size (the reference's ~cap)")
     p.add_argument("--cpu-windows", type=int, default=20)

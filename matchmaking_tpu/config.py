@@ -107,6 +107,20 @@ class EngineConfig:
     #: serialize, so depth 2 keeps the transfer channel busy while window
     #: N+1 computes; deeper only queues latency (see BENCH_SWEEP.md).
     pipeline_depth: int = 2
+    #: Device-side readback grouping: stack this many windows' result
+    #: arrays ON DEVICE and transfer them to host as ONE array. The host
+    #: link is the measured bottleneck (one D2H ≈ 70 ms fixed latency,
+    #: transfers serialized ≈ 12-14/s on the axon tunnel), so one transfer
+    #: per k windows multiplies result throughput by ~k at the cost of up
+    #: to (k-1) device-step times of extra latency for the group's first
+    #: window. 1 = off (one transfer per window). Groups seal early when a
+    #: caller collects (collect_ready/flush), so idle traffic is not held
+    #: back a full group.
+    readback_group: int = 1
+    #: Age (ms) after which a partially-filled readback group is sealed and
+    #: transferred anyway (checked on every collect_ready poll) — bounds
+    #: the extra latency grouping can add when traffic pauses mid-group.
+    readback_group_wait_ms: float = 8.0
 
 
 @dataclass(frozen=True)

@@ -167,13 +167,18 @@ def try_party_match(units: Sequence[SearchRequest], queue: QueueConfig,
             members += su[hi].party_size
             if members < need:
                 continue
-            if hi < fidx:
-                # Window complete but doesn't reach the focus unit yet —
-                # already tried by an earlier arrival (greedy invariant).
-                continue
+            # extra counts EVERY completed window — including hi < fidx ones
+            # in focus mode — so the focused scan tries exactly the subset
+            # of full-scan windows that contain the focus unit; counting
+            # only from fidx would let focus mode reach windows the full
+            # scan abandons at the slack bound, and the two modes would
+            # form different matches on identical pools.
             extra += 1
             if extra > WINDOW_SLACK:
                 break
+            if hi < fidx:
+                # Already tried by an earlier arrival (greedy invariant).
+                continue
             window = su[lo:hi + 1]
             spread = unit_rating(window[-1]) - unit_rating(window[0])
             # Window must fit every member unit's effective threshold

@@ -57,6 +57,37 @@ logger = logging.getLogger(__name__)
 
 
 @dataclass
+class _ReadGroup:
+    """K windows' result arrays awaiting ONE device→host transfer.
+
+    The host link is the measured bottleneck on the axon tunnel (one D2H ≈
+    70 ms fixed latency, transfers serialized ≈ 12-14/s), so result arrays
+    of consecutive windows are stacked ON DEVICE (one tiny jitted stack) and
+    shipped as a single transfer — result throughput scales ~k per transfer
+    slot. Groups are keyed by result shape (same batch bucket), sealed when
+    full, at collect time once older than ``readback_group_wait_ms``, or at
+    flush."""
+
+    handles: list | None
+    created: float
+    stacked: Any = None
+    host: np.ndarray | None = None
+    #: Single-window group (stale seal during a lull): ``stacked`` is the
+    #: bare result array, not a stack — no jitted stack, no extra compile.
+    solo: bool = False
+
+
+class _GroupSlot:
+    """One window's row within a _ReadGroup's stacked transfer."""
+
+    __slots__ = ("group", "idx")
+
+    def __init__(self, group: _ReadGroup, idx: int):
+        self.group = group
+        self.idx = idx
+
+
+@dataclass
 class _Pending:
     """One dispatched-but-uncollected request window."""
 
@@ -181,6 +212,16 @@ class TpuEngine(Engine):
         self._open = 0                      # dispatched, not yet finalized
         self._pending: collections.deque[_Pending] = collections.deque()
         self._next_token = 0
+        # Readback grouping (see _ReadGroup): disabled for device team
+        # queues (synchronous dispatch, different result shape per step
+        # family) and k<=1.
+        self._rb_k = 1 if self._team_device else max(1, ec.readback_group)
+        self._rb_wait_s = ec.readback_group_wait_ms / 1e3
+        self._rb_open: dict[tuple, _ReadGroup] = {}
+        self._stack_fns: dict[tuple, Any] = {}
+        #: Windows finalized out-of-band (wildcard delegation flushes while
+        #: dispatching) — handed to the caller on the next collect/flush.
+        self._done_early: list[tuple[int, Any]] = []
         #: First device failure since the last sync search(); async callers
         #: should check this after collect_ready()/flush().
         self.device_error: BaseException | None = None
@@ -228,19 +269,90 @@ class TpuEngine(Engine):
 
     def _submit(self, pending: _Pending) -> None:
         """Queue the window's D2H behind its execution and track it FIFO."""
-        for chunk in pending.chunks:
-            for h in chunk[1]:
-                try:
-                    h.copy_to_host_async()
-                except AttributeError:  # pragma: no cover - non-Array types
-                    pass
+        if self._rb_k > 1:
+            pending.chunks = [
+                (payload, tuple(self._rb_attach(h) for h in handles), now)
+                for payload, handles, now in pending.chunks
+            ]
+        else:
+            for chunk in pending.chunks:
+                for h in chunk[1]:
+                    try:
+                        h.copy_to_host_async()
+                    except AttributeError:  # pragma: no cover - non-Array
+                        pass
         self._open += 1
         self._pending.append(pending)
 
-    @staticmethod
-    def _is_ready(pending: _Pending) -> bool:
+    # ---- readback grouping --------------------------------------------------
+
+    def _rb_attach(self, out: Any) -> _GroupSlot:
+        key = (out.shape, str(out.dtype))
+        g = self._rb_open.get(key)
+        if g is None:
+            g = _ReadGroup(handles=[], created=time.perf_counter())
+            self._rb_open[key] = g
+        assert g.handles is not None
+        g.handles.append(out)
+        slot = _GroupSlot(g, len(g.handles) - 1)
+        if len(g.handles) >= self._rb_k:
+            self._rb_seal(key, g)
+        return slot
+
+    def _rb_seal(self, key: tuple, g: _ReadGroup) -> None:
+        """Stack the group's results on device and start their ONE D2H."""
+        self._rb_open.pop(key, None)
+        handles = g.handles
+        assert handles is not None
+        g.handles = None
+        if len(handles) == 1:
+            # No stack needed — and crucially no per-(count,shape) XLA
+            # compile on the stale-seal path, which runs on the service
+            # event loop.
+            g.solo = True
+            g.stacked = handles[0]
+        else:
+            fkey = (len(handles),) + key
+            fn = self._stack_fns.get(fkey)
+            if fn is None:
+                fn = jax.jit(lambda *xs: jnp.stack(xs))
+                self._stack_fns[fkey] = fn
+            g.stacked = fn(*handles)
         try:
-            return all(h.is_ready() for c in pending.chunks for h in c[1])
+            g.stacked.copy_to_host_async()
+        except AttributeError:  # pragma: no cover - non-Array types
+            pass
+
+    def _rb_seal_stale(self, force: bool = False) -> None:
+        """Seal partial groups that have waited past the wait budget (or
+        all of them, at flush) so a traffic lull cannot strand results."""
+        if not self._rb_open:
+            return
+        now = time.perf_counter()
+        for key, g in list(self._rb_open.items()):
+            if force or now - g.created >= self._rb_wait_s:
+                self._rb_seal(key, g)
+
+    @staticmethod
+    def _handle_ready(h: Any) -> bool:
+        if isinstance(h, _GroupSlot):
+            g = h.group
+            return g.stacked is not None and g.stacked.is_ready()
+        return h.is_ready()
+
+    @staticmethod
+    def _materialize(h: Any) -> np.ndarray:
+        if isinstance(h, _GroupSlot):
+            g = h.group
+            if g.host is None:
+                g.host = np.asarray(g.stacked)
+            return g.host if g.solo else g.host[h.idx]
+        return np.asarray(h)
+
+    def _is_ready(self, pending: _Pending) -> bool:
+        try:
+            return all(self._handle_ready(h)
+                       for c in pending.chunks for h in c[1])
         except AttributeError:  # pragma: no cover - older jax arrays
             return True
 
@@ -250,7 +362,7 @@ class TpuEngine(Engine):
         if pending.raw is not None:
             return
         try:
-            pending.raw = [tuple(np.asarray(h) for h in c[1])
+            pending.raw = [tuple(self._materialize(h) for h in c[1])
                            for c in pending.chunks]
         except BaseException as e:
             pending.error = e
@@ -481,6 +593,10 @@ class TpuEngine(Engine):
         FIFO — a ready window behind an unfinished one waits its turn).
         Columnar windows yield ColumnarOutcome; object windows SearchOutcome."""
         done: list[tuple[int, SearchOutcome | ColumnarOutcome]] = []
+        if self._done_early:
+            done, self._done_early = self._done_early, []
+        if self._rb_k > 1:
+            self._rb_seal_stale()
         while self._pending and self._is_ready(self._pending[0]):
             pending = self._pending.popleft()
             self._fetch(pending)
@@ -493,6 +609,10 @@ class TpuEngine(Engine):
     def flush(self) -> list[tuple[int, SearchOutcome | ColumnarOutcome]]:
         """Block until every in-flight window is collected and finalized."""
         done: list[tuple[int, SearchOutcome | ColumnarOutcome]] = []
+        if self._done_early:
+            done, self._done_early = self._done_early, []
+        if self._rb_k > 1:
+            self._rb_seal_stale(force=True)
         while self._pending:
             pending = self._pending.popleft()
             self._fetch(pending)
@@ -606,10 +726,14 @@ class TpuEngine(Engine):
             "request to stay on the device path.", self.queue.name)
         from matchmaking_tpu.engine.cpu import CpuEngine
 
-        assert self._open == 0, (
-            "wildcard delegation with windows in flight — team queues "
-            "dispatch synchronously, so this cannot happen"
-        )
+        if self._open:
+            # Team queues dispatch through the pipelined API since round 4,
+            # so a wildcard can arrive with windows in flight. The mirror
+            # snapshot below must be post-match (an in-flight window may
+            # still match players the mirror holds), so finalize them now;
+            # their outcomes are stashed and returned to the caller by the
+            # next collect_ready()/flush() under their original tokens.
+            self._done_early.extend(self.flush())
         delegate = CpuEngine(self.cfg, self.queue)
         waiting = self.pool.waiting()
         if waiting:

@@ -71,28 +71,20 @@ def bench_columnar_config(name, queue_kwargs, *, pool, capacity, window,
     )
     engine = make_engine(cfg, cfg.queues[0])
     rng = np.random.default_rng(11)
-
-    # Patch the generator the shared runner uses so filters/RD flow in.
-    import bench as bench_mod
-
-    orig = bench_mod.make_columns
-    bench_mod.make_columns = (
-        lambda r, n, s, t: make_columns_variant(r, n, s, t, **gen_kwargs))
-    try:
-        mps, lats, total = run_engine_pipelined(
-            engine, rng, pool_target=pool, window=window, warmup=3,
-            measured=windows, depth=depth, label=name)
-    finally:
-        bench_mod.make_columns = orig
+    mps, lats, total = run_engine_pipelined(
+        engine, rng, pool_target=pool, window=window, warmup=3,
+        measured=windows, depth=depth, label=name,
+        gen=lambda r, n, s, t: make_columns_variant(r, n, s, t, **gen_kwargs))
     p50, p99 = _pctls(lats)
     return {"config": name, "matches_per_sec": round(mps, 1),
             "p50_ms": p50, "p99_ms": p99, "pool": pool, "window": window,
             "total_matches": total, "path": "device columnar pipelined"}
 
 
-def bench_team_5v5(*, pool, capacity, window, windows):
-    """Device team kernel: object-API windows (currently dispatched
-    synchronously — the measured latency is the full window round trip)."""
+def bench_team_5v5(*, pool, capacity, window, windows, depth=2):
+    """Device team kernel through the PIPELINED object API (search_async +
+    collect_ready, ≤depth windows in flight — the path the service now
+    runs); latency = dispatch → collected on host."""
     from matchmaking_tpu.config import Config, EngineConfig, QueueConfig
     from matchmaking_tpu.engine.interface import make_engine
     from matchmaking_tpu.service.contract import SearchRequest
@@ -129,24 +121,44 @@ def bench_team_5v5(*, pool, capacity, window, windows):
     refill(now)
     log(f"[team_5v5] pool filled to {engine.pool_size()}")
     lats, players = [], 0
-    span = 0.0
+    submit_t, timed = {}, {}
+    t_start = t_last = None
+
+    def handle(tok, out):
+        nonlocal players, t_last
+        lat = time.perf_counter() - submit_t.pop(tok)
+        if timed.pop(tok):
+            lats.append(lat)
+            players_here = sum(len(t) for m in out.matches for t in m.teams)
+            players = players + players_here
+            t_last = time.perf_counter()
+
     for i in range(3 + windows):
         window_reqs = reqs(window, now)
-        t0 = time.perf_counter()
-        out = engine.search(window_reqs, now)
-        dt = time.perf_counter() - t0
-        now += max(dt, 1e-4)
-        if i >= 3:
-            lats.append(dt)
-            players += sum(len(t) for m in out.matches for t in m.teams)
-            span += dt
+        if i == 3:
+            t_start = time.perf_counter()
+        tok, _ = engine.search_async(window_reqs, now)
+        submit_t[tok] = time.perf_counter()
+        timed[tok] = i >= 3
+        now += 1e-3
+        for tok2, out in engine.collect_ready():
+            handle(tok2, out)
+        while engine.inflight() >= depth:
+            got = engine.collect_ready()
+            if not got:
+                time.sleep(0.0005)
+            for tok2, out in got:
+                handle(tok2, out)
         refill(now)
+    for tok2, out in engine.flush():
+        handle(tok2, out)
+    span = (t_last - t_start) if (t_start and t_last and t_last > t_start) else 0.0
     p50, p99 = _pctls(lats)
-    mps = players / 2.0 / span if span else 0.0  # matches (5v5) per sec
-    return {"config": "team_5v5", "matches_per_sec": round(mps / 5.0, 1),
-            "players_matched_per_sec": round(players / span, 1),
+    return {"config": "team_5v5",
+            "matches_per_sec": round(players / 10.0 / span, 1) if span else 0.0,
+            "players_matched_per_sec": round(players / span, 1) if span else 0.0,
             "p50_ms": p50, "p99_ms": p99, "pool": pool, "window": window,
-            "path": "device team kernel (sync windows)"}
+            "path": f"device team kernel (pipelined depth={depth})"}
 
 
 def bench_role_party_ladder(*, windows=8):

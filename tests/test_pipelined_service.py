@@ -103,6 +103,45 @@ async def test_depth1_disables_pipelining():
     await app.stop()
 
 
+async def test_team_queue_windows_pipeline_and_overlap_1v1(monkeypatch):
+    """Device team queues ride the same pipelined machinery (round-3 ask
+    #9): with collection gated shut, team windows pile up in flight WHILE a
+    1v1 queue's windows are also in flight — the two queues' device work
+    overlaps instead of serializing behind blocking flushes."""
+    qa = QueueConfig(name="mm.solo", rating_threshold=100.0)
+    qb = QueueConfig(name="mm.team", rating_threshold=150.0, team_size=2)
+    app = MatchmakingApp(Config(
+        queues=(qa, qb),
+        engine=EngineConfig(backend="tpu", pool_capacity=256, pool_block=64,
+                            batch_buckets=(8, 32), top_k=4,
+                            pipeline_depth=3),
+        batcher=BatcherConfig(max_batch=4, max_wait_ms=5.0),
+    ))
+    await app.start()
+    rt_solo, rt_team = app.runtime("mm.solo"), app.runtime("mm.team")
+    assert rt_solo._pipelined and rt_team._pipelined
+    monkeypatch.setattr(TpuEngine, "_is_ready", lambda self, p: False)
+    client = MatchmakingClient(app.broker, "mm.solo")
+    handles = {}
+    for i in range(8):
+        handles[f"s{i}"] = client.submit(
+            {"id": f"s{i}", "rating": 1500 + 7 * i}, queue="mm.solo")
+        handles[f"t{i}"] = client.submit(
+            {"id": f"t{i}", "rating": 1500 + 5 * i, "region": "eu",
+             "game_mode": "ranked"}, queue="mm.team")
+    deadline = time.time() + 10.0
+    while time.time() < deadline and not (
+            rt_solo.engine.inflight() >= 2 and rt_team.engine.inflight() >= 2):
+        await asyncio.sleep(0.005)
+    assert rt_solo.engine.inflight() >= 2, rt_solo.engine.inflight()
+    assert rt_team.engine.inflight() >= 2, rt_team.engine.inflight()
+    monkeypatch.undo()
+    for pid, h in handles.items():
+        resp = await client.next_response(h, timeout=20.0)
+        assert resp.status in ("queued", "matched"), (pid, resp)
+    await app.stop()
+
+
 async def test_failed_window_nacks_and_revives(monkeypatch):
     """A device failure on one window: its deliveries are nacked (redelivered
     and deduped), the engine revives from the mirror, and the players still

@@ -1,0 +1,86 @@
+"""Device-side readback grouping (EngineConfig.readback_group): k windows'
+result arrays are stacked on device and transferred as ONE D2H. Must be
+semantically invisible — identical matches to the ungrouped engine, partial
+groups seal on collect after the wait budget, flush never strands a group.
+"""
+
+import time
+
+import numpy as np
+
+from matchmaking_tpu.config import Config, EngineConfig, QueueConfig
+from matchmaking_tpu.engine.interface import make_engine
+from matchmaking_tpu.service.contract import RequestColumns
+
+
+def _cfg(k, wait_ms=8.0):
+    return Config(
+        queues=(QueueConfig(rating_threshold=100.0),),
+        engine=EngineConfig(backend="tpu", pool_capacity=256, pool_block=64,
+                            batch_buckets=(16,), top_k=4,
+                            readback_group=k,
+                            readback_group_wait_ms=wait_ms),
+    )
+
+
+def _cols(rng, n, start):
+    return RequestColumns(
+        ids=np.array([f"p{start + i}" for i in range(n)], object),
+        rating=rng.normal(1500, 80, size=n).astype(np.float32),
+        rd=np.zeros(n, np.float32),
+        region=np.zeros(n, np.int32),
+        mode=np.zeros(n, np.int32),
+        threshold=np.full(n, np.nan, np.float32),
+        enqueued_at=np.full(n, 1.0, np.float64),
+    )
+
+
+def _run(k, n_windows=6, window=16):
+    engine = make_engine(_cfg(k), _cfg(k).queues[0])
+    rng = np.random.default_rng(99)
+    pairs = set()
+    queued = []
+    for w in range(n_windows):
+        engine.search_columns_async(_cols(rng, window, w * window), 1.0 + w)
+        for _tok, out in engine.collect_ready():
+            pairs.update(frozenset(p) for p in zip(out.m_id_a, out.m_id_b))
+    for _tok, out in engine.flush():
+        pairs.update(frozenset(p) for p in zip(out.m_id_a, out.m_id_b))
+        queued.extend(out.q_ids)
+    assert engine.device_error is None
+    return pairs, engine.pool_size()
+
+
+def test_grouped_matches_equal_ungrouped():
+    base_pairs, base_pool = _run(1)
+    for k in (2, 3, 4):
+        pairs, pool = _run(k)
+        assert pairs == base_pairs, f"k={k} diverged"
+        assert pool == base_pool
+
+
+def test_partial_group_seals_on_collect():
+    """One lone window (group of 1 with k=4) must still complete via the
+    stale-seal path on collect_ready polling."""
+    cfg = _cfg(4, wait_ms=1.0)
+    engine = make_engine(cfg, cfg.queues[0])
+    rng = np.random.default_rng(5)
+    tok = engine.search_columns_async(_cols(rng, 16, 0), 1.0)
+    got = []
+    deadline = time.time() + 30.0
+    while not got and time.time() < deadline:
+        time.sleep(0.002)
+        got = engine.collect_ready()
+    assert got and got[0][0] == tok
+    assert engine.inflight() == 0
+
+
+def test_flush_seals_open_groups():
+    cfg = _cfg(8, wait_ms=10_000.0)  # wait budget effectively infinite
+    engine = make_engine(cfg, cfg.queues[0])
+    rng = np.random.default_rng(6)
+    toks = [engine.search_columns_async(_cols(rng, 16, 100 * i), 1.0)
+            for i in range(3)]
+    outs = engine.flush()
+    assert [t for t, _ in outs] == toks
+    assert engine.inflight() == 0

@@ -325,3 +325,37 @@ class TestWildcardDelegation:
         tpu.restore(reqs, now=0.0)
         assert tpu._team_delegate is not None
         assert tpu.pool_size() == 2
+
+
+def test_wildcard_delegation_with_window_in_flight():
+    """A wildcard request arriving while pipelined team windows are in
+    flight must flush-and-stash them (their outcomes surface under their
+    original tokens on the next collect), then delegate to the host oracle
+    — regression for the round-4 review finding (formerly an assert)."""
+    cfg = _team_cfg(2)
+    engine = make_engine(cfg, cfg.queues[0])
+    # One pinned-region window in flight (2 close + 2 far players: the
+    # close pair could match 1v1 but team_size=2 needs 4 in one window).
+    tok0, _ = engine.search_async(
+        [_req(0, 1500), _req(1, 1505), _req(2, 1508), _req(3, 1512)], 1.0)
+    assert engine.inflight() == 1
+    # Wildcard arrival triggers delegation mid-flight.
+    tok1, _ = engine.search_async(
+        [_req(9, 1500, region="*"), _req(10, 1505), _req(11, 1498),
+         _req(12, 1503)], 2.0)
+    outs = dict(engine.flush())
+    assert tok0 in outs and tok1 in outs
+    assert engine._team_delegate is not None
+    # No player lost: window-0 players either matched in the stashed
+    # outcome or live on in the delegate's pool.
+    ids0 = {f"p{i}" for i in range(4)}
+    matched0 = {r.id for m in outs[tok0].matches
+                for t in m.teams for r in t}
+    waiting = {r.id for r in engine.waiting()}
+    assert ids0 <= (matched0 | waiting)
+    # And the delegated queue still matches new arrivals (host oracle).
+    out = engine.search([_req(20, 1501), _req(21, 1502)], 3.0)
+    all_known = (matched0 | waiting
+                 | {r.id for m in out.matches for t in m.teams for r in t}
+                 | {r.id for r in engine.waiting()})
+    assert {"p20", "p21"} <= all_known
