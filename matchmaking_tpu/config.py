@@ -95,11 +95,6 @@ class EngineConfig:
     #: parallel-greedy window-selection rounds (engine/teams.py).
     team_max_matches: int = 1024
     team_rounds: int = 16
-    #: Use the Pallas score+top-k kernel for the 1v1 hot op (VMEM-resident
-    #: score tiles + running top-k — engine/pallas_kernels.py). Off by
-    #: default: the fused-XLA scan is the reference path; flip per
-    #: deployment after benchmarking both on your chip.
-    use_pallas: bool = False
     #: Max dispatched-but-uncollected windows the SERVICE keeps in flight on
     #: the pipelined columnar path (1 = the old dispatch-then-block flush).
     #: Pipelining hides the host↔device round trip — measured on the axon
@@ -107,8 +102,10 @@ class EngineConfig:
     #: serialize, so depth 2 keeps the transfer channel busy while window
     #: N+1 computes; deeper only queues latency (see BENCH_SWEEP.md).
     pipeline_depth: int = 2
-    #: Device-side readback grouping: stack this many windows' result
-    #: arrays ON DEVICE and transfer them to host as ONE array. The host
+    #: Device-side readback grouping: stack this many result arrays (one
+    #: per dispatched window chunk — a window larger than the top batch
+    #: bucket contributes one per chunk) ON DEVICE and transfer them to
+    #: host as ONE array. What is amortized is TRANSFERS: the host
     #: link is the measured bottleneck (one D2H ≈ 70 ms fixed latency,
     #: transfers serialized ≈ 12-14/s on the axon tunnel), so one transfer
     #: per k windows multiplies result throughput by ~k at the cost of up

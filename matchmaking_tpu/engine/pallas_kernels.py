@@ -15,9 +15,16 @@ immediately; nothing (B × blk)-shaped ever touches HBM:
 
 Measured on v5e (round 2): the XLA fused scan and this kernel are within
 noise of each other once both avoid materializing scores (the round-1 top-k
-variants were 2-4× slower than either). The XLA path remains the default;
-``EngineConfig.use_pallas`` flips to this kernel after benchmarking on your
-chip (`scripts/profile_stages.py --mode device`).
+variants were 2-4× slower than either).
+
+STATUS (settled round 4): this is a PINNED REFERENCE, not a production code
+path. The former ``EngineConfig.use_pallas`` gate was removed — the Pallas
+variant ran admission as a separate pool pass, which costs ~20 µs of HBM
+traffic against a ~7.4 ms step (<1%), so even a perfectly fused Pallas step
+cannot clear a ≥15% win over the XLA scan that already fuses
+admit+score+best in one pass. tests/test_pallas.py keeps this kernel
+exactly equivalent (same lists, same tie rule, interpret mode on CPU) so it
+remains a working starting point for chips where hand tiling DOES win.
 
 Layout notes (TPU tiling wants trailing-dim 128):
 - pool fields pre-packed (7, P) f32: rating, rd, region, mode, threshold,
@@ -26,9 +33,8 @@ Layout notes (TPU tiling wants trailing-dim 128):
   mode, eff_threshold (widening pre-applied), valid.
 - outputs (B, 128) f32 ×2 (vals, idx); callers slice [:, :n_blocks].
 
-Gated by ``EngineConfig.use_pallas``; on non-TPU backends the pallas_call
-runs in interpret mode (tests), so CPU correctness is pinned against the
-XLA path.
+On non-TPU backends the pallas_call runs in interpret mode (tests), so CPU
+correctness is pinned against the XLA path.
 """
 
 from __future__ import annotations

@@ -75,6 +75,13 @@ class _ReadGroup:
     #: Single-window group (stale seal during a lull): ``stacked`` is the
     #: bare result array, not a stack — no jitted stack, no extra compile.
     solo: bool = False
+    #: Partial group sealed loose (stale/flush): handles transfer
+    #: individually, NO device stack — the jitted stack would compile per
+    #: (count, shape) and stale seals run on the service EVENT LOOP, where
+    #: a first-time XLA compile freezes every queue. Loose seals happen in
+    #: lulls/flushes where transfer serialization doesn't matter anyway;
+    #: only FULL groups (sealed during dispatch, off-loop) use the stack.
+    loose: bool = False
 
 
 class _GroupSlot:
@@ -180,7 +187,6 @@ class TpuEngine(Engine):
                 widen_per_sec=queue.widen_per_sec,
                 max_threshold=queue.max_threshold,
                 pair_rounds=ec.pair_rounds,
-                use_pallas=ec.use_pallas,
             )
             self._dev_pool = jax.device_put(
                 {k: jnp.asarray(v)
@@ -296,28 +302,36 @@ class TpuEngine(Engine):
         g.handles.append(out)
         slot = _GroupSlot(g, len(g.handles) - 1)
         if len(g.handles) >= self._rb_k:
-            self._rb_seal(key, g)
+            self._rb_seal(key, g, full=True)
         return slot
 
-    def _rb_seal(self, key: tuple, g: _ReadGroup) -> None:
-        """Stack the group's results on device and start their ONE D2H."""
+    def _rb_seal(self, key: tuple, g: _ReadGroup, full: bool = False) -> None:
+        """Start the group's D2H: one stacked transfer for FULL groups
+        (sealed during dispatch, off the event loop), bare per-handle
+        transfers for solo/partial ones (see _ReadGroup.loose)."""
         self._rb_open.pop(key, None)
         handles = g.handles
         assert handles is not None
-        g.handles = None
         if len(handles) == 1:
-            # No stack needed — and crucially no per-(count,shape) XLA
-            # compile on the stale-seal path, which runs on the service
-            # event loop.
             g.solo = True
             g.stacked = handles[0]
-        else:
+            g.handles = None
+        elif full:
+            g.handles = None
             fkey = (len(handles),) + key
             fn = self._stack_fns.get(fkey)
             if fn is None:
                 fn = jax.jit(lambda *xs: jnp.stack(xs))
                 self._stack_fns[fkey] = fn
             g.stacked = fn(*handles)
+        else:
+            g.loose = True
+            for h in handles:
+                try:
+                    h.copy_to_host_async()
+                except AttributeError:  # pragma: no cover - non-Array
+                    pass
+            return
         try:
             g.stacked.copy_to_host_async()
         except AttributeError:  # pragma: no cover - non-Array types
@@ -337,6 +351,9 @@ class TpuEngine(Engine):
     def _handle_ready(h: Any) -> bool:
         if isinstance(h, _GroupSlot):
             g = h.group
+            if g.loose:
+                assert g.handles is not None
+                return g.handles[h.idx].is_ready()
             return g.stacked is not None and g.stacked.is_ready()
         return h.is_ready()
 
@@ -344,6 +361,9 @@ class TpuEngine(Engine):
     def _materialize(h: Any) -> np.ndarray:
         if isinstance(h, _GroupSlot):
             g = h.group
+            if g.loose:
+                assert g.handles is not None
+                return np.asarray(g.handles[h.idx])
             if g.host is None:
                 g.host = np.asarray(g.stacked)
             return g.host if g.solo else g.host[h.idx]

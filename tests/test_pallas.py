@@ -1,17 +1,28 @@
-"""Pallas block-best kernel (engine/pallas_kernels.py) vs the XLA path —
-identical candidate lists (same block geometry, same first-index tie rule),
-same engine-level matches. Runs in interpret mode on the CPU test mesh."""
+"""Pallas block-best kernel (engine/pallas_kernels.py) vs the XLA hot path —
+identical candidate lists (same block geometry, same first-index tie rule).
+Runs in interpret mode on the CPU test mesh.
+
+The kernel is a pinned REFERENCE implementation, not a production code
+path: measured on v5e (round 2) it ties the fused XLA scan, and its
+separate admit pass cannot clear the ≥15% bar that would justify a second
+production implementation of the hot op, so the ``use_pallas`` gate was
+removed in round 4. These tests keep the kernel exactly equivalent so it
+stays a valid starting point for chip generations where a hand-tiled
+kernel DOES win.
+"""
 
 import numpy as np
 import pytest
 
 import jax.numpy as jnp
 
-from matchmaking_tpu.config import Config, EngineConfig, QueueConfig
 from matchmaking_tpu.core.pool import PlayerPool
-from matchmaking_tpu.engine.interface import make_engine
 from matchmaking_tpu.engine.kernels import KernelSet, _effective_threshold
-from matchmaking_tpu.service.contract import SearchRequest
+from matchmaking_tpu.engine.pallas_kernels import (
+    pack_batch_rows,
+    pack_pool_rows,
+    pallas_block_best,
+)
 
 
 def _pool_arrays(rng, capacity, active_n, thr=100.0):
@@ -40,12 +51,24 @@ def _batch(rng, b, capacity, start_slot, thr=100.0):
     }
 
 
+def _pallas_candidates(ks: KernelSet, batch, q_thr_eff, pool, now):
+    """Drive the reference kernel with the KernelSet's geometry (interpret
+    mode — these tests run on CPU)."""
+    return pallas_block_best(
+        pack_pool_rows(pool), pack_batch_rows(batch, q_thr_eff), now,
+        super_blk=ks.pool_block, sub_blk=2048, b_tile=256,
+        capacity=ks.capacity, glicko2=ks.glicko2,
+        widen_per_sec=ks.widen_per_sec, max_threshold=ks.max_threshold,
+        interpret=True,
+    )
+
+
 @pytest.mark.parametrize("glicko2,widen", [(False, 0.0), (True, 0.0),
                                            (False, 7.0)])
 def test_pallas_matches_xla_candidates(rng, glicko2, widen):
     P, B = 1024, 64
     ks = KernelSet(capacity=P, top_k=8, pool_block=256, glicko2=glicko2,
-                   widen_per_sec=widen, max_threshold=300.0, use_pallas=True)
+                   widen_per_sec=widen, max_threshold=300.0)
     pool = _pool_arrays(rng, P, active_n=700)
     batch = _batch(rng, B, P, start_slot=700)
     now = jnp.float32(9.0)
@@ -53,7 +76,7 @@ def test_pallas_matches_xla_candidates(rng, glicko2, widen):
                                      now, widen, 300.0)
 
     xla_v, xla_i = ks._candidates(batch, q_thr_eff, pool, now)
-    pal_v, pal_i = ks._topk_pallas(batch, q_thr_eff, pool, now)
+    pal_v, pal_i = _pallas_candidates(ks, batch, q_thr_eff, pool, now)
 
     # Identical block geometry + identical tie rule ⇒ lists match exactly
     # (position by position), not just as sets.
@@ -64,48 +87,15 @@ def test_pallas_matches_xla_candidates(rng, glicko2, widen):
     np.testing.assert_allclose(x_v[finite], p_v[finite], rtol=0, atol=0)
 
 
-def test_pallas_engine_end_to_end_equivalence(rng):
-    """Full engine with use_pallas on vs off: identical matches on
-    tie-free inputs."""
-    ratings = (np.arange(120) * 7.3 + 1000.0)  # distinct, irregular spacing
-    rng.shuffle(ratings)
-
-    def run(use_pallas):
-        cfg = Config(
-            queues=(QueueConfig(rating_threshold=40.0),),
-            engine=EngineConfig(backend="tpu", pool_capacity=512,
-                                pool_block=128, batch_buckets=(16, 64),
-                                use_pallas=use_pallas),
-        )
-        eng = make_engine(cfg, cfg.queues[0])
-        pairs = []
-        for start in range(0, 120, 30):
-            reqs = [SearchRequest(id=f"p{start + j}",
-                                  rating=float(ratings[start + j]),
-                                  enqueued_at=0.0)
-                    for j in range(30)]
-            out = eng.search(reqs, now=1.0)
-            pairs.extend(
-                frozenset((m.teams[0][0].id, m.teams[1][0].id))
-                for m in out.matches)
-        return set(pairs), eng.pool_size()
-
-    pallas_pairs, pallas_n = run(True)
-    xla_pairs, xla_n = run(False)
-    assert pallas_pairs == xla_pairs
-    assert pallas_n == xla_n
-    assert len(pallas_pairs) > 10  # matches actually formed
-
-
 def test_pallas_small_buckets(rng):
     """Tiny buckets (B=16 < b_tile) and non-2048-divisible geometry."""
     P, B = 256, 16
     ks = KernelSet(capacity=P, top_k=4, pool_block=64, glicko2=False,
-                   widen_per_sec=0.0, max_threshold=400.0, use_pallas=True)
+                   widen_per_sec=0.0, max_threshold=400.0)
     pool = _pool_arrays(rng, P, active_n=100)
     batch = _batch(rng, B, P, start_slot=100)
     now = jnp.float32(1.0)
-    v, i = ks._topk_pallas(batch, batch["threshold"], pool, now)
+    v, i = _pallas_candidates(ks, batch, batch["threshold"], pool, now)
     assert v.shape == (B, 4) and i.shape == (B, 4)  # 4 blocks of 64
     xv, xi = ks._candidates(batch, batch["threshold"], pool, now)
     np.testing.assert_array_equal(np.asarray(xi), np.asarray(i))
