@@ -574,13 +574,19 @@ def main() -> None:
     p.add_argument("--profile-dir", default="",
                    help="write a jax.profiler trace of the measured phase "
                         "(view with tensorboard/xprof)")
-    p.add_argument("--depth", type=int, default=4,
-                   help="max in-flight windows (pipelining hides device RTT)")
-    p.add_argument("--readback-group", type=int, default=1,
+    p.add_argument("--depth", type=int, default=8,
+                   help="max in-flight windows. MUST be >= readback-group "
+                        "for groups to fill before the depth gate blocks; "
+                        "2x readback-group lets the next group's compute "
+                        "overlap the current group's transfer "
+                        "(BENCH_SWEEP.md §3)")
+    p.add_argument("--readback-group", type=int, default=4,
                    help="stack k windows' results on device and transfer "
-                        "them as ONE D2H (the tunnel serializes transfers "
-                        "at ~12-14/s; grouping multiplies result "
-                        "throughput per transfer slot)")
+                        "them as ONE D2H. The tunnel's transfers are "
+                        "latency-bound (~70 ms for 32 B or 24 KB alike) "
+                        "and serialize at ~12-14/s, so grouping "
+                        "multiplies result throughput ~k per transfer "
+                        "slot (BENCH_SWEEP.md §3)")
     p.add_argument("--cpu-pool", type=int, default=2000,
                    help="CPU-oracle pool size (the reference's ~cap)")
     p.add_argument("--cpu-windows", type=int, default=20)
@@ -599,6 +605,10 @@ def main() -> None:
     p.add_argument("--e2e-seconds", type=float, default=6.0,
                    help="e2e phase duration")
     args = p.parse_args()
+    if args.depth < args.readback_group:
+        log(f"[warn] depth {args.depth} < readback-group "
+            f"{args.readback_group}: groups can never fill before the "
+            f"depth gate blocks; grouping degrades to loose partial seals")
 
     devices = init_backend(attempts=args.init_retries, delay_s=args.init_delay)
     if devices is None:

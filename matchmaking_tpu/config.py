@@ -199,6 +199,18 @@ class Config:
         ):
             if name in d:
                 sub = dict(d[name])
+                # Tolerate unknown/removed keys (e.g. engine.use_pallas,
+                # removed round 4) instead of failing the whole boot: a
+                # config written for an older build should degrade to a
+                # warning, not a TypeError at startup.
+                known = {f.name for f in dataclasses.fields(cls)}
+                for extra in [k for k in sub if k not in known]:
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "config: ignoring unknown %s.%s (removed or "
+                        "misspelled)", name, extra)
+                    del sub[extra]
                 for f in dataclasses.fields(cls):
                     if f.name in sub and isinstance(sub[f.name], list):
                         sub[f.name] = tuple(sub[f.name])

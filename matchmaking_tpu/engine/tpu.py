@@ -56,6 +56,14 @@ from matchmaking_tpu.service.contract import (
 logger = logging.getLogger(__name__)
 
 
+def _copy_async(h: Any) -> None:
+    """Queue an async D2H for one device array (no-op for non-Arrays)."""
+    try:
+        h.copy_to_host_async()
+    except AttributeError:  # pragma: no cover - non-Array types
+        pass
+
+
 @dataclass
 class _ReadGroup:
     """K windows' result arrays awaiting ONE device→host transfer.
@@ -72,15 +80,13 @@ class _ReadGroup:
     created: float
     stacked: Any = None
     host: np.ndarray | None = None
-    #: Single-window group (stale seal during a lull): ``stacked`` is the
-    #: bare result array, not a stack — no jitted stack, no extra compile.
-    solo: bool = False
     #: Partial group sealed loose (stale/flush): handles transfer
     #: individually, NO device stack — the jitted stack would compile per
     #: (count, shape) and stale seals run on the service EVENT LOOP, where
     #: a first-time XLA compile freezes every queue. Loose seals happen in
     #: lulls/flushes where transfer serialization doesn't matter anyway;
-    #: only FULL groups (sealed during dispatch, off-loop) use the stack.
+    #: only FULL multi-window groups (sealed during dispatch, off-loop)
+    #: use the stack.
     loose: bool = False
 
 
@@ -283,10 +289,7 @@ class TpuEngine(Engine):
         else:
             for chunk in pending.chunks:
                 for h in chunk[1]:
-                    try:
-                        h.copy_to_host_async()
-                    except AttributeError:  # pragma: no cover - non-Array
-                        pass
+                    _copy_async(h)
         self._open += 1
         self._pending.append(pending)
 
@@ -306,17 +309,13 @@ class TpuEngine(Engine):
         return slot
 
     def _rb_seal(self, key: tuple, g: _ReadGroup, full: bool = False) -> None:
-        """Start the group's D2H: one stacked transfer for FULL groups
-        (sealed during dispatch, off the event loop), bare per-handle
-        transfers for solo/partial ones (see _ReadGroup.loose)."""
+        """Start the group's D2H: one stacked transfer for FULL multi-window
+        groups (sealed during dispatch, off the event loop), bare per-handle
+        transfers otherwise (see _ReadGroup.loose)."""
         self._rb_open.pop(key, None)
         handles = g.handles
         assert handles is not None
-        if len(handles) == 1:
-            g.solo = True
-            g.stacked = handles[0]
-            g.handles = None
-        elif full:
+        if full and len(handles) > 1:
             g.handles = None
             fkey = (len(handles),) + key
             fn = self._stack_fns.get(fkey)
@@ -324,18 +323,11 @@ class TpuEngine(Engine):
                 fn = jax.jit(lambda *xs: jnp.stack(xs))
                 self._stack_fns[fkey] = fn
             g.stacked = fn(*handles)
+            _copy_async(g.stacked)
         else:
             g.loose = True
             for h in handles:
-                try:
-                    h.copy_to_host_async()
-                except AttributeError:  # pragma: no cover - non-Array
-                    pass
-            return
-        try:
-            g.stacked.copy_to_host_async()
-        except AttributeError:  # pragma: no cover - non-Array types
-            pass
+                _copy_async(h)
 
     def _rb_seal_stale(self, force: bool = False) -> None:
         """Seal partial groups that have waited past the wait budget (or
@@ -366,7 +358,7 @@ class TpuEngine(Engine):
                 return np.asarray(g.handles[h.idx])
             if g.host is None:
                 g.host = np.asarray(g.stacked)
-            return g.host if g.solo else g.host[h.idx]
+            return g.host[h.idx]
         return np.asarray(h)
 
     def _is_ready(self, pending: _Pending) -> bool:
