@@ -73,6 +73,19 @@ def _load() -> ctypes.CDLL | None:
                 np.ctypeslib.ndpointer(np.int64),         # region_off
                 np.ctypeslib.ndpointer(np.int64),         # mode_off
             ]
+            lib.mm_encode_matched.restype = ctypes.c_int64
+            lib.mm_encode_matched.argtypes = [
+                ctypes.POINTER(ctypes.c_char_p),          # id_a
+                ctypes.POINTER(ctypes.c_char_p),          # id_b
+                ctypes.POINTER(ctypes.c_char_p),          # match_id
+                ctypes.c_int32,                           # n
+                np.ctypeslib.ndpointer(np.float64),       # lat_a
+                np.ctypeslib.ndpointer(np.float64),       # lat_b
+                np.ctypeslib.ndpointer(np.float64),       # quality
+                ctypes.c_char_p,                          # arena
+                ctypes.c_int64,                           # cap
+                np.ctypeslib.ndpointer(np.int64),         # off
+            ]
             _lib = lib
         except Exception:
             log.exception("native codec unavailable; using pure-Python decode")
@@ -128,3 +141,52 @@ def decode_batch(bodies: list[bytes]):
 
 def error_code(status: int) -> str:
     return _ERROR_CODES.get(int(status), "bad_json")
+
+
+def encode_matched_batch(ids_a, ids_b, match_ids, lat_a_ms, lat_b_ms,
+                         quality):
+    """Encode 2n matched-response bodies natively (a0, b0, a1, b1, ...).
+
+    Inputs are sequences of str (ids) and float64 arrays (latencies in ms,
+    match quality). Returns a list of 2n ``bytes`` bodies matching
+    ``contract.encode_response``'s schema (parsed-value equivalence pinned
+    by tests/test_native_codec.py), or None when the native library is
+    unavailable — callers fall back to the Python encoder.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(match_ids)
+    if n == 0:
+        return []
+    lat_a_ms = np.ascontiguousarray(lat_a_ms, np.float64)
+    lat_b_ms = np.ascontiguousarray(lat_b_ms, np.float64)
+    quality = np.ascontiguousarray(quality, np.float64)
+    if not (np.isfinite(lat_a_ms).all() and np.isfinite(lat_b_ms).all()
+            and np.isfinite(quality).all()):
+        return None  # NaN/inf are not strict JSON; Python encoder handles
+    a_bytes = [s.encode() for s in ids_a]
+    b_bytes = [s.encode() for s in ids_b]
+    m_bytes = [s.encode() for s in match_ids]
+    if any(b"\x00" in s for s in a_bytes) or any(b"\x00" in s for s in b_bytes):
+        # c_char_p is NUL-terminated: an embedded NUL in an id would be
+        # silently truncated, corrupting the body AND its dedup-replay
+        # copy. Pathological ids take the Python encoder.
+        return None
+    a_ptrs = (ctypes.c_char_p * n)(*a_bytes)
+    b_ptrs = (ctypes.c_char_p * n)(*b_bytes)
+    m_ptrs = (ctypes.c_char_p * n)(*m_bytes)
+    lat_a, lat_b, qual = lat_a_ms, lat_b_ms, quality
+    off = np.empty(2 * n + 1, np.int64)
+    # Fixed part ≈ 120 B/response + 4 id copies + match id; escapes can at
+    # worst 6x a string, hence the generous per-row bound with retry.
+    cap = 256 * 2 * n + 8 * sum(len(s) for s in a_bytes + b_bytes + m_bytes)
+    for _ in range(2):
+        arena = ctypes.create_string_buffer(cap)
+        used = lib.mm_encode_matched(a_ptrs, b_ptrs, m_ptrs, n, lat_a, lat_b,
+                                     qual, arena, cap, off)
+        if used >= 0:
+            raw = arena.raw
+            return [raw[off[j]:off[j + 1]] for j in range(2 * n)]
+        cap *= 4
+    return None  # pragma: no cover - bound above cannot be exceeded twice

@@ -85,8 +85,11 @@ class _QueueRuntime:
         self._needs_revive = False
         if self._pipelined:
             self._collector = asyncio.create_task(self._collector_loop())
-        # At-least-once dedup: player id → (terminal SearchResponse, expiry).
-        self._recent: dict[str, tuple[SearchResponse, float]] = {}
+        # At-least-once dedup: player id → (encoded terminal response BODY,
+        # expiry). Bytes, not SearchResponse: the body is built exactly once
+        # (possibly by the native batch encoder) and replays publish it
+        # verbatim — a player always sees a self-consistent response.
+        self._recent: dict[str, tuple[bytes, float]] = {}
         self._next_prune = 0.0
         self.consumer_tag = app.broker.basic_consume(
             queue_cfg.name, self._on_delivery, prefetch=app.cfg.broker.prefetch
@@ -142,7 +145,7 @@ class _QueueRuntime:
                 cached = None
             if cached is not None:
                 self.app.metrics.counters.inc("deduped_replays")
-                self._respond(req, cached[0])
+                self._publish_body(req.reply_to, req.correlation_id, cached[0])
                 self.app.broker.ack(self.consumer_tag, delivery.delivery_tag)
             else:
                 fresh.append((req, delivery))
@@ -264,8 +267,9 @@ class _QueueRuntime:
                 cached = None
             if cached is not None:
                 self.app.metrics.counters.inc("deduped_replays")
-                self._respond_raw(delivery.properties.reply_to,
-                                  delivery.properties.correlation_id, cached[0])
+                self._publish_body(delivery.properties.reply_to,
+                                   delivery.properties.correlation_id,
+                                   cached[0])
                 self.app.broker.ack(self.consumer_tag, delivery.delivery_tag)
                 continue
             lanes.append(row)
@@ -515,12 +519,48 @@ class _QueueRuntime:
 
     def _publish_columnar_matches(self, out, now: float) -> None:
         """Matched responses for one ColumnarOutcome (window flush AND
-        rescan both come through here)."""
+        rescan both come through here). Bodies are built by the native
+        batch encoder when available (one C call per window — at grouped-
+        readback match rates the per-response dict+json.dumps is the
+        service's next hot loop); the Python path is the fallback and the
+        semantic source of truth (parsed-value equivalence pinned by
+        tests/test_native_codec.py)."""
+        import numpy as np
+
+        from matchmaking_tpu.native import codec
         from matchmaking_tpu.service.contract import MatchResult
 
         if self._invariants is not None:
             self._invariants.observe_outcome(out)
-        for j in range(out.n_matches):
+        n = out.n_matches
+        if n == 0:
+            return
+        bodies = None
+        if codec.available():
+            lat_a = np.where(out.m_enq_a != 0.0, (now - out.m_enq_a) * 1e3, 0.0)
+            lat_b = np.where(out.m_enq_b != 0.0, (now - out.m_enq_b) * 1e3, 0.0)
+            bodies = codec.encode_matched_batch(
+                out.m_id_a.tolist(), out.m_id_b.tolist(),
+                out.m_match_id.tolist(), lat_a, lat_b,
+                out.m_quality.astype(np.float64))
+        if bodies is not None:
+            m = self.app.metrics
+            m.counters.inc("players_matched", 2 * n)
+            rec = m.latency["match_wait"]
+            for enq in (out.m_enq_a, out.m_enq_b):
+                for w in (now - enq[enq != 0.0]).tolist():
+                    rec.record(w)
+            ids_a, ids_b = out.m_id_a.tolist(), out.m_id_b.tolist()
+            reply_a, reply_b = out.m_reply_a.tolist(), out.m_reply_b.tolist()
+            corr_a, corr_b = out.m_corr_a.tolist(), out.m_corr_b.tolist()
+            for j in range(n):
+                body_a, body_b = bodies[2 * j], bodies[2 * j + 1]
+                self._remember(ids_a[j], body_a, now)
+                self._remember(ids_b[j], body_b, now)
+                self._publish_body(reply_a[j], corr_a[j], body_a)
+                self._publish_body(reply_b[j], corr_b[j], body_b)
+            return
+        for j in range(n):
             id_a, id_b = out.m_id_a[j], out.m_id_b[j]
             result = MatchResult(
                 match_id=out.m_match_id[j], players=(id_a, id_b),
@@ -535,23 +575,30 @@ class _QueueRuntime:
     def _publish_matched(self, pid: str, reply_to: str, correlation_id: str,
                          enqueued_at: float, result, now: float) -> None:
         """One matched player's response + metrics + dedup memory — the
-        single place the 'matched' response is built (object AND columnar
-        flush paths both come through here; keep them from diverging)."""
+        slow-path builder (object flush; the columnar flush uses the native
+        batch encoder when available and only falls back here)."""
         m = self.app.metrics
         m.counters.inc("players_matched")
         if enqueued_at:
             m.record_latency("match_wait", now - enqueued_at)
-        resp = SearchResponse(
+        body = encode_response(SearchResponse(
             status="matched", player_id=pid, match=result,
-            latency_ms=(now - enqueued_at) * 1e3 if enqueued_at else 0.0)
-        self._remember(pid, resp, now)
-        self._respond_raw(reply_to, correlation_id, resp)
+            latency_ms=(now - enqueued_at) * 1e3 if enqueued_at else 0.0))
+        self._remember(pid, body, now)
+        self._publish_body(reply_to, correlation_id, body)
 
     def _respond_raw(self, reply_to: str, correlation_id: str,
                      resp: SearchResponse) -> None:
         if not reply_to:
             return
         self.app.broker.publish(reply_to, encode_response(resp),
+                                Properties(correlation_id=correlation_id))
+
+    def _publish_body(self, reply_to: str, correlation_id: str,
+                      body: bytes) -> None:
+        if not reply_to:
+            return
+        self.app.broker.publish(reply_to, body,
                                 Properties(correlation_id=correlation_id))
 
     def _revive_engine(self, now: float) -> None:
@@ -601,12 +648,13 @@ class _QueueRuntime:
                 error_reason=f"engine rejected request: {code}",
             ))
         for req in outcome.timed_out:
-            resp = SearchResponse(status="timeout", player_id=req.id)
-            self._remember(req.id, resp, now)
-            self._respond(req, resp)
+            body = encode_response(SearchResponse(status="timeout",
+                                                  player_id=req.id))
+            self._remember(req.id, body, now)
+            self._publish_body(req.reply_to, req.correlation_id, body)
 
-    def _remember(self, player_id: str, resp: SearchResponse, now: float) -> None:
-        self._recent[player_id] = (resp, now + self.queue_cfg.dedup_ttl_s)
+    def _remember(self, player_id: str, body: bytes, now: float) -> None:
+        self._recent[player_id] = (body, now + self.queue_cfg.dedup_ttl_s)
 
     def _prune_recent(self, now: float) -> None:
         # Time-throttled: a full-dict rebuild on every window would be O(n)
@@ -716,12 +764,13 @@ class _QueueRuntime:
                 continue
             for removed in expired:
                 self.app.metrics.counters.inc("timeouts")
-                resp = SearchResponse(
+                body = encode_response(SearchResponse(
                     status="timeout", player_id=removed.id,
                     latency_ms=(now - removed.enqueued_at) * 1e3,
-                )
-                self._remember(removed.id, resp, now)
-                self._respond(removed, resp)
+                ))
+                self._remember(removed.id, body, now)
+                self._publish_body(removed.reply_to, removed.correlation_id,
+                                   body)
 
     async def close(self) -> None:
         if self._sweeper is not None:

@@ -14,6 +14,7 @@
 
 #include <cctype>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <cstdlib>
 #include <cmath>
@@ -357,6 +358,146 @@ int64_t mm_decode_requests(const char** bufs, const int32_t* lens, int32_t n,
   region_off[n] = used;  // unused; kept for symmetric shape
   mode_off[n] = used;
   return used;
+}
+
+}  // extern "C"
+
+// ---- batch matched-response encoder ---------------------------------------
+//
+// The egress twin of mm_decode_requests: one call builds the JSON bodies for
+// BOTH players of a window of matches (2 responses per match — at grouped-
+// readback match rates the per-response Python dict+json.dumps becomes the
+// service's next hot loop). Matches contract.encode_response's schema and
+// key order:
+//   {"status":"matched","player_id":P,"latency_ms":L,
+//    "match":{"match_id":M,"players":[A,B],"teams":[[A],[B]],"quality":Q}}
+// Float formatting: trailing-zero-stripped fixed decimals (3 for latency,
+// 6 for quality). Python emits repr(round(x, k)) which prints the shortest
+// digits; the two agree on the PARSED value (pinned by tests) though not
+// always byte-for-byte (e.g. "1.500"→"1.5" both ways, but Python can print
+// "0.1" where fixed gives "0.100000"→"0.1"). Replay caches store the
+// encoded bytes, so a player always sees a self-consistent body.
+
+namespace {
+
+// Escape one UTF-8 string into JSON (quotes added by caller's context).
+// Returns bytes written or -1 on overflow. Control chars use \u00XX.
+int64_t esc_json(const char* s, char* out, int64_t cap) {
+  static const char* hex = "0123456789abcdef";
+  int64_t w = 0;
+  for (const char* p = s; *p; ++p) {
+    unsigned char ch = (unsigned char)*p;
+    if (ch == '"' || ch == '\\') {
+      if (w + 2 > cap) return -1;
+      out[w++] = '\\'; out[w++] = (char)ch;
+    } else if (ch < 0x20) {
+      if (ch == '\n' || ch == '\t' || ch == '\r' || ch == '\b' || ch == '\f') {
+        if (w + 2 > cap) return -1;
+        out[w++] = '\\';
+        out[w++] = ch == '\n' ? 'n' : ch == '\t' ? 't' : ch == '\r' ? 'r'
+                   : ch == '\b' ? 'b' : 'f';
+      } else {
+        if (w + 6 > cap) return -1;
+        out[w++] = '\\'; out[w++] = 'u'; out[w++] = '0'; out[w++] = '0';
+        out[w++] = hex[ch >> 4]; out[w++] = hex[ch & 15];
+      }
+    } else {
+      if (w + 1 > cap) return -1;
+      out[w++] = (char)ch;  // UTF-8 bytes pass through (json allows raw)
+    }
+  }
+  return w;
+}
+
+// Fixed-decimal float with trailing zeros stripped (keeps >=1 fractional
+// digit so the JSON value stays a float, like Python's "0.0").
+int64_t fmt_float(double v, int decimals, char* out, int64_t cap) {
+  if (!std::isfinite(v)) return -1;  // "nan"/"inf" are not JSON; caller
+                                     // falls back to the Python encoder
+  char buf[64];
+  int len = snprintf(buf, sizeof buf, "%.*f", decimals, v);
+  if (len <= 0 || len >= (int)sizeof buf) return -1;
+  const char* dot = strchr(buf, '.');
+  if (dot) {
+    while (len > 0 && buf[len - 1] == '0') --len;
+    if (len > 0 && buf[len - 1] == '.') ++len;  // keep "x.0"
+  }
+  if (len > cap) return -1;
+  memcpy(out, buf, len);
+  return len;
+}
+
+struct Writer {
+  char* out;
+  int64_t cap;
+  int64_t w = 0;
+  bool ok = true;
+
+  void lit(const char* s) {
+    int64_t n = (int64_t)strlen(s);
+    if (!ok || w + n > cap) { ok = false; return; }
+    memcpy(out + w, s, n); w += n;
+  }
+  void str(const char* s) {
+    if (!ok || w + 1 > cap) { ok = false; return; }
+    out[w++] = '"';
+    int64_t n = esc_json(s, out + w, cap - w);
+    if (n < 0) { ok = false; return; }
+    w += n;
+    if (w + 1 > cap) { ok = false; return; }
+    out[w++] = '"';
+  }
+  void num(double v, int decimals) {
+    if (!ok) return;
+    int64_t n = fmt_float(v, decimals, out + w, cap - w);
+    if (n < 0) { ok = false; return; }
+    w += n;
+  }
+};
+
+void encode_one_matched(Writer& wr, const char* pid, const char* mid,
+                        const char* a, const char* b, double lat_ms,
+                        double quality) {
+  wr.lit("{\"status\":\"matched\",\"player_id\":");
+  wr.str(pid);
+  wr.lit(",\"latency_ms\":");
+  wr.num(lat_ms, 3);
+  wr.lit(",\"match\":{\"match_id\":");
+  wr.str(mid);
+  wr.lit(",\"players\":[");
+  wr.str(a); wr.lit(","); wr.str(b);
+  wr.lit("],\"teams\":[[");
+  wr.str(a); wr.lit("],["); wr.str(b);
+  wr.lit("]],\"quality\":");
+  wr.num(quality, 6);
+  wr.lit("}}");
+}
+
+}  // namespace
+
+extern "C" {
+
+// Encode 2n matched responses (players a and b of n matches) into `arena`;
+// body j spans arena[off[j] .. off[j+1]) with order a0,b0,a1,b1,...
+// Returns bytes used, or -1 if the arena overflowed (caller retries
+// bigger). Strings are NUL-terminated UTF-8.
+int64_t mm_encode_matched(const char** id_a, const char** id_b,
+                          const char** match_id, int32_t n,
+                          const double* lat_a, const double* lat_b,
+                          const double* quality,
+                          char* arena, int64_t cap, int64_t* off) {
+  Writer wr{arena, cap};
+  for (int32_t i = 0; i < n; ++i) {
+    off[2 * i] = wr.w;
+    encode_one_matched(wr, id_a[i], match_id[i], id_a[i], id_b[i],
+                       lat_a[i], quality[i]);
+    off[2 * i + 1] = wr.w;
+    encode_one_matched(wr, id_b[i], match_id[i], id_a[i], id_b[i],
+                       lat_b[i], quality[i]);
+    if (!wr.ok) return -1;
+  }
+  off[2 * n] = wr.w;
+  return wr.w;
 }
 
 }  // extern "C"

@@ -171,3 +171,81 @@ class TestAgainstPythonDecoder:
             assert (regions[i] or ANY) == py.region
             assert (modes[i] or ANY) == py.game_mode
         assert n_ok >= 250  # fast path covers the overwhelming majority
+
+
+class TestNativeEncoder:
+    """Batch matched-response encoder vs contract.encode_response: parsed-
+    value equivalence (byte formats may differ in trailing float zeros)."""
+
+    def test_parsed_equivalence_varied(self):
+        import json
+
+        import numpy as np
+
+        from matchmaking_tpu.service.contract import (
+            MatchResult,
+            SearchResponse,
+            encode_response,
+        )
+
+        if not codec.available():
+            import pytest
+
+            pytest.skip("native codec unavailable")
+        ids_a = ["alice", 'q"uote', "back\\slash", "unié", "tab\there"]
+        ids_b = ["bob", "b2", "b3", "b4", "b5"]
+        mids = [f"m{i}" for i in range(5)]
+        lat_a = np.array([12.3456, 0.0, 0.00004, 1.5, 99999.999])
+        lat_b = np.array([1.0, 2.25, 3.875, 0.125, 7.0])
+        qual = np.array([0.987654321, 1.0, 0.0, 0.5, 0.333333333])
+        bodies = codec.encode_matched_batch(ids_a, ids_b, mids, lat_a, lat_b,
+                                            qual)
+        assert bodies is not None and len(bodies) == 10
+        for i in range(5):
+            for side, (pid, lat) in enumerate(((ids_a[i], lat_a[i]),
+                                               (ids_b[i], lat_b[i]))):
+                native = json.loads(bodies[2 * i + side])
+                py = json.loads(encode_response(SearchResponse(
+                    status="matched", player_id=pid,
+                    latency_ms=round(float(lat), 3),
+                    match=MatchResult(match_id=mids[i],
+                                      players=(ids_a[i], ids_b[i]),
+                                      teams=((ids_a[i],), (ids_b[i],)),
+                                      quality=float(qual[i])))))
+                assert native["status"] == py["status"] == "matched"
+                assert native["player_id"] == py["player_id"]
+                assert abs(native["latency_ms"] - py["latency_ms"]) < 5e-4
+                nm, pm = native["match"], py["match"]
+                assert nm["match_id"] == pm["match_id"]
+                assert nm["players"] == pm["players"]
+                assert nm["teams"] == pm["teams"]
+                assert abs(nm["quality"] - pm["quality"]) < 5e-7
+
+    def test_empty_batch(self):
+        if not codec.available():
+            import pytest
+
+            pytest.skip("native codec unavailable")
+        assert codec.encode_matched_batch([], [], [],
+                                          [], [], []) == []
+
+    def test_nul_and_nonfinite_fall_back_to_python(self):
+        import numpy as np
+
+        if not codec.available():
+            import pytest
+
+            pytest.skip("native codec unavailable")
+        # Embedded NUL in an id: c_char_p would truncate -> must refuse.
+        assert codec.encode_matched_batch(
+            ["a\x00b"], ["bob"], ["m1"],
+            np.array([1.0]), np.array([1.0]), np.array([0.5])) is None
+        # Non-finite floats are not strict JSON -> must refuse.
+        assert codec.encode_matched_batch(
+            ["a"], ["b"], ["m1"],
+            np.array([float("nan")]), np.array([1.0]),
+            np.array([0.5])) is None
+        assert codec.encode_matched_batch(
+            ["a"], ["b"], ["m1"],
+            np.array([1.0]), np.array([1.0]),
+            np.array([float("inf")])) is None
