@@ -468,6 +468,16 @@ def bench_e2e(args) -> dict:
 
         app.broker.basic_consume(reply_q, on_reply, prefetch=1_000_000)
 
+        def quiet() -> bool:
+            # Drained = nothing buffered at ANY stage: broker queue, the
+            # batcher's open window, a flush in progress (covers the
+            # first-window jit compile, during which batcher.depth AND
+            # engine.inflight() both read 0), or windows on device.
+            return (app.broker.queue_depth(cfg.broker.request_queue) == 0
+                    and rt.batcher.depth == 0
+                    and rt._flushing == 0
+                    and rt.engine.inflight() == 0)
+
         # Warmup: compile every bucket shape outside the measured phase.
         wrng = np.random.default_rng(4)
         for k, burst in enumerate((8, 40, 160, args.window)):
@@ -481,8 +491,7 @@ def bench_e2e(args) -> dict:
                                         f"{time.time():.6f}"}))
             for _ in range(2400):
                 await asyncio.sleep(0.025)
-                if (app.broker.queue_depth(cfg.broker.request_queue) == 0
-                        and rt.engine.inflight() == 0):
+                if quiet():
                     break
         lat_ms.clear()
         log("[e2e] buckets warm; starting measured Poisson phase")
@@ -516,8 +525,7 @@ def bench_e2e(args) -> dict:
         # Drain: give in-flight windows + replies time to land.
         for _ in range(400):
             await asyncio.sleep(0.025)
-            if (app.broker.queue_depth(cfg.broker.request_queue) == 0
-                    and rt.engine.inflight() == 0):
+            if quiet():
                 break
         matched = len(lat_ms)
         pool_end = rt.engine.pool_size()

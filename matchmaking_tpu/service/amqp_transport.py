@@ -190,9 +190,13 @@ class AmqpBroker:
 
     def basic_consume(self, queue: str,
                       callback: Callable[[Delivery], Awaitable[None]],
-                      prefetch: int | None = None) -> str:
+                      prefetch: int | None = None,
+                      batch_hint: bool = False) -> str:
         """Start a supervised consumer (dedicated connection + thread) for
-        ``queue`` and bridge deliveries into the service event loop."""
+        ``queue`` and bridge deliveries into the service event loop.
+        ``batch_hint`` is accepted for interface parity with InProcBroker
+        and ignored: pika already delivers from its own IO thread and the
+        loop bridge is the batching boundary here."""
         tag = f"ctag-{uuid.uuid4().hex[:8]}"
         consumer = _Consumer(queue, callback, prefetch or self._prefetch)
         self._consumers[tag] = consumer

@@ -83,6 +83,11 @@ class _QueueRuntime:
         self._collector: asyncio.Task | None = None
         #: A collected window failed on device; revive once in-flight drains.
         self._needs_revive = False
+        #: Windows currently inside a flush (decode → dispatch → [inline
+        #: handling]); engine.inflight() only counts DISPATCHED windows, so
+        #: during a long first-window compile both it and batcher.depth read
+        #: 0 — drain/quiesce checks must consult this too.
+        self._flushing = 0
         if self._pipelined:
             self._collector = asyncio.create_task(self._collector_loop())
         # At-least-once dedup: player id → (encoded terminal response BODY,
@@ -91,8 +96,15 @@ class _QueueRuntime:
         # verbatim — a player always sees a self-consistent response.
         self._recent: dict[str, tuple[bytes, float]] = {}
         self._next_prune = 0.0
+        # batch_hint: _on_delivery is non-blocking for auth modes other
+        # than "rpc" (decode defers to the batched codec; static/none auth
+        # never awaits), so the broker may drain bursts into one handler
+        # task. RPC auth keeps per-delivery tasks — its round trips must
+        # overlap up to prefetch (the GenServer-pool parallelism analog).
         self.consumer_tag = app.broker.basic_consume(
-            queue_cfg.name, self._on_delivery, prefetch=app.cfg.broker.prefetch
+            queue_cfg.name, self._on_delivery,
+            prefetch=app.cfg.broker.prefetch,
+            batch_hint=app.cfg.auth.mode != "rpc",
         )
         self._sweeper: asyncio.Task | None = None
         if queue_cfg.request_timeout_s is not None:
@@ -129,6 +141,13 @@ class _QueueRuntime:
     # ---- the window flush: THE seam into Engine.search --------------------
 
     async def _flush(self, window: list[tuple[SearchRequest, Delivery]]) -> None:
+        self._flushing += 1
+        try:
+            await self._flush_inner(window)
+        finally:
+            self._flushing -= 1
+
+    async def _flush_inner(self, window: list[tuple[SearchRequest, Delivery]]) -> None:
         if self._columnar:
             await self._flush_columnar([d for _, d in window])
             return
