@@ -469,11 +469,15 @@ def bench_e2e(args) -> dict:
         app.broker.basic_consume(reply_q, on_reply, prefetch=1_000_000)
 
         def quiet() -> bool:
-            # Drained = nothing buffered at ANY stage: broker queue, the
-            # batcher's open window, a flush in progress (covers the
+            # Drained = nothing buffered at ANY stage: broker queues
+            # (request AND reply), handler tasks (deliveries inside a
+            # created-but-unstarted handler are invisible to queue_depth),
+            # the batcher's open window, a flush in progress (covers the
             # first-window jit compile, during which batcher.depth AND
             # engine.inflight() both read 0), or windows on device.
             return (app.broker.queue_depth(cfg.broker.request_queue) == 0
+                    and app.broker.queue_depth(reply_q) == 0
+                    and app.broker.handlers_idle()
                     and rt.batcher.depth == 0
                     and rt._flushing == 0
                     and rt.engine.inflight() == 0)

@@ -58,6 +58,20 @@ class _Queue:
         self.consumers: list["_Consumer"] = []
 
 
+class _BatchState:
+    """One handler task's deliveries + progress, registered BEFORE the task
+    is created so ``cancel()`` can requeue them even if asyncio cancels the
+    task before its first step (a never-started coroutine's try/finally
+    never runs — relying on the task body alone LOSES the whole batch)."""
+
+    __slots__ = ("batch", "i", "current")
+
+    def __init__(self, batch: list[Delivery]):
+        self.batch = batch
+        self.i = 0                       # next index to start
+        self.current: Delivery | None = None  # in-flight delivery, if any
+
+
 class _Consumer:
     def __init__(self, broker: "InProcBroker", queue: _Queue,
                  callback: Callable[[Delivery], Awaitable[None]], prefetch: int,
@@ -78,6 +92,7 @@ class _Consumer:
         #: GenServer-pool parallelism (SURVEY.md §2).
         self.batch_hint = batch_hint
         self._cancel_requeued: set[int] = set()
+        self._batch_states: set[_BatchState] = set()
         self._free = self.prefetch
         self._free_ev = asyncio.Event()
         self._handlers: set[asyncio.Task] = set()
@@ -117,41 +132,50 @@ class _Consumer:
                 self.queue.messages.put_nowait(delivery)
                 self._release()
                 return
+            batch = [delivery]
             if self.batch_hint:
-                batch = [delivery]
                 while (len(batch) < 256
                        and not self.queue.messages.empty()
                        and self._try_acquire()):
                     batch.append(self.queue.messages.get_nowait())
-                task = asyncio.create_task(self._handle_batch(batch))
-            else:
-                task = asyncio.create_task(self._handle(delivery))
+            # Register BEFORE create_task: cancel() must see these
+            # deliveries even if the task is cancelled before it ever runs.
+            state = _BatchState(batch)
+            self._batch_states.add(state)
+            task = asyncio.create_task(self._handle_batch(state))
             self._handlers.add(task)
             task.add_done_callback(self._handlers.discard)
 
-    async def _handle_batch(self, batch: list[Delivery]) -> None:
-        # Cancellation mid-batch must not LOSE deliveries (at-least-once):
-        # unstarted ones are requeued here; the in-flight one is requeued by
-        # cancel()'s unacked sweep once registered, or here if cancellation
-        # landed before registration. The _cancel_requeued set prevents
-        # double-requeueing the registered case (dedup would absorb it, but
-        # a duplicate costs a redelivery-budget tick).
-        remaining = list(batch)
-        current: Delivery | None = None
-        try:
-            while remaining:
-                current = remaining.pop(0)
-                await self._handle(current)
-                current = None
-        finally:
-            if (current is not None
-                    and current.delivery_tag not in self.unacked
+    def _requeue_batch_rest(self, state: _BatchState) -> None:
+        """Requeue a batch's unfinished deliveries exactly once
+        (at-least-once on cancellation). Called from the task's finally OR
+        from cancel() — whichever runs first empties the state so the other
+        is a no-op. The in-flight delivery is requeued by the unacked sweep
+        when it got that far; the _cancel_requeued/unacked checks cover the
+        not-yet-registered window."""
+        start = state.i
+        current, state.current = state.current, None
+        if current is not None:
+            if (current.delivery_tag not in self.unacked
                     and current.delivery_tag not in self._cancel_requeued):
                 self._release()
                 self.broker._requeue(self.queue, current)
-            for d in remaining:
-                self._release()
-                self.broker._requeue(self.queue, d)
+            start += 1
+        for j in range(start, len(state.batch)):
+            self._release()
+            self.broker._requeue(self.queue, state.batch[j])
+        state.i = len(state.batch)
+        self._batch_states.discard(state)
+
+    async def _handle_batch(self, state: _BatchState) -> None:
+        try:
+            while state.i < len(state.batch):
+                state.current = state.batch[state.i]
+                await self._handle(state.current)
+                state.current = None
+                state.i += 1
+        finally:
+            self._requeue_batch_rest(state)
 
     async def _handle(self, delivery: Delivery) -> None:
         if self.broker.consume_faults_enabled:
@@ -196,6 +220,11 @@ class _Consumer:
         for delivery in list(self.unacked.values()):
             self.broker._requeue(self.queue, delivery)
         self.unacked.clear()
+        # Handler tasks cancelled before their first step never run their
+        # finally — sweep their registered batches here (each state empties
+        # on first sweep, so a later-running finally is a no-op).
+        for state in list(self._batch_states):
+            self._requeue_batch_rest(state)
 
 
 class InProcBroker:
@@ -234,6 +263,13 @@ class InProcBroker:
     def queue_depth(self, name: str) -> int:
         q = self._queues.get(name)
         return q.messages.qsize() if q else 0
+
+    def handlers_idle(self) -> bool:
+        """True when no consumer has a handler task outstanding — i.e. no
+        delivery is inside a created-(possibly-unstarted)-handler, which
+        ``queue_depth`` cannot see. Drain/quiesce checks combine this with
+        queue depths."""
+        return all(not c._handlers for c in self._consumers.values())
 
     def publish(self, queue: str, body: bytes,
                 properties: Properties | None = None) -> None:

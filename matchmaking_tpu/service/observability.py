@@ -32,6 +32,10 @@ def _flatten_prom(report: dict[str, Any]) -> str:
             lines.append(f"{metric} {value}")
     for queue, depth in sorted(report.get("pools", {}).items()):
         lines.append(f'matchmaking_pool_size{{queue="{queue}"}} {depth}')
+    for queue, spans in sorted(report.get("engine_spans", {}).items()):
+        for stat, value in sorted(spans.items()):
+            lines.append(
+                f'matchmaking_engine_{stat}{{queue="{queue}"}} {value}')
     return "\n".join(lines) + "\n"
 
 
@@ -54,6 +58,14 @@ class ObservabilityServer:
             for name, rt in self.app._runtimes.items()
         }
         report["broker"] = dict(self.app.broker.stats)
+        # Engine stage spans (SURVEY.md §5 tracing): per-queue averages of
+        # dispatch/turnaround/pack/H2D/... — how window time splits between
+        # host work, transfer, and device.
+        report["engine_spans"] = {
+            name: rt.engine.span_report()
+            for name, rt in self.app._runtimes.items()
+            if hasattr(rt.engine, "span_report")
+        }
         return report
 
     async def _healthz(self, request) -> "web.Response":

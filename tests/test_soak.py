@@ -19,14 +19,24 @@ from matchmaking_tpu.service.app import MatchmakingApp
 from matchmaking_tpu.service.broker import Properties
 
 
-def test_soak_faulty_broker_no_double_match():
+import pytest
+
+
+@pytest.mark.parametrize("readback_group", [1, 3])
+def test_soak_faulty_broker_no_double_match(readback_group):
+    """readback_group=3 additionally soaks the grouped-readback transfer
+    path (full stacks, loose stale seals, flush force-seals) under the same
+    drop/dup fault injection and pipelined service flushes."""
     async def run():
         q = QueueConfig(rating_threshold=60.0, widen_per_sec=20.0,
                         max_threshold=300.0, rescan_interval_s=0.05)
         cfg = Config(
             queues=(q,),
             engine=EngineConfig(backend="tpu", pool_capacity=1024,
-                                pool_block=256, batch_buckets=(16, 64, 256)),
+                                pool_block=256, batch_buckets=(16, 64, 256),
+                                pipeline_depth=4,
+                                readback_group=readback_group,
+                                readback_group_wait_ms=2.0),
             broker=BrokerConfig(drop_prob=0.1, dup_prob=0.15,
                                 max_redelivery=30),
             batcher=BatcherConfig(max_batch=256, max_wait_ms=2.0),
