@@ -419,7 +419,8 @@ def bench_e2e(args) -> dict:
                 backend="tpu", pool_capacity=args.capacity,
                 pool_block=args.pool_block,
                 batch_buckets=(16, 64, 256, args.window), top_k=8,
-                pipeline_depth=args.depth),
+                pipeline_depth=args.depth,
+                readback_group=args.readback_group),
             batcher=BatcherConfig(max_batch=args.window, max_wait_ms=3.0),
             broker=BrokerConfig(prefetch=max(8 * args.window, 4096)),
         )

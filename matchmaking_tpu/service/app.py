@@ -24,7 +24,7 @@ import logging
 import time
 
 from matchmaking_tpu.config import Config, QueueConfig
-from matchmaking_tpu.engine.interface import Engine, Match, SearchOutcome, make_engine
+from matchmaking_tpu.engine.interface import Engine, SearchOutcome, make_engine
 from matchmaking_tpu.service.batcher import Batcher
 from matchmaking_tpu.service.broker import Delivery, InProcBroker, Properties
 from matchmaking_tpu.service.contract import (
