@@ -46,7 +46,8 @@ def make_columns(rng, n, start_id, now):
     )
 
 
-def build_engine(pool, capacity, window, pool_block=8192, buckets=None):
+def build_engine(pool, capacity, window, pool_block=8192, buckets=None,
+                 readback_group=1):
     from matchmaking_tpu.config import Config, EngineConfig, QueueConfig
     from matchmaking_tpu.engine.interface import make_engine
 
@@ -55,6 +56,7 @@ def build_engine(pool, capacity, window, pool_block=8192, buckets=None):
         engine=EngineConfig(
             backend="tpu", pool_capacity=capacity, pool_block=pool_block,
             batch_buckets=tuple(buckets or (window,)), top_k=8,
+            readback_group=readback_group,
         ),
     )
     engine = make_engine(cfg, cfg.queues[0])
@@ -138,7 +140,13 @@ def mode_window(args):
 
 
 def run_point(args, window, depth, reps, iters):
-    engine, rng, next_id = build_engine(args.pool, args.capacity, window)
+    if depth < args.readback_group:
+        log(f"[warn] depth {depth} < readback-group {args.readback_group}: "
+            f"groups never fill before the depth gate blocks — this point "
+            f"measures wait-dominated stale seals")
+    engine, rng, next_id = build_engine(
+        args.pool, args.capacity, window,
+        readback_group=args.readback_group)
     results = []
     for rep in range(reps):
         lats, matches, t0 = [], 0, time.perf_counter()
@@ -213,6 +221,8 @@ def main():
     p.add_argument("--reps", type=int, default=3)
     p.add_argument("--sweep-windows", default="256,512,1024,2048")
     p.add_argument("--sweep-depths", default="1,2,3,4")
+    p.add_argument("--readback-group", type=int, default=1,
+                   help="device-side result grouping for window/sweep modes")
     args = p.parse_args()
     import jax
 
