@@ -184,3 +184,52 @@ async def test_dup_fault_injection_duplicates():
     await _drain(received, 2)
     assert received[1].redelivered
     b.close()
+
+
+@pytest.mark.asyncio
+async def test_cancel_before_handler_starts_loses_nothing(broker):
+    """asyncio cancels a never-started task WITHOUT running its body (so
+    its try/finally never fires) — the consumer-level batch-state sweep
+    must requeue those deliveries (at-least-once; round-4 regression)."""
+    first = []
+
+    async def cb(d: Delivery):
+        first.append(d)
+
+    tag = broker.basic_consume("qz", cb, batch_hint=True)
+    for i in range(10):
+        broker.publish("qz", f"m{i}".encode())
+    # Let the consumer's _run drain a burst into a handler task...
+    await asyncio.sleep(0)
+    # ...and cancel in the same tick, before that task's first step.
+    broker.basic_cancel(tag)
+    # The cancel beat the handler's first step: nothing was processed, and
+    # nothing may be lost — all 10 messages must be back in the queue
+    # (possibly as redeliveries), ready for the next consumer.
+    assert not first
+    received = []
+
+    async def cb2(d: Delivery):
+        received.append(d)
+        broker.ack(tag2, d.delivery_tag)
+
+    tag2 = broker.basic_consume("qz", cb2)
+    await _drain(received, 10)
+    assert sorted(d.body for d in received) == sorted(
+        f"m{i}".encode() for i in range(10))
+
+
+@pytest.mark.asyncio
+async def test_batch_hint_preserves_order_and_acks(broker):
+    received = []
+
+    async def cb(d: Delivery):
+        received.append(d)
+        broker.ack(tag, d.delivery_tag)
+
+    tag = broker.basic_consume("qb", cb, batch_hint=True)
+    for i in range(50):
+        broker.publish("qb", f"m{i}".encode())
+    await _drain(received, 50)
+    assert [d.body for d in received] == [f"m{i}".encode() for i in range(50)]
+    assert broker.stats["acked"] == 50
