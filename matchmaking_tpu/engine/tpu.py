@@ -462,7 +462,11 @@ class TpuEngine(Engine):
         """Re-submit the longest-waiting players as a search window so that
         threshold widening can resolve between POOL members (matching is
         otherwise arrival-triggered). Returns a window token, or None when
-        the pool is empty / the path is unsupported (team queues).
+        there is nothing to rescan (empty pool; device team queues with
+        fewer than one match's worth of players) or the path is unsupported
+        (host-oracle team/role queues, which re-form on arrival). Device
+        team queues rescan via _rescan_team (pool-wide window formation
+        with an all-invalid batch).
 
         Safe by construction: the batch carries the players' EXISTING slots,
         so the fused admit rewrites identical values; self-masking and the
@@ -474,8 +478,10 @@ class TpuEngine(Engine):
         match. Periodic ticks cover pools larger than a bucket. The
         resulting ColumnarOutcome's q_ids are the unmatched rescans —
         callers must NOT re-ack them as newly queued."""
-        if self._team_device or self._team_delegate is not None:
-            return None
+        if self._team_delegate is not None:
+            return None  # host-oracle team queues re-form on arrival only
+        if self._team_device:
+            return self._rescan_team(now)
         # The engine refuses, not just the service's lock convention: a
         # rescan while a window is in flight re-admits — from the
         # not-yet-finalized mirror — slots that window may already have
@@ -517,6 +523,33 @@ class TpuEngine(Engine):
             self._dev_pool, jnp.asarray(pack_batch(batch, now - t0))
         )
         pending.chunks.append(((cols, slots), (out,), now))
+        self._submit(pending)
+        return pending.token
+
+    def _rescan_team(self, now: float) -> int | None:
+        """Device-team rescan: the team step's window formation is POOL-wide
+        (the batch only admits), so dispatching an all-invalid batch re-runs
+        match formation with CURRENT effective thresholds — without this,
+        two waiting groups whose thresholds WIDENED into compatibility would
+        never match under zero traffic (the same gap the 1v1 rescan closes;
+        config #3 enables widening)."""
+        assert self._open == 0, (
+            "rescan with windows in flight — collect with flush() first"
+        )
+        if len(self.pool) < 2 * self.queue.team_size:
+            return None
+        bucket = self.buckets[0]
+        # All lanes are the canonical padding (slot = capacity sentinel,
+        # valid = False) — the same never-matching batch batch_arrays
+        # produces for an empty window.
+        batch = self.pool.batch_arrays([], [], bucket)
+        t0 = self._rel_base(now)
+        pending = _Pending(token=self._next_token,
+                           created=time.perf_counter())
+        self._next_token += 1
+        self._dev_pool, out = self.kernels.search_step_packed(
+            self._dev_pool, jnp.asarray(pack_batch(batch, now - t0)))
+        pending.chunks.append(([], (out,), now))
         self._submit(pending)
         return pending.token
 

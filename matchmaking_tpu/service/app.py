@@ -110,7 +110,11 @@ class _QueueRuntime:
         if queue_cfg.request_timeout_s is not None:
             self._sweeper = asyncio.create_task(self._sweep_timeouts())
         self._rescanner: asyncio.Task | None = None
-        if queue_cfg.rescan_interval_s > 0 and queue_cfg.team_size == 1:
+        if queue_cfg.rescan_interval_s > 0:
+            # 1v1 queues AND device team queues support rescan (team window
+            # formation is pool-wide, so an all-invalid batch re-forms with
+            # widened thresholds); host-oracle team paths return None from
+            # rescan_async and the tick is a no-op.
             self._rescanner = asyncio.create_task(self._rescan_loop())
         # Online invariant checking (SURVEY.md §5 "Race detection").
         self._invariants = None

@@ -148,3 +148,77 @@ class TestServiceRescan:
                 await app.stop()
 
         asyncio.run(run())
+
+
+def test_device_team_rescan_resolves_widening():
+    """Two 2v2 groups too far apart at enqueue time: with widening, a
+    rescan tick must form the match under ZERO traffic (round-4: the team
+    step's window formation is pool-wide, so an all-invalid batch re-runs
+    it with current effective thresholds)."""
+    from matchmaking_tpu.service.contract import SearchRequest
+
+    cfg = Config(
+        queues=(QueueConfig(team_size=2, rating_threshold=10.0,
+                            widen_per_sec=10.0, max_threshold=400.0),),
+        engine=EngineConfig(backend="tpu", pool_capacity=64, pool_block=64,
+                            batch_buckets=(16,)),
+    )
+    engine = make_engine(cfg, cfg.queues[0])
+    reqs = [SearchRequest(id=f"p{i}", rating=1500.0 + 30.0 * i,
+                          region="eu", game_mode="std", enqueued_at=1.0)
+            for i in range(4)]  # spread 90 > threshold 10 at t=1
+    out = engine.search(reqs, now=1.0)
+    assert not out.matches and engine.pool_size() == 4
+    # t=1: no match. t=31: widened by 300 -> spread 90 fits.
+    tok = engine.rescan_async(16, 31.0)
+    assert tok is not None
+    outs = dict(engine.flush())
+    assert engine.device_error is None
+    matches = outs[tok].matches
+    assert len(matches) == 1
+    ids = {r.id for t in matches[0].teams for r in t}
+    assert ids == {"p0", "p1", "p2", "p3"}
+    assert engine.pool_size() == 0
+
+
+def test_service_team_rescan_end_to_end():
+    """Service-level: a device team queue with widening + rescan ticks
+    matches waiting groups under ZERO follow-up traffic (rescan outcomes
+    flow through _rescan_loop's object-outcome branch with the pipelined
+    drain)."""
+    import asyncio
+
+    from matchmaking_tpu.config import BatcherConfig
+    from matchmaking_tpu.service.app import MatchmakingApp
+    from matchmaking_tpu.service.client import MatchmakingClient
+
+    async def run():
+        cfg = Config(
+            queues=(QueueConfig(team_size=2, rating_threshold=10.0,
+                                widen_per_sec=50.0, max_threshold=400.0,
+                                rescan_interval_s=0.2),),
+            engine=EngineConfig(backend="tpu", pool_capacity=64,
+                                pool_block=64, batch_buckets=(8,)),
+            batcher=BatcherConfig(max_batch=4, max_wait_ms=5.0),
+        )
+        app = MatchmakingApp(cfg)
+        await app.start()
+        c = MatchmakingClient(app.broker, "matchmaking.search")
+        # Spread 90 > threshold 10 at enqueue; widening (50/s) makes the
+        # 4-player window valid within ~2 s — only rescan ticks can see it.
+        handles = {f"p{i}": c.submit({"id": f"p{i}", "rating": 1500 + 30 * i,
+                                      "region": "eu", "game_mode": "std"})
+                   for i in range(4)}
+        matched = set()
+        for pid, h in handles.items():
+            r = await c.next_response(h, timeout=30.0)
+            while r.status == "queued":
+                r = await c.next_response(h, timeout=30.0)
+            assert r.status == "matched", (pid, r)
+            matched.add(pid)
+        assert matched == set(handles)
+        # one 2v2 match, formed by a rescan tick (counter counts MATCHES)
+        assert app.metrics.counters.get("rescan_matches") >= 1
+        await app.stop()
+
+    asyncio.run(run())
