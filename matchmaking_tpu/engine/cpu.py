@@ -81,7 +81,11 @@ class CpuEngine(Engine):
         out = SearchOutcome()
         if self.queue.team_size != 1:
             return out
-        oldest = sorted(self._entries, key=lambda r: r.enqueued_at)[:max_window]
+        # O(n log k), not a full sort: max_window is typically ≪ pool size.
+        import heapq
+
+        oldest = heapq.nsmallest(max_window, self._entries,
+                                 key=lambda r: r.enqueued_at)
         for req in oldest:
             idx = self._by_id.get(req.id)
             if idx is None:

@@ -540,7 +540,7 @@ class TpuEngine(Engine):
             return None
         bucket = self.buckets[0]
         # All lanes are the canonical padding (slot = capacity sentinel,
-        # valid = False) — the same never-matching batch batch_arrays
+        # valid = False) — the same never-matching batch that batch_arrays
         # produces for an empty window.
         batch = self.pool.batch_arrays([], [], bucket)
         t0 = self._rel_base(now)
