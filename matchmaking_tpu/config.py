@@ -118,6 +118,24 @@ class EngineConfig:
     #: transferred anyway (checked on every collect_ready poll) — bounds
     #: the extra latency grouping can add when traffic pauses mid-group.
     readback_group_wait_ms: float = 8.0
+    #: Rating-banded candidate pruning (single-device 1v1 path). 0 = dense
+    #: scoring of every pool block. N > 0: each rating-sorted window chunk
+    #: scores only an N-block contiguous span of the pool chosen from live
+    #: per-block rating bounds — BIT-EXACT vs dense (a whole-window dense
+    #: fallback cond covers spans that don't fit; kernels.py
+    #: ``_search_step_pruned``). Effective only with ``band_spec`` set so
+    #: the allocator keeps blocks rating-coherent. Size so that
+    #: N·(capacity/n_blocks) slots cover ~2·max effective threshold of
+    #: rating mass (Glicko-2: /g(max rd)) for the mid-distribution chunks.
+    prune_window_blocks: int = 0
+    #: Sorted-window chunk size for pruning: smaller chunks → tighter rating
+    #: intervals → narrower spans, but more scan iterations per window.
+    prune_chunk: int = 128
+    #: Rating-band layout for the HOST slot allocator (core/pool.py
+    #: ``band_edges_from_spec``): "" (off), "uniform:LO:HI", or
+    #: "gaussian:MEAN:STD" (equal-mass bands — keeps band occupancy even
+    #: under a normal rating distribution). One band per pool block.
+    band_spec: str = ""
 
 
 @dataclass(frozen=True)

@@ -93,13 +93,38 @@ class PlayerPool:
     carry state); this class owns which slot means which player.
     """
 
-    def __init__(self, capacity: int, default_threshold: float):
+    def __init__(self, capacity: int, default_threshold: float,
+                 band_edges: Sequence[float] | None = None):
         self.capacity = int(capacity)
         self.default_threshold = float(default_threshold)
         # Vectorized free list: pop from the END (head), so initial pops
         # yield slot 0, 1, 2, ... (kept for slot-order determinism in tests).
         self._free = np.arange(self.capacity - 1, -1, -1, dtype=np.int32)
         self._head = self.capacity  # number of free slots
+        # Rating-banded mode: slots partitioned into contiguous bands, one
+        # free stack per band; a player's slot comes from the band holding
+        # its rating (spilling outward to the nearest non-full band). Keeps
+        # each pool BLOCK's live rating interval narrow, which is what makes
+        # the kernels' bit-exact block pruning effective (kernels.py
+        # "_search_step_pruned"). ``band_edges`` are the len(R-1) ascending
+        # rating boundaries; band b owns slots [b·P/R, (b+1)·P/R).
+        self._band_edges: np.ndarray | None = None
+        if band_edges is not None and len(band_edges) > 0:
+            edges = np.asarray(band_edges, np.float64)
+            if not np.all(np.diff(edges) > 0):
+                raise ValueError("band_edges must be strictly ascending")
+            r = edges.size + 1
+            self._band_edges = edges
+            self._band_start = np.array(
+                [b * self.capacity // r for b in range(r + 1)], np.int64)
+            # Stacks store descending so pops yield ascending slot order.
+            self._band_free = [
+                np.arange(self._band_start[b + 1] - 1,
+                          self._band_start[b] - 1, -1, dtype=np.int32)
+                for b in range(r)
+            ]
+            self._band_head = np.array(
+                [s.size for s in self._band_free], np.int64)
         self._slot_of: dict[str, int] = {}                   # player id → slot
         # Columnar mirror (slot-indexed).
         self.m_id = np.full(self.capacity, None, dtype=object)
@@ -173,7 +198,10 @@ class PlayerPool:
             raise ValueError("duplicate player id in window")
         if any(pid in self._slot_of for pid in ids):
             raise ValueError("player already in pool")
-        slots = self._free[self._head - n:self._head][::-1].copy()
+        if self._band_edges is not None:
+            slots = self._take_banded(np.asarray(cols.rating, np.float64), n)
+        else:
+            slots = self._free[self._head - n:self._head][::-1].copy()
         self._head -= n
         self.m_id[slots] = cols.ids
         self.m_rating[slots] = cols.rating
@@ -218,8 +246,51 @@ class PlayerPool:
         for pid in ids[occupied].tolist():
             del self._slot_of[pid]
         self.m_id[arr] = None
-        self._free[self._head:self._head + arr.size] = arr
-        self._head += arr.size
+        if self._band_edges is not None:
+            # Slots return to their HOME band (slot ranges are static), so
+            # band occupancy self-heals as spilled players match out.
+            bands = np.searchsorted(self._band_start, arr, side="right") - 1
+            for b in np.unique(bands):
+                sel = arr[bands == b][::-1]
+                h = self._band_head[b]
+                self._band_free[b][h:h + sel.size] = sel
+                self._band_head[b] += sel.size
+            self._head += arr.size
+        else:
+            self._free[self._head:self._head + arr.size] = arr
+            self._head += arr.size
+
+    def _take_banded(self, ratings: np.ndarray, n: int) -> np.ndarray:
+        """Pop ``n`` slots by rating band; spill outward when a band is full.
+
+        Vectorized per band present in the window (≤ R tiny numpy slices);
+        the per-request Python loop runs only for spilled requests, which is
+        rare until the pool nears capacity or the rating distribution drifts
+        from the band edges."""
+        band = np.digitize(ratings, self._band_edges)
+        slots = np.empty(n, np.int32)
+        for b in np.unique(band):
+            idx = np.nonzero(band == b)[0]
+            h = int(self._band_head[b])
+            take = min(idx.size, h)
+            if take:
+                slots[idx[:take]] = self._band_free[b][h - take:h][::-1]
+                self._band_head[b] = h - take
+            for j in idx[take:]:
+                bb = self._nearest_free_band(int(b))
+                hh = int(self._band_head[bb])
+                slots[j] = self._band_free[bb][hh - 1]
+                self._band_head[bb] = hh - 1
+        return slots
+
+    def _nearest_free_band(self, b: int) -> int:
+        r = len(self._band_free)
+        for off in range(1, r):
+            for cand in (b - off, b + off):
+                if 0 <= cand < r and self._band_head[cand] > 0:
+                    return cand
+        raise PoolFullError("no free slot in any band")  # pragma: no cover
+        # (unreachable: allocate_columns checks total free space upfront)
 
     # ---- array building ---------------------------------------------------
 
@@ -276,6 +347,36 @@ class PlayerPool:
     def empty_device_arrays(capacity: int) -> dict[str, np.ndarray]:
         """Initial HBM pool state (all slots inactive)."""
         return {name: np.zeros(capacity, dtype) for name, dtype in POOL_FIELDS}
+
+
+def band_edges_from_spec(spec: str, n_bands: int) -> list[float] | None:
+    """Parse an EngineConfig ``band_spec`` into ``n_bands - 1`` rating edges.
+
+    Formats (JSON/env-friendly single string):
+      ``""``                     — banding off (returns None)
+      ``"uniform:LO:HI"``        — equal-width bands over [LO, HI]
+      ``"gaussian:MEAN:STD"``    — equal-probability-mass bands under
+                                   N(MEAN, STD) (stdlib NormalDist quantiles;
+                                   matches a typical rating distribution so
+                                   bands fill evenly and spilling stays rare)
+    """
+    if not spec:
+        return None
+    if n_bands < 2:
+        return None
+    kind, *params = spec.split(":")
+    if kind == "uniform":
+        lo, hi = float(params[0]), float(params[1])
+        if not hi > lo:
+            raise ValueError(f"uniform band_spec needs hi > lo: {spec!r}")
+        step = (hi - lo) / n_bands
+        return [lo + i * step for i in range(1, n_bands)]
+    if kind == "gaussian":
+        from statistics import NormalDist
+
+        nd = NormalDist(float(params[0]), float(params[1]))
+        return [nd.inv_cdf(i / n_bands) for i in range(1, n_bands)]
+    raise ValueError(f"unknown band_spec kind: {spec!r}")
 
 
 #: Row order of the packed batch (one f32[9, B] array per window — a single

@@ -160,7 +160,8 @@ def _mask_members(active, start, blk: int, slots) -> jnp.ndarray:
     return active & ~hit
 
 
-def greedy_pair(vals, idxs, self_slot, capacity: int, rounds: int = 8):
+def greedy_pair(vals, idxs, self_slot, capacity: int, rounds: int = 8,
+                rid=None):
     """Parallel greedy conflict-free pairing over B×K candidate lists.
 
     A fixed number of proposal rounds (Luby-style parallel greedy matching —
@@ -186,10 +187,16 @@ def greedy_pair(vals, idxs, self_slot, capacity: int, rounds: int = 8):
 
     Returns (q_slot i32[B], c_slot i32[B], dist f32[B]), row-indexed;
     unmatched lanes hold the sentinel ``capacity`` / +inf.
+
+    ``rid`` overrides the tie-break row ids (default: row position). The
+    pruned step runs pairing over a rating-SORTED window; passing the
+    original lane ids keeps exact-tie resolution identical to the dense
+    step, so sorting cannot change which edge wins a conflict.
     """
     b, k = vals.shape
     cap = jnp.int32(capacity)
-    rid = jnp.arange(b, dtype=jnp.int32)
+    if rid is None:
+        rid = jnp.arange(b, dtype=jnp.int32)
     not_diag = ~jnp.eye(b, dtype=bool)
 
     def body(_, state):
@@ -249,7 +256,8 @@ class KernelSet:
     def __init__(self, *, capacity: int, top_k: int, pool_block: int,
                  glicko2: bool, widen_per_sec: float, max_threshold: float,
                  evict_bucket: int = 64, pair_rounds: int = 8,
-                 exact_block: bool = False):
+                 exact_block: bool = False, prune_window_blocks: int = 0,
+                 prune_chunk: int = 128):
         pool_block = effective_pool_block(capacity, pool_block, top_k,
                                           min_blocks=not exact_block)
         self.capacity = capacity
@@ -261,10 +269,19 @@ class KernelSet:
         self.max_threshold = max_threshold
         self.evict_bucket = evict_bucket
         self.pair_rounds = pair_rounds
+        # Rating-banded candidate pruning (bit-exact — see
+        # _search_step_pruned). 0 disables; values ≥ n_blocks degenerate to
+        # scoring every block through the pruned plumbing.
+        self.prune_window_blocks = min(max(0, prune_window_blocks),
+                                       self.n_blocks)
+        self.prune_chunk = max(1, prune_chunk)
 
+        step = (self._search_step_pruned if self.prune_window_blocks
+                else self._search_step)
+        self._step_impl = step
         self.admit = jax.jit(self._admit, donate_argnums=0)
         self.evict = jax.jit(self._evict, donate_argnums=0)
-        self.search_step = jax.jit(self._search_step, donate_argnums=0)
+        self.search_step = jax.jit(step, donate_argnums=0)
         # Packed I/O variants: one f32[8,B] in, one f32[3,B] out — a single
         # H2D and a single D2H RPC per window through the device tunnel.
         self.admit_packed = jax.jit(
@@ -279,7 +296,7 @@ class KernelSet:
         (q_slot, c_slot, dist) as f32[3, B] (slot ids ≪ 2^24 are f32-exact)."""
         batch = unpack_batch(packed)
         now = packed[8, 0]
-        pool, out_q, out_c, out_d = self._search_step(pool, batch, now)
+        pool, out_q, out_c, out_d = self._step_impl(pool, batch, now)
         out = jnp.stack([out_q.astype(jnp.float32),
                          out_c.astype(jnp.float32), out_d])
         return pool, out
@@ -315,8 +332,11 @@ class KernelSet:
 
     def _score_block(self, batch: dict[str, Any], q_thr_eff, block: dict[str, Any],
                      start, now):
-        """Masked scores of the window vs one pool block: f32[B, block]."""
-        blk = self.pool_block
+        """Masked scores of the window vs one pool block: f32[B, block].
+
+        Block width comes from the arrays (not ``self.pool_block``): the
+        pruned step scores window chunks against W-block spans in one call."""
+        blk = block["rating"].shape[0]
         d = _pair_distance(
             batch["rating"][:, None], block["rating"][None, :],
             batch["rd"][:, None], block["rd"][None, :], glicko2=self.glicko2,
@@ -437,14 +457,210 @@ class KernelSet:
         pool = dict(pool, active=act_blocks.reshape(self.capacity))
         return pool, out_q, out_c, out_d
 
+    # ---- rating-banded candidate pruning ----------------------------------
+    #
+    # The dense step scores every request against every pool slot — O(B·P)
+    # pair compute per window, even though a request with threshold t can
+    # only ever match candidates within rating distance t (ELO) or
+    # t / g(rd_q, rd_c) (Glicko-2, g ≤ 1). The pruned step exploits that
+    # WITHOUT changing a single output bit:
+    #
+    #   1. sort the window by rating (padding to the end), carrying original
+    #      lane ids for tie-break/order restoration;
+    #   2. one cheap O(P) pass admits the window and records each pool
+    #      block's live rating bounds (min/max rating, max rd);
+    #   3. each sorted chunk of C requests scores ONLY a W-block contiguous
+    #      span of the pool chosen from those bounds (dynamic start, static
+    #      width — no recompiles);
+    #   4. if any chunk's admissible span exceeds W blocks, the WHOLE window
+    #      falls back to the dense scan via one lax.cond (same compiled
+    #      step, no recompile, exact by construction).
+    #
+    # Bit-exactness argument: a block outside a chunk's span can contain no
+    # admissible candidate for any request in the chunk (the span bound is
+    # inflated past f32 rounding), so the dense scan would have produced
+    # -inf for exactly the (row, block) cells the pruned scan leaves at
+    # -inf; covered cells are computed by the same _score_block math. The
+    # candidate matrices are therefore identical, pairing (with original-id
+    # tie-breaks) is identical, and the unsort is an exact one-hot matmul.
+    # One caveat scopes the claim: the dense and pruned PROGRAMS compile the
+    # shared scoring expression at different tile shapes, and a backend's
+    # instruction selection (e.g. LLVM FMA contraction on the CPU test
+    # backend) may round intermediates differently per shape — measured ≤1
+    # ulp in distance on CPU, and bit-identical on the TPU backend. That
+    # noise is a property of compiling the SAME math twice, not of pruning.
+    #
+    # Effectiveness depends on the HOST keeping ratings spatially coherent:
+    # with PlayerPool rating bands aligned to pool blocks (band_spec), block
+    # bounds are tight and W ≈ (2·threshold span)/band width ≪ n_blocks.
+    # With the default LIFO allocator every block spans the whole rating
+    # range and the step falls back to dense — correct, just not faster.
+
+    def _sort_batch(self, batch: dict[str, Any], q_thr_eff):
+        """Sort window lanes by rating (padding lanes to the end); returns
+        (sorted batch, sorted q_thr_eff, original lane ids i32[B])."""
+        b = batch["rating"].shape[0]
+        key = jnp.where(batch["valid"], batch["rating"], jnp.inf)
+        orig = jnp.arange(b, dtype=jnp.int32)
+        (_, slot, rating, rd, region, mode, thr, enq, valid, qte, oi) = lax.sort(
+            (key, batch["slot"], batch["rating"], batch["rd"], batch["region"],
+             batch["mode"], batch["threshold"], batch["enqueue_t"],
+             batch["valid"], q_thr_eff, orig),
+            num_keys=1, is_stable=True)
+        sb = dict(slot=slot, rating=rating, rd=rd, region=region, mode=mode,
+                  threshold=thr, enqueue_t=enq, valid=valid)
+        return sb, qte, oi
+
+    def _admit_stats(self, pool: dict[str, Any], batch: dict[str, Any]):
+        """Admission pass + per-block live stats: (pool', min_r f32[n_blocks],
+        max_r f32[n_blocks], max_rd f32[n_blocks]). Empty blocks carry
+        (+inf, -inf, 0) — the overlap test then never selects them."""
+        blk = self.pool_block
+
+        def body(_, blk_i):
+            start = blk_i * blk
+            block = {f: lax.dynamic_slice_in_dim(pool[f], start, blk)
+                     for f in (*_ADMIT_FIELDS, "active")}
+            block = _admit_block(block, start, blk, batch)
+            act = block["active"]
+            minr = jnp.min(jnp.where(act, block["rating"], jnp.inf))
+            maxr = jnp.max(jnp.where(act, block["rating"], -jnp.inf))
+            maxrd = jnp.max(jnp.where(act, block["rd"], 0.0))
+            return None, (block, minr, maxr, maxrd)
+
+        _, (blocks, minr, maxr, maxrd) = lax.scan(
+            body, None, jnp.arange(self.n_blocks, dtype=jnp.int32))
+        pool = {f: blocks[f].reshape(self.capacity) for f in blocks}
+        return pool, minr, maxr, maxrd
+
+    def _chunk_size(self, b: int) -> int:
+        c = max(1, min(self.prune_chunk, b))
+        while b % c:
+            c //= 2
+        return c
+
+    def _chunk_windows(self, sb, q_thr_eff, bmin, bmax, brd):
+        """Per-chunk block-span starts + global feasibility.
+
+        A (chunk, block) pair can hold an admissible edge only if the
+        block's live rating interval, inflated by the chunk's worst-case
+        reach E, overlaps the chunk's rating interval. E = max effective
+        threshold (ELO) or that / g(max rd_chunk, max rd_block) (Glicko-2:
+        g ≤ 1 and decreasing in rd, so the max-rd g lower-bounds every
+        pair's g). The 0.1% + 0.5 inflation swamps f32 rounding in the
+        kernel's distance math — a block excluded here scores -inf in the
+        dense scan too."""
+        b = sb["rating"].shape[0]
+        c = self._chunk_size(b)
+        n_chunks = b // c
+        nb, w = self.n_blocks, self.prune_window_blocks
+        v = sb["valid"].reshape(n_chunks, c)
+        r = sb["rating"].reshape(n_chunks, c)
+        cmin = jnp.min(jnp.where(v, r, jnp.inf), axis=1)
+        cmax = jnp.max(jnp.where(v, r, -jnp.inf), axis=1)
+        cthr = jnp.max(jnp.where(v, q_thr_eff.reshape(n_chunks, c), 0.0),
+                       axis=1)
+        if self.glicko2:
+            crd = jnp.max(jnp.where(v, sb["rd"].reshape(n_chunks, c), 0.0),
+                          axis=1)
+            g = scoring.glicko_g(crd[:, None], brd[None, :])
+            reach = cthr[:, None] / jnp.maximum(g, jnp.float32(1e-6))
+        else:
+            reach = jnp.broadcast_to(cthr[:, None], (n_chunks, nb))
+        reach = reach * jnp.float32(1.001) + jnp.float32(0.5)
+        ov = ((bmin[None, :] - reach <= cmax[:, None])
+              & (bmax[None, :] + reach >= cmin[:, None]))
+        idx = jnp.arange(nb, dtype=jnp.int32)
+        first = jnp.min(jnp.where(ov, idx, nb), axis=1)
+        last = jnp.max(jnp.where(ov, idx, -1), axis=1)
+        width = jnp.maximum(last - first + 1, 0)
+        feasible = jnp.all(width <= w)
+        dstart = jnp.clip(jnp.minimum(first, nb - w), 0, nb - w)
+        return dstart.astype(jnp.int32), feasible
+
+    def _candidates_pruned(self, sb, q_thr_eff, pool, now, dstart):
+        """Best-per-block candidates, scoring only each chunk's W-block span.
+
+        Output shape/content identical to _candidates on the sorted batch:
+        (vals f32[B, n_blocks], idxs i32[B, n_blocks]); blocks outside a
+        chunk's span hold -inf / capacity — exactly what the dense scan
+        yields for them (no admissible candidate there)."""
+        blk, w, nb = self.pool_block, self.prune_window_blocks, self.n_blocks
+        b = sb["rating"].shape[0]
+        c = self._chunk_size(b)
+        n_chunks = b // c
+
+        def body(_, j):
+            ds = dstart[j] * blk
+            wpool = {f: lax.dynamic_slice_in_dim(pool[f], ds, w * blk)
+                     for f in (*_ADMIT_FIELDS, "active")}
+            cb = {f: lax.dynamic_slice_in_dim(sb[f], j * c, c) for f in sb}
+            qte = lax.dynamic_slice_in_dim(q_thr_eff, j * c, c)
+            scores = self._score_block(cb, qte, wpool, ds, now)  # (c, w·blk)
+            sc = scores.reshape(c, w, blk)
+            v = sc.max(-1)
+            gi = (ds + jnp.arange(w, dtype=jnp.int32)[None, :] * blk
+                  + jnp.argmax(sc, -1).astype(jnp.int32))
+            cv = lax.dynamic_update_slice(
+                jnp.full((c, nb), _NEG_INF), v, (0, dstart[j]))
+            ci = lax.dynamic_update_slice(
+                jnp.full((c, nb), jnp.int32(self.capacity)),
+                jnp.where(v > _NEG_INF, gi, self.capacity), (0, dstart[j]))
+            return None, (cv, ci)
+
+        _, (cvs, cis) = lax.scan(body, None,
+                                 jnp.arange(n_chunks, dtype=jnp.int32))
+        return cvs.reshape(b, nb), cis.reshape(b, nb)
+
+    def _search_step_pruned(self, pool: dict[str, Any], batch: dict[str, Any],
+                            now):
+        """Bit-exact pruned window step (see the section comment above)."""
+        b = batch["rating"].shape[0]
+        blk = self.pool_block
+        q_thr_eff = _effective_threshold(
+            batch["threshold"], batch["enqueue_t"], now,
+            self.widen_per_sec, self.max_threshold,
+        )
+        sb, qte, oi = self._sort_batch(batch, q_thr_eff)
+        pool, bmin, bmax, brd = self._admit_stats(pool, sb)
+        dstart, feasible = self._chunk_windows(sb, qte, bmin, bmax, brd)
+        vals, idxs = lax.cond(
+            feasible,
+            lambda: self._candidates_pruned(sb, qte, pool, now, dstart),
+            lambda: self._candidates(sb, qte, pool, now),
+        )
+        s_q, s_c, s_d = greedy_pair(vals, idxs, sb["slot"], self.capacity,
+                                    self.pair_rounds, rid=oi)
+
+        # Unsort to original lane order with an exact one-hot matmul (the
+        # scatter-free idiom; gathers/scatters of B irregular elements
+        # serialize on TPU). HIGHEST keeps the 0/1 × value products exact;
+        # +inf sentinels are encoded as -1 first (0·inf would poison rows
+        # with NaN), and dist ≥ 0 makes -1 unambiguous.
+        onehot = (oi[None, :] == jnp.arange(b, dtype=jnp.int32)[:, None]
+                  ).astype(jnp.float32)
+        enc_d = jnp.where(jnp.isinf(s_d), jnp.float32(-1.0), s_d)
+        stacked = jnp.stack(
+            [s_q.astype(jnp.float32), s_c.astype(jnp.float32), enc_d], axis=1)
+        un = jnp.matmul(onehot, stacked, precision=lax.Precision.HIGHEST)
+        out_q = un[:, 0].astype(jnp.int32)
+        out_c = un[:, 1].astype(jnp.int32)
+        out_d = jnp.where(un[:, 2] < 0, jnp.inf, un[:, 2])
+
+        # Eviction uses the sorted-order outputs — same slot set.
+        pool = self._evict(pool, jnp.concatenate([s_q, s_c]))
+        return pool, out_q, out_c, out_d
+
 
 @functools.lru_cache(maxsize=None)
 def kernel_set(capacity: int, top_k: int, pool_block: int, glicko2: bool,
                widen_per_sec: float, max_threshold: float,
-               pair_rounds: int = 8) -> KernelSet:
+               pair_rounds: int = 8, prune_window_blocks: int = 0,
+               prune_chunk: int = 128) -> KernelSet:
     """Cached KernelSet per static config (compile once per queue shape)."""
     return KernelSet(
         capacity=capacity, top_k=top_k, pool_block=pool_block, glicko2=glicko2,
         widen_per_sec=widen_per_sec, max_threshold=max_threshold,
-        pair_rounds=pair_rounds,
+        pair_rounds=pair_rounds, prune_window_blocks=prune_window_blocks,
+        prune_chunk=prune_chunk,
     )

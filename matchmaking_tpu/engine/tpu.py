@@ -36,7 +36,12 @@ import jax
 import jax.numpy as jnp
 
 from matchmaking_tpu.config import Config, QueueConfig
-from matchmaking_tpu.core.pool import BatchArrays, PlayerPool, pack_batch
+from matchmaking_tpu.core.pool import (
+    BatchArrays,
+    PlayerPool,
+    band_edges_from_spec,
+    pack_batch,
+)
 from matchmaking_tpu.engine import scoring
 from matchmaking_tpu.engine.interface import (
     ColumnarOutcome,
@@ -193,13 +198,21 @@ class TpuEngine(Engine):
                 widen_per_sec=queue.widen_per_sec,
                 max_threshold=queue.max_threshold,
                 pair_rounds=ec.pair_rounds,
+                prune_window_blocks=ec.prune_window_blocks,
+                prune_chunk=ec.prune_chunk,
             )
             self._dev_pool = jax.device_put(
                 {k: jnp.asarray(v)
                  for k, v in PlayerPool.empty_device_arrays(self.kernels.capacity).items()}
             )
         # Capacity may have been rounded up (sharding divisibility).
-        self.pool = PlayerPool(self.kernels.capacity, queue.rating_threshold)
+        # Rating-banded slot allocation (one band per pool block) keeps
+        # block rating bounds tight for the pruned kernel; harmless (and
+        # unused) for non-pruning paths, so it keys off band_spec alone.
+        edges = band_edges_from_spec(
+            ec.band_spec, getattr(self.kernels, "n_blocks", 0))
+        self.pool = PlayerPool(self.kernels.capacity, queue.rating_threshold,
+                               band_edges=edges)
         self.buckets = tuple(sorted(ec.batch_buckets))
         # Wall-clock rebase: device times are float32 (128 s spacing at epoch
         # magnitude), so all device-visible times are relative to the first

@@ -47,7 +47,8 @@ def make_columns(rng, n, start_id, now):
 
 
 def build_engine(pool, capacity, window, pool_block=8192, buckets=None,
-                 readback_group=1):
+                 readback_group=1, prune_window_blocks=0, prune_chunk=128,
+                 band_spec=""):
     from matchmaking_tpu.config import Config, EngineConfig, QueueConfig
     from matchmaking_tpu.engine.interface import make_engine
 
@@ -57,6 +58,8 @@ def build_engine(pool, capacity, window, pool_block=8192, buckets=None,
             backend="tpu", pool_capacity=capacity, pool_block=pool_block,
             batch_buckets=tuple(buckets or (window,)), top_k=8,
             readback_group=readback_group,
+            prune_window_blocks=prune_window_blocks, prune_chunk=prune_chunk,
+            band_spec=band_spec,
         ),
     )
     engine = make_engine(cfg, cfg.queues[0])
@@ -98,6 +101,63 @@ def mode_device(args):
         log(f"[device rep{rep}] {args.iters} chained steps: "
             f"{dt * 1e3:.1f} ms total, {dt / args.iters * 1e3:.3f} ms/step "
             f"(B={args.window}, P={k.capacity})")
+
+
+def mode_prunecheck(args):
+    """Rating-banded pruning vs dense at the same pool state: per-step device
+    time for both, plus an on-chip bit-exactness check of one step's outputs
+    (the pruned step's contract — kernels.py _search_step_pruned)."""
+    import jax.numpy as jnp
+    from matchmaking_tpu.core.pool import pack_batch
+    from matchmaking_tpu.engine.kernels import kernel_set
+
+    w = args.prune_window_blocks or 12
+    engine, rng, next_id = build_engine(
+        args.pool, args.capacity, args.window, pool_block=args.pool_block,
+        prune_window_blocks=w, prune_chunk=args.prune_chunk,
+        band_spec="gaussian:1500:300")
+    pruned_k = engine.kernels
+    dense_k = kernel_set(
+        capacity=pruned_k.capacity, top_k=pruned_k.top_k,
+        pool_block=pruned_k.pool_block, glicko2=pruned_k.glicko2,
+        widen_per_sec=pruned_k.widen_per_sec,
+        max_threshold=pruned_k.max_threshold,
+        pair_rounds=pruned_k.pair_rounds)
+    cols = make_columns(rng, args.window, next_id, 0.0)
+    slots = engine.pool.allocate_columns(cols)
+    batch = engine.pool.batch_arrays_cols(cols, slots, args.window, 0.0)
+    packed = jnp.asarray(pack_batch(batch, 0.0))
+    base_pool = engine._dev_pool
+
+    # On-chip exactness: one step through each kernel from the same state.
+    import jax
+
+    p1, o1 = dense_k.search_step_packed(
+        jax.tree.map(jnp.copy, base_pool), packed)
+    p2, o2 = pruned_k.search_step_packed(
+        jax.tree.map(jnp.copy, base_pool), packed)
+    same_out = bool(jnp.array_equal(o1, o2, equal_nan=True))
+    same_pool = all(bool(jnp.array_equal(p1[f], p2[f])) for f in p1)
+    log(f"[prunecheck] outputs bit-identical: {same_out}, "
+        f"pool bit-identical: {same_pool} "
+        f"(B={args.window}, P={pruned_k.capacity}, "
+        f"blocks={pruned_k.n_blocks}, W={pruned_k.prune_window_blocks})")
+
+    for name, k in (("dense", dense_k), ("pruned", pruned_k)):
+        pool_dev = jax.tree.map(jnp.copy, base_pool)
+        pool_dev, out = k.search_step_packed(pool_dev, packed)
+        out.block_until_ready()
+        times = []
+        for rep in range(args.reps):
+            t0 = time.perf_counter()
+            outs = []
+            for _ in range(args.iters):
+                pool_dev, out = k.search_step_packed(pool_dev, packed)
+                outs.append(out)
+            outs[-1].block_until_ready()
+            times.append((time.perf_counter() - t0) / args.iters * 1e3)
+        log(f"[prunecheck {name}] ms/step min/med/max: "
+            f"{min(times):.3f}/{statistics.median(times):.3f}/{max(times):.3f}")
 
 
 def mode_dispatch(args):
@@ -211,7 +271,8 @@ def mode_sweep(args):
 
 def main():
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--mode", choices=("device", "dispatch", "window", "sweep"),
+    p.add_argument("--mode", choices=("device", "dispatch", "window", "sweep",
+                                      "prunecheck"),
                    default="device")
     p.add_argument("--pool", type=int, default=100_000)
     p.add_argument("--capacity", type=int, default=131_072)
@@ -223,12 +284,17 @@ def main():
     p.add_argument("--sweep-depths", default="1,2,3,4")
     p.add_argument("--readback-group", type=int, default=1,
                    help="device-side result grouping for window/sweep modes")
+    p.add_argument("--pool-block", type=int, default=8192)
+    p.add_argument("--prune-window-blocks", type=int, default=0,
+                   help="prunecheck: span width W (0 → mode default)")
+    p.add_argument("--prune-chunk", type=int, default=128)
     args = p.parse_args()
     import jax
 
     log(f"jax {jax.__version__} devices={jax.devices()}")
     dict(device=mode_device, dispatch=mode_dispatch,
-         window=mode_window, sweep=mode_sweep)[args.mode](args)
+         window=mode_window, sweep=mode_sweep,
+         prunecheck=mode_prunecheck)[args.mode](args)
 
 
 if __name__ == "__main__":
