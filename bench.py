@@ -576,8 +576,11 @@ def main() -> None:
                    help="sustained concurrent pool size (headline: 100k)")
     p.add_argument("--capacity", type=int, default=131_072)
     p.add_argument("--pool-block", type=int, default=8192)
-    p.add_argument("--window", type=int, default=2048,
-                   help="requests per timed search window")
+    p.add_argument("--window", type=int, default=4096,
+                   help="requests per timed search window (default from the "
+                        "round-4 sweep: (4096, depth 4, group 4) measured "
+                        "53-62k matches/s at the best p99 of the high-"
+                        "throughput points — BENCH_SWEEP.md §4)")
     p.add_argument("--windows", type=int, default=50,
                    help="measured windows")
     p.add_argument("--warmup", type=int, default=5)
@@ -587,12 +590,13 @@ def main() -> None:
     p.add_argument("--profile-dir", default="",
                    help="write a jax.profiler trace of the measured phase "
                         "(view with tensorboard/xprof)")
-    p.add_argument("--depth", type=int, default=8,
+    p.add_argument("--depth", type=int, default=4,
                    help="max in-flight windows. MUST be >= readback-group "
-                        "for groups to fill before the depth gate blocks; "
-                        "2x readback-group lets the next group's compute "
-                        "overlap the current group's transfer "
-                        "(BENCH_SWEEP.md §3)")
+                        "for groups to fill before the depth gate blocks. "
+                        "The round-4 sweep (BENCH_SWEEP.md §4) found depth "
+                        "beyond the group size only queues latency through "
+                        "the tunnel (dispatch RPCs stall behind transfer "
+                        "RPCs), so the default matches the group")
     p.add_argument("--readback-group", type=int, default=4,
                    help="stack k windows' results on device and transfer "
                         "them as ONE D2H. The tunnel's transfers are "
