@@ -247,12 +247,27 @@ class Config:
     @staticmethod
     def from_env(prefix: str = "MM_") -> "Config":
         """Env-var overrides of the flat scalar knobs (reference parity for
-        12-factor config; nested keys use ``MM_ENGINE_BACKEND`` style)."""
-        cfg = Config()
+        12-factor config; nested keys use ``MM_ENGINE_BACKEND`` style).
+
+        Two structural keys serve the multi-process supervisor
+        (service/multiproc.py) and are generally useful:
+
+        - ``MM_CONFIG_JSON=<path>`` — load the FULL config tree from a JSON
+          file first, then apply the other ``MM_*`` scalars on top (env
+          wins — the supervisor overrides per-worker backend/ports this
+          way).
+        - ``MM_QUEUE_NAMES=a,b`` — serve only the named queues from that
+          tree (a worker's partition).
+        """
         env = {k[len(prefix):].lower(): v for k, v in os.environ.items() if k.startswith(prefix)}
-        if not env:
-            return cfg
-        d: dict[str, Any] = {}
+        base: dict[str, Any] = {}
+        if "config_json" in env:
+            with open(env.pop("config_json")) as f:
+                base = json.load(f)
+        queue_names = env.pop("queue_names", None)
+        if not env and not base and queue_names is None:
+            return Config()
+        d: dict[str, Any] = base
         for key, raw in env.items():
             try:
                 val: Any = json.loads(raw)
@@ -267,7 +282,19 @@ class Config:
                 continue
             section, name = parts
             d.setdefault(section, {})[name] = val
-        return Config.from_dict(d)
+        cfg = Config.from_dict(d)
+        if queue_names is not None:
+            names = [n for n in str(queue_names).split(",") if n]
+            keep = tuple(q for q in cfg.queues if q.name in names)
+            missing = set(names) - {q.name for q in keep}
+            if missing:
+                raise KeyError(f"MM_QUEUE_NAMES not in config: {sorted(missing)}")
+            cfg = dataclasses.replace(cfg, queues=keep)
+        return cfg
+
+    def to_dict(self) -> dict[str, Any]:
+        """JSON-ready tree (inverse of from_dict; tuples become lists)."""
+        return dataclasses.asdict(self)
 
     def queue(self, name: str) -> QueueConfig:
         for q in self.queues:
