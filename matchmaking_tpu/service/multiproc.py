@@ -19,9 +19,12 @@ for the rebuild (SURVEY.md §2 "AMQP consumer", §5 "Failure detection"):
   engine. A TPU chip has one owning process; on multi-chip hosts, point
   more workers at devices via per-worker env overrides (``extra_env``).
 - **Supervision**: one_for_one restarts with exponential backoff and a
-  restart budget per worker (the reference's supervisor semantics): a
-  crashing worker is restarted with backoff; a worker that burns its budget
-  takes the whole supervisor down (fail fast — matches OTP max_restarts).
+  *time-windowed* restart intensity per worker (OTP's ``max_restarts``
+  within ``max_seconds``): a crashing worker is restarted with backoff; a
+  worker that crashes more than ``max_restarts`` times inside a sliding
+  ``restart_window_s`` takes the whole supervisor down (fail fast).
+  Crashes spaced out over a long healthy uptime fall out of the window and
+  do NOT accumulate toward the budget.
   The engines themselves already revive from the host mirror inside a
   worker (service/app.py); this layer covers whole-process death, where the
   broker's unacked deliveries are redelivered to the restarted worker.
@@ -35,6 +38,7 @@ not a fork: JAX backends and asyncio loops do not survive forking.
 
 from __future__ import annotations
 
+import atexit
 import json
 import logging
 import os
@@ -68,6 +72,9 @@ class _Worker:
     env: dict[str, str]
     proc: subprocess.Popen | None = None
     restarts: int = 0
+    #: monotonic timestamps of recent crashes — the sliding restart-intensity
+    #: window (OTP max_restarts/max_seconds, not a lifetime budget).
+    restart_times: list[float] = field(default_factory=list)
     #: monotonic deadline before which a restart must wait (backoff).
     next_start: float = 0.0
     backoff: float = 0.0
@@ -80,6 +87,7 @@ class WorkerSupervisor:
     def __init__(self, cfg: Config, workers: int, *,
                  device_worker: int = 0,
                  max_restarts: int = 5,
+                 restart_window_s: float = 60.0,
                  backoff_initial_s: float = 0.5,
                  backoff_max_s: float = 30.0,
                  extra_env: dict[int, dict[str, str]] | None = None,
@@ -87,18 +95,29 @@ class WorkerSupervisor:
         """``command`` overrides the child argv (tests use stubs); the
         default runs the ordinary serve entrypoint in a fresh interpreter.
         ``extra_env[i]`` adds/overrides env for worker i (e.g. a device
-        pinning for multi-chip hosts)."""
+        pinning for multi-chip hosts). The supervisor fails fast only when
+        a worker crashes more than ``max_restarts`` times within a sliding
+        ``restart_window_s`` (OTP restart intensity)."""
         self.cfg = cfg
         self.max_restarts = max_restarts
+        self.restart_window_s = restart_window_s
         self.backoff_initial_s = backoff_initial_s
         self.backoff_max_s = backoff_max_s
         self._stopping = False
         self._cfg_path: str | None = None
 
         names = [q.name for q in cfg.queues]
+        if not names:
+            raise ValueError("config has no queues: a zero-worker "
+                             "supervisor would idle forever")
         if len(set(names)) != len(names):
             raise ValueError("queue names must be unique for partitioning")
         parts = partition_queues(names, workers)
+        if cfg.engine.backend != "cpu" and not (0 <= device_worker < len(parts)):
+            log.warning(
+                "device_worker=%d is outside the %d collapsed partitions: "
+                "NO worker keeps engine backend %r — all run cpu",
+                device_worker, len(parts), cfg.engine.backend)
         if command is None:
             command = [sys.executable, "-m", "matchmaking_tpu.service.app",
                        "serve"]
@@ -108,6 +127,9 @@ class WorkerSupervisor:
                                               suffix=".json")
         with os.fdopen(fd, "w") as f:
             json.dump(cfg.to_dict(), f)
+        # stop() is the normal cleanup path; atexit covers abnormal exits
+        # (exception before run()'s finally) so the snapshot never leaks.
+        atexit.register(self._cleanup_snapshot)
 
         self.workers: list[_Worker] = []
         for i, qnames in enumerate(parts):
@@ -134,7 +156,8 @@ class WorkerSupervisor:
 
     def poll(self) -> None:
         """One supervision pass: restart dead workers whose backoff expired;
-        raise RuntimeError when a worker exhausts its restart budget."""
+        raise RuntimeError when a worker exceeds the restart intensity
+        (``max_restarts`` crashes within ``restart_window_s``)."""
         now = time.monotonic()
         for w in self.workers:
             if w.proc is not None and w.proc.poll() is None:
@@ -143,15 +166,23 @@ class WorkerSupervisor:
             if w.proc is not None:
                 w.proc = None
                 w.restarts += 1
+                w.restart_times.append(now)
+                w.restart_times = [t for t in w.restart_times
+                                   if now - t <= self.restart_window_s]
+                recent = len(w.restart_times)
                 w.backoff = min(self.backoff_max_s,
-                                self.backoff_initial_s * (2 ** (w.restarts - 1)))
+                                self.backoff_initial_s * (2 ** (recent - 1)))
                 w.next_start = now + w.backoff
-                log.warning("worker %d exited rc=%s; restart %d/%d in %.1fs",
-                            w.idx, rc, w.restarts, self.max_restarts,
-                            w.backoff)
-            if w.restarts > self.max_restarts:
-                raise RuntimeError(
-                    f"worker {w.idx} exceeded {self.max_restarts} restarts")
+                log.warning(
+                    "worker %d exited rc=%s; restart %d in window/%d "
+                    "(lifetime %d) in %.1fs", w.idx, rc, recent,
+                    self.max_restarts, w.restarts, w.backoff)
+                # OTP restart intensity: fail fast only on crashes
+                # clustered inside the window, not a lifetime total.
+                if recent > self.max_restarts:
+                    raise RuntimeError(
+                        f"worker {w.idx} exceeded {self.max_restarts} "
+                        f"restarts within {self.restart_window_s:.0f}s")
             if now >= w.next_start:
                 self._spawn(w)
 
@@ -192,6 +223,10 @@ class WorkerSupervisor:
                 log.error("worker %d ignored SIGTERM; killing", w.idx)
                 w.proc.kill()
                 w.proc.wait()
+        self._cleanup_snapshot()
+
+    def _cleanup_snapshot(self) -> None:
+        atexit.unregister(self._cleanup_snapshot)
         if self._cfg_path:
             try:
                 os.unlink(self._cfg_path)
@@ -216,12 +251,16 @@ def main(argv: list[str] | None = None) -> None:
                    help="worker index that keeps the configured engine "
                         "backend (others run the CPU engine)")
     p.add_argument("--max-restarts", type=int, default=5)
+    p.add_argument("--restart-window-s", type=float, default=60.0,
+                   help="sliding window for the restart intensity: fail "
+                        "fast only on > max-restarts crashes within it")
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     cfg = Config.from_env()
     sup = WorkerSupervisor(cfg, args.workers,
                            device_worker=args.device_worker,
-                           max_restarts=args.max_restarts)
+                           max_restarts=args.max_restarts,
+                           restart_window_s=args.restart_window_s)
     log.info("supervising %d workers over %d queues", len(sup.workers),
              len(cfg.queues))
     sup.run()

@@ -56,6 +56,17 @@ def _cfg(n_queues=4, backend="cpu", **kw):
                   engine=EngineConfig(backend=backend), **kw)
 
 
+def _fast_children(sup):
+    """Strip the axon TPU-relay dial from worker envs: the sitecustomize
+    hook adds seconds to EVERY child interpreter start when
+    PALLAS_AXON_POOL_IPS is set, which turns crash-loop timing tests into
+    flakes. (The real serve-boot test does the same.)"""
+    for w in sup.workers:
+        w.env.pop("PALLAS_AXON_POOL_IPS", None)
+        w.env["JAX_PLATFORMS"] = "cpu"
+    return sup
+
+
 def test_supervisor_env_partitioning():
     sup = WorkerSupervisor(_cfg(5, backend="tpu", metrics_port=9200), 2,
                            command=["true"])
@@ -79,10 +90,11 @@ def test_supervisor_env_partitioning():
 
 def test_supervisor_restarts_with_budget():
     """A crash-looping worker is restarted with growing backoff, then the
-    supervisor fails fast once the budget is burned (OTP max_restarts)."""
-    sup = WorkerSupervisor(
+    supervisor fails fast once the restart intensity is exceeded (OTP
+    max_restarts within max_seconds; here all crashes land in one window)."""
+    sup = _fast_children(WorkerSupervisor(
         _cfg(1), 1, max_restarts=2, backoff_initial_s=0.01,
-        command=[sys.executable, "-c", "import sys; sys.exit(3)"])
+        command=[sys.executable, "-c", "import sys; sys.exit(3)"]))
     try:
         sup.start()
         deadline = time.monotonic() + 10.0
@@ -95,6 +107,44 @@ def test_supervisor_restarts_with_budget():
         assert w.backoff >= 0.02                   # exponential growth
     finally:
         sup.stop()
+
+
+def test_restart_window_forgives_spaced_crashes():
+    """Crashes spaced wider than the sliding window never trip the budget:
+    the worker keeps being revived even after far more than max_restarts
+    lifetime crashes (the OTP intensity semantics, not a lifetime cap)."""
+    sup = _fast_children(WorkerSupervisor(
+        _cfg(1), 1, max_restarts=1, restart_window_s=0.05,
+        backoff_initial_s=0.1,   # backoff > window => crashes never cluster
+        command=[sys.executable, "-c", "import sys; sys.exit(3)"]))
+    try:
+        sup.start()
+        deadline = time.monotonic() + 1.5
+        while time.monotonic() < deadline:
+            sup.poll()                             # must never raise
+            time.sleep(0.02)
+        assert sup.workers[0].restarts > 1         # lifetime total exceeded
+    finally:
+        sup.stop()
+
+
+def test_empty_queue_config_rejected():
+    with pytest.raises(ValueError, match="no queues"):
+        WorkerSupervisor(Config(queues=(), engine=EngineConfig()), 2,
+                         command=["true"])
+
+
+def test_device_worker_out_of_range_warns(caplog):
+    """device_worker beyond the collapsed partition list means NO process
+    keeps the accelerator backend — the supervisor must say so."""
+    import logging
+    with caplog.at_level(logging.WARNING,
+                         logger="matchmaking_tpu.service.multiproc"):
+        sup = WorkerSupervisor(_cfg(2, backend="tpu"), 2, device_worker=7,
+                               command=["true"])
+        sup.stop()
+    assert any("device_worker=7" in r.message for r in caplog.records)
+    assert all(w.env.get("MM_ENGINE_BACKEND") == "cpu" for w in sup.workers)
 
 
 def test_supervisor_healthy_worker_not_restarted():
