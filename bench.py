@@ -277,12 +277,15 @@ def roofline(engine, rng: np.random.Generator, *, window: int,
     pool_bytes = sum(x.nbytes for x in jax.tree.leaves(pool_dev))
     step_bytes = pool_bytes + packed.nbytes
     k = engine.kernels
-    pool_dev, out = k.search_step_packed(pool_dev, packed)  # warm/compile
+    # Same compiled variant the engine's hot path would pick for this
+    # window (the all-ANY no-filter variant for the bench's requests).
+    step = engine._step_fn(batch)
+    pool_dev, out = step(pool_dev, packed)  # warm/compile
     out.block_until_ready()
     t0 = time.perf_counter()
     outs = []
     for _ in range(iters):
-        pool_dev, out = k.search_step_packed(pool_dev, packed)
+        pool_dev, out = step(pool_dev, packed)
         outs.append(out)
     outs[-1].block_until_ready()
     dt = (time.perf_counter() - t0) / iters
@@ -420,7 +423,8 @@ def bench_e2e(args) -> dict:
                 pool_block=args.pool_block,
                 batch_buckets=(16, 64, 256, args.window), top_k=8,
                 pipeline_depth=args.depth,
-                readback_group=args.readback_group),
+                readback_group=args.readback_group,
+                warm_start=True),
             batcher=BatcherConfig(max_batch=args.window, max_wait_ms=3.0),
             broker=BrokerConfig(prefetch=max(8 * args.window, 4096)),
         )

@@ -118,6 +118,14 @@ class EngineConfig:
     #: transferred anyway (checked on every collect_ready poll) — bounds
     #: the extra latency grouping can add when traffic pauses mid-group.
     readback_group_wait_ms: float = 8.0
+    #: Compile every (batch bucket × step variant) executable at app start
+    #: (Engine.warmup) instead of lazily on first use. The engine ships TWO
+    #: compiled 1v1 step variants (full and all-ANY-window, see
+    #: kernels.KernelSet); without warmup the first window that needs the
+    #: OTHER variant stalls on an XLA compile inline on the serving path —
+    #: the recompile cliff the bucketing exists to prevent. Off by default
+    #: (tests build many small engines; serve/bench turn it on).
+    warm_start: bool = False
     #: Rating-banded candidate pruning (single-device 1v1 path). 0 = dense
     #: scoring of every pool block. N > 0: each rating-sorted window chunk
     #: scores only an N-block contiguous span of the pool chosen from live

@@ -65,6 +65,14 @@ class CpuEngine(Engine):
     def waiting(self) -> list[SearchRequest]:
         return list(self._entries)
 
+    def has_wildcards(self) -> bool:
+        """True if any waiting player carries an ANY region/mode — the
+        TpuEngine re-promotion gate (a wildcard-free pool is safe to move
+        back to the device kernel's exact-group semantics). O(waiting)
+        attribute scan, no request materialization."""
+        return any(r.region == ANY or r.game_mode == ANY
+                   for r in self._entries)
+
     def restore(self, requests: Sequence[SearchRequest], now: float) -> None:
         for req in requests:
             if req.id not in self._by_id:

@@ -132,6 +132,12 @@ class Engine(abc.ABC):
     # The host-side request log is the authoritative pool state; device state
     # is a pure function of it, so checkpoint = serialize waiting requests.
 
+    def warmup(self) -> None:
+        """Pre-compile every executable the serving path can reach (no-op
+        for host engines). Called by the app at start when
+        ``EngineConfig.warm_start`` is set, so no first-of-its-kind window
+        pays an XLA compile inline on the hot path."""
+
     @abc.abstractmethod
     def waiting(self) -> list[SearchRequest]:
         """Snapshot of the waiting pool (checkpoint payload)."""

@@ -84,21 +84,24 @@ _pair_distance = scoring.distance
 
 
 def _admit_block(pool_block: dict[str, Any], start, blk: int,
-                 batch: dict[str, Any]) -> dict[str, Any]:
+                 batch: dict[str, Any], eq=None) -> dict[str, Any]:
     """Admission into one pool block, scatter-free.
 
     ``eq`` is the (blk, B) equality matrix between block positions and the
     window's slot ids (padding lanes carry the sentinel capacity ⇒ never
-    equal). Each real slot is unique, so ``eq @ vals`` selects exactly the
-    admitted lane's values; int fields round-trip through f32 exactly
-    (interner codes ≪ 2^24). Precision must be HIGHEST: the TPU MXU's
-    DEFAULT f32 matmul multiplies in bf16, which would round admitted
-    ratings to ~8-bit mantissa (±4 ELO at 1500 — corrupts matching near the
-    threshold); with HIGHEST the 0/1 × value products are exact and each
-    output row has exactly one nonzero term, so the select is bit-exact.
+    equal); the hot-path scan passes it in so the SAME compare also serves
+    scoring's self-mask (one B×P pass instead of two). Each real slot is
+    unique, so ``eq @ vals`` selects exactly the admitted lane's values;
+    int fields round-trip through f32 exactly (interner codes ≪ 2^24).
+    Precision must be HIGHEST: the TPU MXU's DEFAULT f32 matmul multiplies
+    in bf16, which would round admitted ratings to ~8-bit mantissa (±4 ELO
+    at 1500 — corrupts matching near the threshold); with HIGHEST the
+    0/1 × value products are exact and each output row has exactly one
+    nonzero term, so the select is bit-exact.
     """
     pos = start + jnp.arange(blk, dtype=jnp.int32)
-    eq = batch["slot"][None, :] == pos[:, None]
+    if eq is None:
+        eq = batch["slot"][None, :] == pos[:, None]
     hit = eq.any(axis=1)
     vals = jnp.stack(
         [batch[f].astype(jnp.float32) for f in _ADMIT_FIELDS], axis=1)
@@ -192,6 +195,17 @@ def greedy_pair(vals, idxs, self_slot, capacity: int, rounds: int = 8,
     pruned step runs pairing over a rating-SORTED window; passing the
     original lane ids keeps exact-tie resolution identical to the dense
     step, so sorting cannot change which edge wins a conflict.
+
+    The loop exits as soon as no live proposal remains (every row matched,
+    dead, or out of candidates). Early exit is output-exact: a round with
+    no live proposal forms no match and changes no state, so skipping the
+    remaining rounds returns bit-identical results — and pairing typically
+    converges in ~3 rounds at the bench operating point (measured round-5:
+    4096-window vs 100k pool forms 97% of its matches in round 1), so the
+    default 8-round budget mostly buys no-op rounds at ~0.5 ms each. The
+    exit predicate is data-dependent but replicated-consistent: the sharded
+    engine runs this on identical merged candidates on every shard, so all
+    shards take the same trip count.
     """
     b, k = vals.shape
     cap = jnp.int32(capacity)
@@ -199,8 +213,12 @@ def greedy_pair(vals, idxs, self_slot, capacity: int, rounds: int = 8,
         rid = jnp.arange(b, dtype=jnp.int32)
     not_diag = ~jnp.eye(b, dtype=bool)
 
-    def body(_, state):
-        row_dead, cand_dead, out_q, out_c, out_d = state
+    def cond(state):
+        r, live_any, *_ = state
+        return (r < rounds) & live_any
+
+    def body(state):
+        r, _, row_dead, cand_dead, out_q, out_c, out_d = state
         masked = jnp.where(cand_dead | row_dead[:, None], _NEG_INF, vals)
         bj = jnp.argmax(masked, axis=1)
         bv = jnp.take_along_axis(masked, bj[:, None], axis=1)[:, 0]
@@ -233,16 +251,21 @@ def greedy_pair(vals, idxs, self_slot, capacity: int, rounds: int = 8,
         row_dead = (row_dead
                     | (self_slot[:, None] == wq[None, :]).any(-1)
                     | (self_slot[:, None] == wc[None, :]).any(-1))
-        return row_dead, cand_dead, out_q, out_c, out_d
+        # Liveness for the NEXT round: any candidate still proposable.
+        live_any = (jnp.where(cand_dead | row_dead[:, None], _NEG_INF, vals)
+                    > _NEG_INF).any()
+        return r + 1, live_any, row_dead, cand_dead, out_q, out_c, out_d
 
     init = (
+        jnp.int32(0),
+        jnp.bool_(True),
         jnp.zeros(b, jnp.bool_),
         jnp.zeros((b, k), jnp.bool_),
         jnp.full(b, capacity, jnp.int32),
         jnp.full(b, capacity, jnp.int32),
         jnp.full(b, jnp.inf, jnp.float32),
     )
-    _, _, out_q, out_c, out_d = lax.fori_loop(0, rounds, body, init)
+    _, _, _, _, out_q, out_c, out_d = lax.while_loop(cond, body, init)
     return out_q, out_c, out_d
 
 
@@ -289,14 +312,25 @@ class KernelSet:
             donate_argnums=0)
         self.search_step_packed = jax.jit(self._search_step_packed,
                                           donate_argnums=0)
+        # All-ANY-window variant: identical outputs when no window lane
+        # carries a region/mode constraint (see _score_block); ~40% fewer
+        # per-cell mask ops in the dominant score scan. The engine selects
+        # per window on the host. Each variant compiles on first use; a
+        # deployment that must never pay that stall mid-serving sets
+        # EngineConfig.warm_start, which compiles BOTH variants for every
+        # bucket at app start (TpuEngine.warmup).
+        self.search_step_packed_nofilter = jax.jit(
+            functools.partial(self._search_step_packed, skip_filters=True),
+            donate_argnums=0)
 
-    def _search_step_packed(self, pool, packed):
+    def _search_step_packed(self, pool, packed, skip_filters: bool = False):
         """Packed window step: batch rows per pool.PACKED_ROWS plus a 9th row
         whose [0] element is the rebased ``now`` scalar; output stacks
         (q_slot, c_slot, dist) as f32[3, B] (slot ids ≪ 2^24 are f32-exact)."""
         batch = unpack_batch(packed)
         now = packed[8, 0]
-        pool, out_q, out_c, out_d = self._step_impl(pool, batch, now)
+        pool, out_q, out_c, out_d = self._step_impl(pool, batch, now,
+                                                    skip_filters)
         out = jnp.stack([out_q.astype(jnp.float32),
                          out_c.astype(jnp.float32), out_d])
         return pool, out
@@ -331,11 +365,18 @@ class KernelSet:
     # ---- scoring ----------------------------------------------------------
 
     def _score_block(self, batch: dict[str, Any], q_thr_eff, block: dict[str, Any],
-                     start, now):
+                     start, now, skip_filters: bool = False, not_self=None):
         """Masked scores of the window vs one pool block: f32[B, block].
 
         Block width comes from the arrays (not ``self.pool_block``): the
-        pruned step scores window chunks against W-block spans in one call."""
+        pruned step scores window chunks against W-block spans in one call.
+
+        ``skip_filters`` (static) drops the region/mode mask math — the
+        B×blk compare/or chains are ~40% of the per-cell ops. Bit-exact
+        whenever every WINDOW lane carries the ANY wildcard (code 0):
+        ``(q==0) | ...`` is then identically true regardless of pool
+        contents, so the masks it skips were all-ones. The engine checks
+        the window on the host and picks the matching compiled variant."""
         blk = block["rating"].shape[0]
         d = _pair_distance(
             batch["rating"][:, None], block["rating"][None, :],
@@ -345,18 +386,20 @@ class KernelSet:
                                          now, self.widen_per_sec, self.max_threshold)
         limit = jnp.minimum(q_thr_eff[:, None], c_thr_eff[None, :])
 
-        q_reg, q_mod = batch["region"][:, None], batch["mode"][:, None]
-        c_reg, c_mod = block["region"][None, :], block["mode"][None, :]
-        region_ok = (q_reg == 0) | (c_reg == 0) | (q_reg == c_reg)
-        mode_ok = (q_mod == 0) | (c_mod == 0) | (q_mod == c_mod)
-
-        global_idx = start + jnp.arange(blk, dtype=jnp.int32)
-        not_self = batch["slot"][:, None] != global_idx[None, :]
+        if not_self is None:
+            global_idx = start + jnp.arange(blk, dtype=jnp.int32)
+            not_self = batch["slot"][:, None] != global_idx[None, :]
 
         valid = (
             block["active"][None, :] & batch["valid"][:, None]
-            & region_ok & mode_ok & not_self & (d <= limit)
+            & not_self & (d <= limit)
         )
+        if not skip_filters:
+            q_reg, q_mod = batch["region"][:, None], batch["mode"][:, None]
+            c_reg, c_mod = block["region"][None, :], block["mode"][None, :]
+            region_ok = (q_reg == 0) | (c_reg == 0) | (q_reg == c_reg)
+            mode_ok = (q_mod == 0) | (c_mod == 0) | (q_mod == c_mod)
+            valid = valid & region_ok & mode_ok
         return jnp.where(valid, -d, _NEG_INF)
 
     @staticmethod
@@ -375,7 +418,7 @@ class KernelSet:
         return scores.max(axis=1), jnp.argmax(scores, axis=1)
 
     def _candidates(self, batch: dict[str, Any], q_thr_eff,
-                    pool: dict[str, Any], now):
+                    pool: dict[str, Any], now, skip_filters: bool = False):
         """Best-per-block candidate lists: (vals f32[B, n_blocks],
         idx i32[B, n_blocks]), fully fused (no score materialization).
 
@@ -389,7 +432,8 @@ class KernelSet:
             start = blk_i * blk
             block = {f: lax.dynamic_slice_in_dim(pool[f], start, blk)
                      for f in (*_ADMIT_FIELDS, "active")}
-            scores = self._score_block(batch, q_thr_eff, block, start, now)
+            scores = self._score_block(batch, q_thr_eff, block, start, now,
+                                       skip_filters)
             v, i = self._block_best(scores)
             return None, (v, (i + start).astype(jnp.int32))
 
@@ -406,7 +450,8 @@ class KernelSet:
 
     # ---- the full step ----------------------------------------------------
 
-    def _search_step(self, pool: dict[str, Any], batch: dict[str, Any], now):
+    def _search_step(self, pool: dict[str, Any], batch: dict[str, Any], now,
+                     skip_filters: bool = False):
         """One window: fused admit+score+top-k pass → pair → evict matched.
 
         Returns (pool', q_slot[B], c_slot[B], dist[B]) with sentinel P /
@@ -431,8 +476,11 @@ class KernelSet:
             start = blk_i * blk
             block = {f: lax.dynamic_slice_in_dim(pool[f], start, blk)
                      for f in (*_ADMIT_FIELDS, "active")}
-            block = _admit_block(block, start, blk, batch)
-            scores = self._score_block(batch, q_thr_eff, block, start, now)
+            pos = start + jnp.arange(blk, dtype=jnp.int32)
+            eq = batch["slot"][None, :] == pos[:, None]     # (blk, B)
+            block = _admit_block(block, start, blk, batch, eq=eq)
+            scores = self._score_block(batch, q_thr_eff, block, start, now,
+                                       skip_filters, not_self=~eq.T)
             v, i = self._block_best(scores)
             return None, (block, v, (i + start).astype(jnp.int32))
 
@@ -578,7 +626,8 @@ class KernelSet:
         dstart = jnp.clip(jnp.minimum(first, nb - w), 0, nb - w)
         return dstart.astype(jnp.int32), feasible
 
-    def _candidates_pruned(self, sb, q_thr_eff, pool, now, dstart):
+    def _candidates_pruned(self, sb, q_thr_eff, pool, now, dstart,
+                           skip_filters: bool = False):
         """Best-per-block candidates, scoring only each chunk's W-block span.
 
         Output shape/content identical to _candidates on the sorted batch:
@@ -596,7 +645,8 @@ class KernelSet:
                      for f in (*_ADMIT_FIELDS, "active")}
             cb = {f: lax.dynamic_slice_in_dim(sb[f], j * c, c) for f in sb}
             qte = lax.dynamic_slice_in_dim(q_thr_eff, j * c, c)
-            scores = self._score_block(cb, qte, wpool, ds, now)  # (c, w·blk)
+            scores = self._score_block(cb, qte, wpool, ds, now,
+                                       skip_filters)       # (c, w·blk)
             sc = scores.reshape(c, w, blk)
             v = sc.max(-1)
             gi = (ds + jnp.arange(w, dtype=jnp.int32)[None, :] * blk
@@ -613,7 +663,7 @@ class KernelSet:
         return cvs.reshape(b, nb), cis.reshape(b, nb)
 
     def _search_step_pruned(self, pool: dict[str, Any], batch: dict[str, Any],
-                            now):
+                            now, skip_filters: bool = False):
         """Bit-exact pruned window step (see the section comment above)."""
         b = batch["rating"].shape[0]
         blk = self.pool_block
@@ -626,8 +676,9 @@ class KernelSet:
         dstart, feasible = self._chunk_windows(sb, qte, bmin, bmax, brd)
         vals, idxs = lax.cond(
             feasible,
-            lambda: self._candidates_pruned(sb, qte, pool, now, dstart),
-            lambda: self._candidates(sb, qte, pool, now),
+            lambda: self._candidates_pruned(sb, qte, pool, now, dstart,
+                                            skip_filters),
+            lambda: self._candidates(sb, qte, pool, now, skip_filters),
         )
         s_q, s_c, s_d = greedy_pair(vals, idxs, sb["slot"], self.capacity,
                                     self.pair_rounds, rid=oi)

@@ -826,7 +826,10 @@ class MatchmakingApp:
         assert not self._started
         for queue_cfg in self.cfg.queues:
             self.broker.declare_queue(queue_cfg.name)
-            self._runtimes[queue_cfg.name] = _QueueRuntime(self, queue_cfg)
+            rt = _QueueRuntime(self, queue_cfg)
+            self._runtimes[queue_cfg.name] = rt
+            if self.cfg.engine.warm_start:
+                rt.engine.warmup()
         if self.cfg.metrics_port:
             from matchmaking_tpu.service.observability import ObservabilityServer
 

@@ -66,6 +66,15 @@ class ObservabilityServer:
             for name, rt in self.app._runtimes.items()
             if hasattr(rt.engine, "span_report")
         }
+        # Engine lifecycle counters (e.g. team_delegated/team_repromoted:
+        # the wildcard delegation round-trip must be visible, not silent).
+        counters = {
+            name: dict(rt.engine.counters)
+            for name, rt in self.app._runtimes.items()
+            if getattr(rt.engine, "counters", None)
+        }
+        if counters:
+            report["engine_counters"] = counters
         return report
 
     async def _healthz(self, request) -> "web.Response":

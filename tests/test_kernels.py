@@ -285,3 +285,86 @@ def test_conflict_loser_falls_back_to_other_block():
     got = {(int(a), int(b)) for a, b in zip(q, c) if a < 256}
     assert len(got) == 2                       # both queries matched
     assert {p[1] for p in got} == {10, 200}    # winner got 10, loser got 200
+
+
+def test_nofilter_variant_bit_exact_on_any_window(rng):
+    """The all-ANY compiled variant (region/mode masks compiled out) must
+    produce bit-identical pool state and outputs to the full step whenever
+    NO WINDOW lane carries a filter — even when POOL candidates do carry
+    nonzero region/mode codes (an all-ANY query matches any of them)."""
+    from matchmaking_tpu.core.pool import PACKED_ROWS
+
+    ks = make_kernels(capacity=256, pool_block=64)
+    pool = empty_pool()
+    # Seed the pool with filtered players (nonzero codes) via a first step.
+    seed = make_batch(list(range(8)), rng.normal(1500, 50, 8), bucket=16,
+                      capacity=256, regions=[1, 2, 1, 2, 1, 2, 1, 2],
+                      modes=[1, 1, 2, 2, 1, 1, 2, 2],
+                      thresholds=[1.0] * 8)   # too tight to match each other
+    pool, *_ = run_step(ks, pool, seed)
+    assert int(np.asarray(pool["active"]).sum()) == 8
+
+    # All-ANY window against that pool, through BOTH compiled variants.
+    win = make_batch([20, 21, 22], rng.normal(1500, 50, 3), bucket=16,
+                     capacity=256, thresholds=[200.0] * 3)
+    packed = np.zeros((len(PACKED_ROWS) + 1, 16), np.float32)
+    for i, name in enumerate(PACKED_ROWS):
+        packed[i] = np.asarray(win[name])
+    pa = jnp.asarray(packed)
+    pool_a = {k: v.copy() for k, v in pool.items()}
+    pool_b = {k: v.copy() for k, v in pool.items()}
+    pool_a, out_a = ks.search_step_packed(pool_a, pa)
+    pool_b, out_b = ks.search_step_packed_nofilter(pool_b, jnp.asarray(packed))
+    assert (np.asarray(out_a) == np.asarray(out_b)).all()
+    for k in pool_a:
+        assert (np.asarray(pool_a[k]) == np.asarray(pool_b[k])).all(), k
+
+
+def test_engine_selects_nofilter_variant_per_window():
+    """TpuEngine._step_fn: all-ANY windows take the no-filter executable;
+    any window lane with a region or mode falls back to the full one."""
+    from matchmaking_tpu.config import Config, EngineConfig, QueueConfig
+    from matchmaking_tpu.engine.interface import make_engine
+
+    cfg = Config(queues=(QueueConfig(rating_threshold=80.0),),
+                 engine=EngineConfig(backend="tpu", pool_capacity=256,
+                                     pool_block=64, batch_buckets=(16,)))
+    engine = make_engine(cfg, cfg.queues[0])
+    any_b = make_batch([0], [1500.0], bucket=16, capacity=256)
+    any_np = {k: np.asarray(v) for k, v in any_b.items()}
+
+    class _B:  # minimal batch view (engine checks .region / .mode)
+        region = any_np["region"]
+        mode = any_np["mode"]
+
+    assert engine._step_fn(_B) is engine.kernels.search_step_packed_nofilter
+
+    class _F:
+        region = np.array([3, 0, 0], np.int32)
+        mode = np.zeros(3, np.int32)
+
+    assert engine._step_fn(_F) is engine.kernels.search_step_packed
+
+
+def test_greedy_pair_early_exit_matches_full_rounds(rng):
+    """greedy_pair under heavy contention (many rows sharing best
+    candidates — the regime that exercises several proposal rounds before
+    the early exit fires) equals the NumPy mirror. Note both sides stop
+    when no live proposal remains (the mirror breaks on empty ``props``),
+    which is the exactness argument itself: a proposal-free round changes
+    no state, so stopping there cannot alter outputs."""
+    from matchmaking_tpu.engine.kernels import greedy_pair
+
+    P, B, K = 512, 64, 4
+    vals = np.where(rng.random((B, K)) < 0.3, -np.inf,
+                    -np.abs(rng.normal(0, 30, (B, K)))).astype(np.float32)
+    idxs = rng.integers(0, 40, (B, K)).astype(np.int32)   # heavy contention
+    idxs = np.where(vals > -np.inf, idxs, P)
+    slot = (100 + rng.permutation(B)).astype(np.int32)
+    q, c, d = greedy_pair(jnp.asarray(vals), jnp.asarray(idxs),
+                          jnp.asarray(slot), P, rounds=8)
+    oq, oc, od = np_greedy_pair(vals, idxs, slot, P, rounds=8)
+    assert (np.asarray(q) == oq).all()
+    assert (np.asarray(c) == oc).all()
+    d, od = np.asarray(d), od.astype(np.float32)
+    assert ((d == od) | (np.isinf(d) & np.isinf(od))).all()

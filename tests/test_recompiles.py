@@ -62,3 +62,30 @@ def test_zero_recompiles_after_buckets_warm(rng):
     assert CompileCounter.count() == warm, (
         f"hot-path recompiles: {CompileCounter.count() - warm} new XLA "
         f"compiles after all buckets were warm")
+
+
+def test_warm_start_precompiles_both_variants(rng):
+    """With EngineConfig.warm_start, warmup() compiles BOTH step variants
+    for every bucket: a first filtered window after all-ANY warm traffic
+    (and vice versa) must not trigger a new XLA compile."""
+    cfg = Config(
+        queues=(QueueConfig(rating_threshold=80.0),),
+        engine=EngineConfig(backend="tpu", pool_capacity=512, pool_block=128,
+                            batch_buckets=(16, 64), top_k=4,
+                            warm_start=True),
+    )
+    engine = make_engine(cfg, cfg.queues[0])
+    engine.warmup()
+    warm = CompileCounter.count()
+    assert warm > 0
+
+    # All-ANY window, then a region-filtered window, both buckets.
+    engine.search(_reqs(rng, 10, 0), now=1.0)
+    filtered = [SearchRequest(id=f"f{i}", rating=float(rng.normal(1500, 50)),
+                              region="eu", enqueued_at=2.0)
+                for i in range(20)]
+    engine.search(filtered, now=2.0)
+    engine.expire(now=1e9, timeout=1.0)
+    engine.restore(_reqs(rng, 5, 100), now=3.0)
+    assert CompileCounter.count() == warm, (
+        f"{CompileCounter.count() - warm} compiles leaked past warmup")

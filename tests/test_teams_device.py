@@ -359,3 +359,99 @@ def test_wildcard_delegation_with_window_in_flight():
                  | {r.id for m in out.matches for t in m.teams for r in t}
                  | {r.id for r in engine.waiting()})
     assert {"p20", "p21"} <= all_known
+
+
+def test_wildcard_queue_repromotes_after_drain(caplog):
+    """Round-trip: a wildcard burst delegates the device team queue to the
+    host oracle; once the delegate pool drains of wildcards AND the quiet
+    period passes, the queue promotes back to the device path (waiting
+    players transferred, counters recording both transitions) — one stray
+    wildcard no longer downgrades the queue forever."""
+    import logging
+
+    cfg = _team_cfg(2)
+    tpu = make_engine(cfg, cfg.queues[0])
+    out = tpu.search([_req(0, 1500, region="*")], now=0.0)
+    assert not out.matches and tpu._team_delegate is not None
+    assert tpu.counters["team_delegated"] == 1
+
+    # Concrete arrival inside the quiet period: stays delegated (no scan).
+    tpu.search([_req(1, 1510, region="eu")], now=1.0)
+    assert tpu._team_delegate is not None
+
+    # Cancel the wildcard; pool now wildcard-free but the quiet period
+    # since the last wildcard sighting (delegation, now=0) must elapse.
+    assert tpu.remove("p0") is not None
+    assert tpu.pool_size() == 1
+
+    with caplog.at_level(logging.INFO, logger="matchmaking_tpu.engine.tpu"):
+        out = tpu.search([_req(2, 1512, region="eu")], now=6.0)
+    assert tpu._team_delegate is None                   # promoted back
+    assert tpu.counters["team_repromoted"] == 1
+    assert tpu.pool_size() == 2                         # p1 transferred + p2
+    assert any("promoted back" in r.message for r in caplog.records)
+
+    # The device path is live again: a full 2v2 forms from the 4 players.
+    out = tpu.search([_req(3, 1514, region="eu"), _req(4, 1516, region="eu")],
+                     now=6.5)
+    assert len(out.matches) == 1
+    ids = {p.id for t in out.matches[0].teams for p in t}
+    assert ids == {"p1", "p2", "p3", "p4"}
+    assert tpu.pool_size() == 0
+
+
+def test_wildcard_queue_stays_delegated_while_wildcards_wait():
+    """Re-promotion must be gated on the POOL being wildcard-free, not just
+    on traffic: a waiting wildcard player after the quiet period keeps the
+    queue on the oracle (the device kernel can't serve them), and the
+    authoritative scan re-arms the quiet period instead of thrashing."""
+    import dataclasses
+
+    cfg = _team_cfg(2)
+    tpu = make_engine(cfg, cfg.queues[0])
+    # Nonzero enqueue times: the expire sweep treats 0.0 as "no timestamp".
+    wc = dataclasses.replace(_req(0, 1500, region="*"), enqueued_at=0.5)
+    eu = dataclasses.replace(_req(1, 1510, region="eu"), enqueued_at=0.5)
+    tpu.search([wc], now=1.0)
+    assert tpu._team_delegate is not None
+    # Quiet period elapsed, but p0 (wildcard) still waits → no promotion.
+    tpu.search([eu], now=10.0)
+    assert tpu._team_delegate is not None
+    assert tpu.counters.get("team_repromoted", 0) == 0
+    # expire() drains everyone (incl. the wildcard); the SAME call then
+    # promotes: the quiet clock last re-armed at the now=10 scan, so by
+    # now=100 the period has elapsed and the post-expiry scan finds a
+    # wildcard-free pool.
+    tpu.expire(now=100.0, timeout=10.0)
+    assert tpu.pool_size() == 0
+    assert tpu._team_delegate is None
+    assert tpu.counters["team_repromoted"] == 1
+
+
+def test_repromote_deferred_when_pool_exceeds_device_capacity():
+    """The oracle pool is unbounded; the device pool is not. Promotion with
+    more waiting players than kernels.capacity would drop players mid
+    restore, so the gate defers it (re-armed per quiet period) until the
+    pool fits."""
+    import dataclasses
+
+    cfg = _team_cfg(2, capacity=16)
+    tpu = make_engine(cfg, cfg.queues[0])
+    tpu.search([_req(0, 1500, region="*")], now=0.0)
+    assert tpu._team_delegate is not None
+    # 20 concrete players, ratings 40 apart: any 4-window spread is 120 >
+    # threshold 50, so nobody matches and the oracle pool stays oversized.
+    reqs = [dataclasses.replace(_req(100 + i, 1000.0 + 40.0 * i, region="eu"),
+                                enqueued_at=0.5) for i in range(20)]
+    tpu.search(reqs, now=1.0)
+    assert tpu.pool_size() == 21
+    assert tpu.remove("p0") is not None          # wildcard drained
+    tpu.search([], now=10.0)                     # quiet elapsed, pool 20 > 16
+    assert tpu._team_delegate is not None
+    assert tpu.counters.get("team_repromoted", 0) == 0
+    for i in range(10):                          # shrink below capacity
+        tpu.remove(f"p{100 + i}")
+    tpu.search([], now=20.0)                     # next quiet period → promote
+    assert tpu._team_delegate is None
+    assert tpu.counters["team_repromoted"] == 1
+    assert tpu.pool_size() == 10
