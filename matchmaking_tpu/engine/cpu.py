@@ -70,8 +70,9 @@ class CpuEngine(Engine):
         TpuEngine re-promotion gate (a wildcard-free pool is safe to move
         back to the device kernel's exact-group semantics). O(waiting)
         attribute scan, no request materialization."""
-        return any(r.region == ANY or r.game_mode == ANY
-                   for r in self._entries)
+        from matchmaking_tpu.service.contract import is_wildcard
+
+        return any(is_wildcard(r) for r in self._entries)
 
     def restore(self, requests: Sequence[SearchRequest], now: float) -> None:
         for req in requests:

@@ -450,28 +450,21 @@ class KernelSet:
 
     # ---- the full step ----------------------------------------------------
 
-    def _search_step(self, pool: dict[str, Any], batch: dict[str, Any], now,
-                     skip_filters: bool = False):
-        """One window: fused admit+score+top-k pass → pair → evict matched.
+    def _candidates_admitting(self, pool: dict[str, Any], batch: dict[str, Any],
+                              q_thr_eff, now, skip_filters: bool = False):
+        """The fused admit+score+block-best scan — THE dense hot path (also
+        the pruned step's whole-window fallback). Returns (pool', vals
+        f32[B, n_blocks], idxs i32[B, n_blocks]).
 
-        Returns (pool', q_slot[B], c_slot[B], dist[B]) with sentinel P /
-        +inf in unmatched lanes. Match quality is computed on the host from
-        the pair's requests (the host has both sides' exact thresholds).
-        """
-        b = batch["rating"].shape[0]
+        A Pallas variant (engine/pallas_kernels.pallas_block_best) exists
+        as a pinned reference: measured on v5e it ties this scan once both
+        avoid materializing scores, and its separate admit pass costs
+        ~20 µs of HBM traffic against a ~7.4 ms step (<1%), so it cannot
+        clear the ≥15% bar that would justify a second production
+        implementation of the hot op — the production gate was removed in
+        round 4."""
         blk = self.pool_block
-        q_thr_eff = _effective_threshold(
-            batch["threshold"], batch["enqueue_t"], now,
-            self.widen_per_sec, self.max_threshold,
-        )
 
-        # The fused admit+score+best scan is THE hot path. A Pallas variant
-        # (engine/pallas_kernels.pallas_block_best) exists as a pinned
-        # reference: measured on v5e it ties this scan once both avoid
-        # materializing scores, and its separate admit pass costs ~20 µs of
-        # HBM traffic against a ~7.4 ms step (<1%), so it cannot clear the
-        # ≥15% bar that would justify a second production implementation
-        # of the hot op — the production gate was removed in round 4.
         def body(_, blk_i):
             start = blk_i * blk
             block = {f: lax.dynamic_slice_in_dim(pool[f], start, blk)
@@ -489,6 +482,24 @@ class KernelSet:
         pool = {f: blocks[f].reshape(self.capacity) for f in blocks}
         vals = vs.T                                       # (B, n_blocks)
         idxs = jnp.where(vals > _NEG_INF, is_.T, self.capacity)
+        return pool, vals, idxs
+
+    def _search_step(self, pool: dict[str, Any], batch: dict[str, Any], now,
+                     skip_filters: bool = False):
+        """One window: fused admit+score+top-k pass → pair → evict matched.
+
+        Returns (pool', q_slot[B], c_slot[B], dist[B]) with sentinel P /
+        +inf in unmatched lanes. Match quality is computed on the host from
+        the pair's requests (the host has both sides' exact thresholds).
+        """
+        b = batch["rating"].shape[0]
+        blk = self.pool_block
+        q_thr_eff = _effective_threshold(
+            batch["threshold"], batch["enqueue_t"], now,
+            self.widen_per_sec, self.max_threshold,
+        )
+        pool, vals, idxs = self._candidates_admitting(
+            pool, batch, q_thr_eff, now, skip_filters)
 
         out_q, out_c, out_d = self.greedy_pair(vals, idxs, batch["slot"])
 
@@ -515,20 +526,30 @@ class KernelSet:
     #
     #   1. sort the window by rating (padding to the end), carrying original
     #      lane ids for tie-break/order restoration;
-    #   2. one cheap O(P) pass admits the window and records each pool
-    #      block's live rating bounds (min/max rating, max rd);
-    #   3. each sorted chunk of C requests scores ONLY a W-block contiguous
-    #      span of the pool chosen from those bounds (dynamic start, static
-    #      width — no recompiles);
+    #   2. cheap per-block bounds: an O(P) three-column pass over the live
+    #      pool (_live_stats) merged with the window's own per-block bounds
+    #      computed from slot ids alone (_incoming_stats) — together equal
+    #      to post-admission bounds without doing the admission;
+    #   3. TIER 1 — each sorted chunk of C requests scores ONLY a W-block
+    #      contiguous span of the pool chosen from those bounds (dynamic
+    #      start, static width — no recompiles);
+    #      TIER 2 — admission is chunk-local too (_admit_chunked): chunk j
+    #      admits its own C players into its span, O(B·W·blk) total instead
+    #      of the dense pass's O(B·P) eq compares. Round 4 pruned scoring
+    #      only and measured ~10% — full-pool admission was the floor.
     #   4. if any chunk's admissible span exceeds W blocks, the WHOLE window
-    #      falls back to the dense scan via one lax.cond (same compiled
-    #      step, no recompile, exact by construction).
+    #      falls back to the dense fused admit+score scan via one lax.cond
+    #      (same compiled step, no recompile, exact by construction).
     #
     # Bit-exactness argument: a block outside a chunk's span can contain no
     # admissible candidate for any request in the chunk (the span bound is
     # inflated past f32 rounding), so the dense scan would have produced
     # -inf for exactly the (row, block) cells the pruned scan leaves at
-    # -inf; covered cells are computed by the same _score_block math. The
+    # -inf; covered cells are computed by the same _score_block math. A
+    # window player's own block always lies inside its chunk's span (its
+    # rating is in both the block's merged bounds and the chunk's interval,
+    # so the overlap test admits it at reach ≥ 0), hence chunk-local
+    # admission admits every valid lane exactly once. The
     # candidate matrices are therefore identical, pairing (with original-id
     # tie-breaks) is identical, and the unsort is an exact one-hot matmul.
     # One caveat scopes the claim: the dense and pruned PROGRAMS compile the
@@ -559,27 +580,79 @@ class KernelSet:
                   threshold=thr, enqueue_t=enq, valid=valid)
         return sb, qte, oi
 
-    def _admit_stats(self, pool: dict[str, Any], batch: dict[str, Any]):
-        """Admission pass + per-block live stats: (pool', min_r f32[n_blocks],
-        max_r f32[n_blocks], max_rd f32[n_blocks]). Empty blocks carry
-        (+inf, -inf, 0) — the overlap test then never selects them."""
+    def _live_stats(self, pool: dict[str, Any]):
+        """Per-block rating bounds of the CURRENT pool (no admission):
+        (min_r f32[n_blocks], max_r f32[n_blocks], max_rd f32[n_blocks]).
+        Empty blocks carry (+inf, -inf, 0) — the overlap test then never
+        selects them. O(P) reads of three columns only; the O(P·B)
+        admission work happens per-span in _admit_chunked instead."""
         blk = self.pool_block
 
         def body(_, blk_i):
             start = blk_i * blk
-            block = {f: lax.dynamic_slice_in_dim(pool[f], start, blk)
-                     for f in (*_ADMIT_FIELDS, "active")}
-            block = _admit_block(block, start, blk, batch)
-            act = block["active"]
-            minr = jnp.min(jnp.where(act, block["rating"], jnp.inf))
-            maxr = jnp.max(jnp.where(act, block["rating"], -jnp.inf))
-            maxrd = jnp.max(jnp.where(act, block["rd"], 0.0))
-            return None, (block, minr, maxr, maxrd)
+            r = lax.dynamic_slice_in_dim(pool["rating"], start, blk)
+            rd = lax.dynamic_slice_in_dim(pool["rd"], start, blk)
+            act = lax.dynamic_slice_in_dim(pool["active"], start, blk)
+            minr = jnp.min(jnp.where(act, r, jnp.inf))
+            maxr = jnp.max(jnp.where(act, r, -jnp.inf))
+            maxrd = jnp.max(jnp.where(act, rd, 0.0))
+            return None, (minr, maxr, maxrd)
 
-        _, (blocks, minr, maxr, maxrd) = lax.scan(
+        _, (minr, maxr, maxrd) = lax.scan(
             body, None, jnp.arange(self.n_blocks, dtype=jnp.int32))
-        pool = {f: blocks[f].reshape(self.capacity) for f in blocks}
-        return pool, minr, maxr, maxrd
+        return minr, maxr, maxrd
+
+    def _incoming_stats(self, batch: dict[str, Any]):
+        """Per-block rating bounds of the WINDOW being admitted, from slot
+        ids alone: (min_r, max_r, max_rd) over valid lanes whose slot lies
+        in each block. Merged with _live_stats this equals the
+        post-admission bounds _chunk_windows needs — which is what makes
+        chunk-local admission sound: any block receiving a window player
+        then has bmin ≤ r ≤ bmax for that player's rating r, so the block
+        always lands inside the player's own chunk's span (overlap with
+        reach ≥ 0), and no admission can escape its chunk. Tiny dense op:
+        (n_blocks, B) compares."""
+        nb = self.n_blocks
+        blk_of = batch["slot"] // self.pool_block          # sentinel → nb
+        hit = (blk_of[None, :] == jnp.arange(nb, dtype=jnp.int32)[:, None]
+               ) & batch["valid"][None, :]
+        minr = jnp.min(jnp.where(hit, batch["rating"][None, :], jnp.inf),
+                       axis=1)
+        maxr = jnp.max(jnp.where(hit, batch["rating"][None, :], -jnp.inf),
+                       axis=1)
+        maxrd = jnp.max(jnp.where(hit, batch["rd"][None, :], 0.0), axis=1)
+        return minr, maxr, maxrd
+
+    def _admit_chunked(self, pool: dict[str, Any], sb: dict[str, Any],
+                       dstart):
+        """Chunk-local admission: chunk j admits its own C players into its
+        W-block span only (their slots provably lie there — see
+        _incoming_stats), via the same scatter-free eq-matmul as the dense
+        path. O(B · W·blk) compares instead of the dense pass's O(B · P) —
+        the second tier of the pruning: round 4 pruned scoring alone and
+        measured that full-pool admission kept the win at ~10%. Sequential
+        pool carry: spans overlap, but each slot is written exactly once
+        (by its own chunk), so order cannot matter."""
+        blk, w = self.pool_block, self.prune_window_blocks
+        b = sb["rating"].shape[0]
+        c = self._chunk_size(b)
+        fields = (*_ADMIT_FIELDS, "active")
+
+        def body(pool, j):
+            ds = dstart[j] * blk
+            span = {f: lax.dynamic_slice_in_dim(pool[f], ds, w * blk)
+                    for f in fields}
+            cb = {f: lax.dynamic_slice_in_dim(sb[f], j * c, c) for f in sb}
+            span = _admit_block(span, ds, w * blk, cb)
+            pool = dict(pool, **{
+                f: lax.dynamic_update_slice_in_dim(pool[f], span[f], ds,
+                                                   axis=0)
+                for f in fields})
+            return pool, None
+
+        pool, _ = lax.scan(body, pool,
+                           jnp.arange(b // c, dtype=jnp.int32))
+        return pool
 
     def _chunk_size(self, b: int) -> int:
         c = max(1, min(self.prune_chunk, b))
@@ -672,14 +745,24 @@ class KernelSet:
             self.widen_per_sec, self.max_threshold,
         )
         sb, qte, oi = self._sort_batch(batch, q_thr_eff)
-        pool, bmin, bmax, brd = self._admit_stats(pool, sb)
+        lmin, lmax, lrd = self._live_stats(pool)
+        imin, imax, ird = self._incoming_stats(sb)
+        bmin = jnp.minimum(lmin, imin)
+        bmax = jnp.maximum(lmax, imax)
+        brd = jnp.maximum(lrd, ird)
         dstart, feasible = self._chunk_windows(sb, qte, bmin, bmax, brd)
-        vals, idxs = lax.cond(
-            feasible,
-            lambda: self._candidates_pruned(sb, qte, pool, now, dstart,
-                                            skip_filters),
-            lambda: self._candidates(sb, qte, pool, now, skip_filters),
-        )
+
+        def pruned_path():
+            p = self._admit_chunked(pool, sb, dstart)
+            v, i = self._candidates_pruned(sb, qte, p, now, dstart,
+                                           skip_filters)
+            return p, v, i
+
+        def dense_path():
+            return self._candidates_admitting(pool, sb, qte, now,
+                                              skip_filters)
+
+        pool, vals, idxs = lax.cond(feasible, pruned_path, dense_path)
         s_q, s_c, s_d = greedy_pair(vals, idxs, sb["slot"], self.capacity,
                                     self.pair_rounds, rid=oi)
 

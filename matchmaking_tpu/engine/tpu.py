@@ -776,9 +776,9 @@ class TpuEngine(Engine):
         """
         if not self._team_device or self._team_delegate is not None:
             return False
-        from matchmaking_tpu.service.contract import ANY
+        from matchmaking_tpu.service.contract import is_wildcard
 
-        if not any(r.region == ANY or r.game_mode == ANY for r in requests):
+        if not any(is_wildcard(r) for r in requests):
             return False
         logger.warning(
             "team queue %r: wildcard region/mode request received — device "
@@ -833,9 +833,9 @@ class TpuEngine(Engine):
                         now: float) -> None:
         """While delegated: record wildcard arrivals (resets the quiet
         period that gates re-promotion)."""
-        from matchmaking_tpu.service.contract import ANY
+        from matchmaking_tpu.service.contract import is_wildcard
 
-        if any(r.region == ANY or r.game_mode == ANY for r in requests):
+        if any(is_wildcard(r) for r in requests):
             self._delegate_last_wc = now
 
     def _maybe_repromote_team(self, now: float) -> bool:

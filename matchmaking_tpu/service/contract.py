@@ -54,6 +54,15 @@ DEFAULT_RD = 350.0  # Glicko-2 deviation for an unrated player
 ANY = "*"
 
 
+def is_wildcard(req) -> bool:
+    """True if the request matches outside any one exact (region, mode)
+    group — the single definition behind the device team kernel's wildcard
+    delegation AND its re-promotion gate (engine/tpu.py, engine/cpu.py):
+    those two checks must never diverge, or a wildcard could slip onto the
+    device path whose grouping can't serve it."""
+    return req.region == ANY or req.game_mode == ANY
+
+
 class ContractError(ValueError):
     """Malformed payload. Carries a machine-readable code for the error
     response (the reference's middleware rejects invalid payloads before the

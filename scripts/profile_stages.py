@@ -295,7 +295,8 @@ def main():
                    help="prunecheck: span width W (0 → mode default)")
     p.add_argument("--prune-chunk", type=int, default=128)
     p.add_argument("--threshold", type=float, default=100.0,
-                   help="queue rating_threshold (prunecheck: span width)")
+                   help="queue rating_threshold; tighter values shrink the "
+                        "admissible rating spans prunecheck measures")
     args = p.parse_args()
     import jax
 

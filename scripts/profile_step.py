@@ -29,12 +29,9 @@ from __future__ import annotations
 
 import argparse
 import functools
-import sys
 import time
 
 import numpy as np
-
-sys.path.insert(0, "/root/repo")
 
 
 def timeit(fn, *args, iters: int = 30, chain: bool = False):
