@@ -387,6 +387,8 @@ def bench_tpu(args) -> dict:
         "window": args.window,
         "all_runs_mps": [round(r["matches_per_sec"], 1) for r in runs],
         "hot_path_recompiles": recompiles,
+        "spans": (engine.span_report()
+                  if hasattr(engine, "span_report") else {}),
         **roof,
     }
 
@@ -503,55 +505,181 @@ def bench_e2e(args) -> dict:
                 if quiet():
                     break
         lat_ms.clear()
-        log("[e2e] buckets warm; starting measured Poisson phase")
+        log("[e2e] buckets warm; starting measured Poisson phases")
 
-        # Poisson arrivals: exponential gaps, submitted in micro-bursts so
-        # the event loop isn't woken per message on this 1-core host.
-        rate = float(args.e2e_rate)
-        duration = float(args.e2e_seconds)
-        ratings = rng.normal(1500.0, 300.0, size=int(rate * duration * 2) + 16)
-        gaps = rng.exponential(1.0 / rate, size=ratings.size)
-        t0 = time.perf_counter()
-        sched = np.cumsum(gaps)
-        i = 0
-        sent = 0
-        while i < ratings.size and sched[i] <= duration:
-            now_rel = time.perf_counter() - t0
-            # publish everything whose scheduled arrival has passed
-            while i < ratings.size and sched[i] <= min(now_rel, duration):
-                pid = f"e{i}"
-                body = (f'{{"id":"{pid}","rating":{ratings[i]:.2f}}}').encode()
-                app.broker.publish(
-                    cfg.broker.request_queue, body,
-                    Properties(reply_to=reply_q, correlation_id=pid,
-                               headers={"x-first-received":
-                                        f"{time.time():.6f}"}))
-                i += 1
-                sent += 1
-            if i < ratings.size and sched[i] > now_rel:
-                await asyncio.sleep(min(sched[i] - now_rel, 0.005))
-        span = time.perf_counter() - t0
-        # Drain: give in-flight windows + replies time to land.
-        for _ in range(400):
-            await asyncio.sleep(0.025)
-            if quiet():
-                break
-        matched = len(lat_ms)
-        pool_end = rt.engine.pool_size()
+        async def poisson(rate: float, duration: float, tag: str) -> dict:
+            """One measured Poisson arrival phase at ``rate`` req/s.
+            Exponential gaps, submitted in micro-bursts so the event loop
+            isn't woken per message on this 1-core host."""
+            lat_ms.clear()
+            match_ids.clear()
+            ratings = rng.normal(1500.0, 300.0,
+                                 size=int(rate * duration * 2) + 16)
+            gaps = rng.exponential(1.0 / rate, size=ratings.size)
+            t0 = time.perf_counter()
+            sched = np.cumsum(gaps)
+            i = 0
+            while i < ratings.size and sched[i] <= duration:
+                now_rel = time.perf_counter() - t0
+                # publish everything whose scheduled arrival has passed
+                while i < ratings.size and sched[i] <= min(now_rel, duration):
+                    pid = f"e{tag}_{i}"
+                    body = (f'{{"id":"{pid}","rating":{ratings[i]:.2f}}}'
+                            ).encode()
+                    app.broker.publish(
+                        cfg.broker.request_queue, body,
+                        Properties(reply_to=reply_q, correlation_id=pid,
+                                   headers={"x-first-received":
+                                            f"{time.time():.6f}"}))
+                    i += 1
+                if i < ratings.size and sched[i] > now_rel:
+                    await asyncio.sleep(min(sched[i] - now_rel, 0.005))
+            span = time.perf_counter() - t0
+            # Snapshot BEFORE the drain: the sustained-rate criterion must
+            # count only matches delivered while arrivals were still
+            # flowing — replies landing during the drain are backlog being
+            # worked off, and counting them against the arrival span would
+            # make an oversaturated service look like it kept up.
+            matched_in_span = len(lat_ms)
+            matches_in_span = len(match_ids)
+            # Drain: give in-flight windows + replies time to land (the
+            # percentiles DO include drained replies — those are real
+            # latencies of this phase's requests).
+            drained = False
+            for _ in range(400):
+                await asyncio.sleep(0.025)
+                if quiet():
+                    drained = True
+                    break
+            if not drained:
+                log(f"[e2e {tag}] WARNING: backlog not drained in 10 s — "
+                    "later rows may be contaminated")
+            arr = (np.sort(np.asarray(lat_ms)) if lat_ms
+                   else np.array([0.0]))
+            return {
+                "e2e_offered_req_s": rate,
+                "e2e_requests": i,
+                "e2e_rate_req_s": round(i / span, 1),
+                "e2e_players_matched": len(lat_ms),
+                "e2e_matched_per_s": round(matched_in_span / span, 1),
+                "e2e_matches_per_sec": round(matches_in_span / span, 1),
+                "e2e_p50_ms": round(float(np.percentile(arr, 50)), 3),
+                "e2e_p99_ms": round(float(np.percentile(arr, 99)), 3),
+                "e2e_drained": drained,
+                "e2e_pool_end": rt.engine.pool_size(),
+            }
+
+        headline = await poisson(float(args.e2e_rate),
+                                 float(args.e2e_seconds), "h")
+        headline["e2e_pool_start"] = pool_start
+
+        # Saturation sweep: escalate offered load to find the knee of the
+        # single-process service (round-4 verdict #1: the engine does 64k
+        # matches/s but the service was only proven at ~6k offered). The
+        # knee is the highest offered rate the service still clears at
+        # ≥90% (matched players/s vs offered arrivals/s).
+        sweep_rows = []
+        knee = None
+        if args.e2e_rates:
+            for r in (float(x) for x in args.e2e_rates.split(",")):
+                async with rt._engine_lock:
+                    await asyncio.to_thread(prefill)
+                row = await poisson(r, float(args.e2e_sweep_seconds),
+                                    f"k{int(r)}")
+                log(f"[e2e sweep] {row}")
+                sweep_rows.append(row)
+                if row["e2e_matched_per_s"] >= 0.9 * r:
+                    knee = max(knee or 0.0, r)
+
         await app.stop()
-        arr = np.sort(np.asarray(lat_ms)) if lat_ms else np.array([0.0])
-        return {
-            "e2e_requests": sent,
-            "e2e_rate_req_s": round(sent / span, 1),
-            "e2e_players_matched": matched,
-            "e2e_matches_per_sec": round(len(match_ids) / span, 1),
-            "e2e_p50_ms": round(float(np.percentile(arr, 50)), 3),
-            "e2e_p99_ms": round(float(np.percentile(arr, 99)), 3),
-            "e2e_pool_start": pool_start,
-            "e2e_pool_end": pool_end,
-        }
+        out = dict(headline)
+        if sweep_rows:
+            out["e2e_sweep"] = sweep_rows
+            out["e2e_knee_req_s"] = knee
+        return out
 
     return asyncio.run(run())
+
+
+def bench_multiproc(args) -> dict:
+    """Multi-process ingress scaling: N supervised self-driving workers
+    (service/multiproc.WorkerSupervisor + service/loadgen), each running
+    the FULL ingress path (broker → decode → middleware → batcher → engine
+    → publish) against its own queue partition. No RabbitMQ exists in this
+    environment, so workers drive themselves instead of sharing a network
+    broker (loadgen.py docstring).
+
+    Interpretation on THIS bench host (1 core): the aggregate is
+    core-bound by construction — the N=1 row IS the per-process ingress
+    ceiling, and the N=2 row pins that partitioned share-nothing workers
+    add no coordination overhead beyond the core they fight over. On an
+    M-core deployment the per-worker ceiling multiplies by min(M, N); the
+    architecture (one pool owner per queue, AMQP routing by queue name)
+    has no cross-worker communication to cap it."""
+    import subprocess
+    import sys
+    import tempfile
+
+    from matchmaking_tpu.config import Config, EngineConfig, QueueConfig
+    from matchmaking_tpu.service.multiproc import WorkerSupervisor
+
+    rows = []
+    for n in (1, 2):
+        cfg = Config(
+            queues=tuple(QueueConfig(name=f"lg{i}", send_queued_ack=False)
+                         for i in range(n)),
+            engine=EngineConfig(backend="cpu", pool_capacity=4096),
+        )
+        outs = []
+        extra = {}
+        for i in range(n):
+            fd, path = tempfile.mkstemp(prefix=f"mm_lg{i}_", suffix=".json")
+            os.close(fd)
+            outs.append(path)
+            extra[i] = {
+                "MM_LOADGEN_RATE": str(args.mp_rate),
+                "MM_LOADGEN_SECONDS": str(args.mp_seconds),
+                "MM_LOADGEN_OUT": path,
+                "JAX_PLATFORMS": "cpu",
+            }
+        sup = WorkerSupervisor(
+            cfg, n,
+            command=[sys.executable, "-m", "matchmaking_tpu.service.loadgen"],
+            extra_env=extra)
+        for w in sup.workers:
+            # Workers are host-only: skip the axon TPU-relay dial that the
+            # machine-wide sitecustomize adds to every interpreter start.
+            w.env.pop("PALLAS_AXON_POOL_IPS", None)
+        sup.start()
+        try:
+            for w in sup.workers:
+                w.proc.wait(timeout=args.mp_seconds + 60)
+        except subprocess.TimeoutExpired:
+            log(f"[multiproc] worker fleet n={n} timed out")
+        finally:
+            sup.stop()
+        results = []
+        for path in outs:
+            try:
+                with open(path) as f:
+                    results.append(json.load(f))
+            except (OSError, json.JSONDecodeError):
+                pass
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        row = {
+            "workers": n,
+            "completed": len(results),
+            "offered_req_s_per_worker": float(args.mp_rate),
+            "agg_sent_req_s": round(sum(r["sent_req_s"] for r in results), 1),
+            "agg_matched_per_s": round(
+                sum(r["matched_per_s"] for r in results), 1),
+        }
+        log(f"[multiproc] {row}")
+        rows.append(row)
+    return {"multiproc": rows, "multiproc_host_cores": os.cpu_count()}
 
 
 def bench_cpu_oracle(args) -> dict:
@@ -625,7 +753,39 @@ def main() -> None:
                    help="Poisson arrival rate (req/s) for the e2e phase")
     p.add_argument("--e2e-seconds", type=float, default=6.0,
                    help="e2e phase duration")
+    p.add_argument("--e2e-rates", default="12000,24000,48000,80000",
+                   help="comma-separated offered rates for the saturation "
+                        "sweep (finds the single-process knee); empty "
+                        "string skips the sweep")
+    p.add_argument("--e2e-sweep-seconds", type=float, default=4.0,
+                   help="duration of each saturation-sweep step")
+    p.add_argument("--skip-multiproc", action="store_true",
+                   help="skip the multi-process ingress phase")
+    p.add_argument("--mp-rate", type=float, default=80000.0,
+                   help="offered req/s per self-driving multiproc worker "
+                        "(above the ~77k/s single-process ceiling so the "
+                        "phase measures saturation, not the offered rate)")
+    p.add_argument("--mp-seconds", type=float, default=4.0)
+    p.add_argument("--latency", action="store_true",
+                   help="latency mode: small window, depth 1, grouping "
+                        "off — reports the tunnel-floor-bounded measured "
+                        "p50/p99 AND the projected PCIe-local latency "
+                        "(batcher wait + host dispatch + device step), "
+                        "then exits. The p99 < 50 ms north star is a "
+                        "LATENCY claim; the default mode optimizes "
+                        "throughput (BENCH_SWEEP.md §4)")
+    p.add_argument("--latency-window", type=int, default=512)
     args = p.parse_args()
+    if args.latency:
+        # Latency operating point: one small window in flight, no
+        # grouping (grouping trades first-window latency for transfer
+        # throughput), tighter batcher wait.
+        args.window = args.latency_window
+        args.depth = 1
+        args.readback_group = 1
+        args.skip_e2e = True
+        args.skip_multiproc = True
+        args.skip_cpu = True
     if args.depth < args.readback_group:
         log(f"[warn] depth {args.depth} < readback-group "
             f"{args.readback_group}: groups can never fill before the "
@@ -649,6 +809,47 @@ def main() -> None:
     log(f"jax {jax.__version__} devices={devices}")
 
     tpu = bench_tpu(args)
+    if args.latency:
+        # Projection to PCIe-local hardware: every component is measured on
+        # THIS run except the transfer channel it removes. alloc/pack are
+        # host-only (hardware-independent); h2d is kept at the measured
+        # tunnel value (conservative — PCIe is faster); device_step_ms is
+        # the chained on-device step time. The batcher contributes up to
+        # max_wait_ms (3.0 in the service default): half in the median
+        # case, the full wait plus one queued step at p99.
+        spans = tpu.get("spans", {})
+        host_ms = sum(spans.get(k, 0.0) for k in
+                      ("alloc_ms_avg", "pack_ms_avg", "h2d_ms_avg"))
+        step_ms = tpu.get("device_step_ms") or 0.0
+        batcher_wait_ms = 3.0
+        proj_p50 = round(batcher_wait_ms / 2 + host_ms + step_ms, 2)
+        proj_p99 = round(batcher_wait_ms + host_ms + 2 * step_ms, 2)
+        print(json.dumps({
+            "metric": (f"p99 match latency @ {args.pool}-player pool "
+                       "(1v1 ELO, latency preset)"),
+            "value": round(tpu["p99_ms"], 3),
+            "unit": "ms",
+            "vs_baseline": None,
+            "p50_ms": round(tpu["p50_ms"], 3),
+            "p99_target_ms": 50.0,
+            "window": args.window,
+            "depth": 1,
+            "readback_group": 1,
+            "matches_per_sec": round(tpu["matches_per_sec"], 1),
+            "device_step_ms": tpu.get("device_step_ms"),
+            "host_dispatch_ms": round(host_ms, 3),
+            "projected_local_p50_ms": proj_p50,
+            "projected_local_p99_ms": proj_p99,
+            "projection_formula": (
+                "p50 = max_wait/2 + alloc+pack+h2d + device_step; "
+                "p99 = max_wait + alloc+pack+h2d + 2*device_step "
+                "(measured spans; removes only the tunnel's ~70 ms "
+                "serialized D2H, which PCIe-local hardware does not have)"),
+            "note": ("measured p50/p99 include the axon tunnel's ~70 ms "
+                     "fixed D2H latency (BENCH_SWEEP.md §1) — the floor "
+                     "below which no number through THIS harness can go"),
+        }), flush=True)
+        return
     e2e = {}
     if not args.skip_e2e:
         try:
@@ -656,6 +857,12 @@ def main() -> None:
             log(f"[e2e] {e2e}")
         except Exception as e:
             log(f"[e2e] failed: {e!r}")
+    mp = {}
+    if not args.skip_multiproc:
+        try:
+            mp = bench_multiproc(args)
+        except Exception as e:
+            log(f"[multiproc] failed: {e!r}")
     if args.skip_cpu:
         # None, not NaN: NaN is not valid RFC 8259 JSON and breaks strict
         # parsers on the driver side.
@@ -679,6 +886,7 @@ def main() -> None:
         "total_matches": tpu["total_matches"],
         "all_runs_mps": tpu.get("all_runs_mps", []),
         **e2e,
+        **mp,
         "hot_path_recompiles": tpu.get("hot_path_recompiles"),
         "device_step_ms": tpu.get("device_step_ms"),
         "hbm_bytes_per_s": tpu.get("hbm_bytes_per_s"),

@@ -60,6 +60,13 @@ class QueueConfig:
     #: waiting window so widening can resolve. Only meaningful with
     #: ``widen_per_sec > 0`` on 1v1 queues.
     rescan_interval_s: float = 0.0
+    #: Players covered per rescan tick (0 → the batcher's max_batch).
+    #: Device 1v1 queues rescan through a no-admission step that is safe to
+    #: overlap in-flight windows AND to split into multiple device chunks
+    #: (kernels._rescan_step), so this may exceed one batch bucket — set it
+    #: ≳ pool size to resolve widening pool-wide in a single tick instead
+    #: of one bucket per tick.
+    rescan_window: int = 0
 
 
 @dataclass(frozen=True)

@@ -137,6 +137,9 @@ class PlayerPool:
         self.m_enqueued = np.zeros(self.capacity, np.float64)
         self.m_reply = np.full(self.capacity, "", dtype=object)
         self.m_corr = np.full(self.capacity, "", dtype=object)
+        # Declared role sets (config #5 device path); None for the columnar
+        # 1v1 ingress, which never carries roles.
+        self.m_roles = np.full(self.capacity, None, dtype=object)
         self.regions = Interner()
         self.modes = Interner()
 
@@ -165,6 +168,7 @@ class PlayerPool:
             region=self.regions.name(int(self.m_region[slot])),
             rating_threshold=(float(self.m_threshold[slot])
                               if self.m_thr_override[slot] else None),
+            roles=tuple(self.m_roles[slot] or ()),
             reply_to=self.m_reply[slot],
             correlation_id=self.m_corr[slot],
             enqueued_at=float(self.m_enqueued[slot]),
@@ -229,7 +233,11 @@ class PlayerPool:
                 raise ValueError(f"player {req.id!r} already in pool")
         cols = RequestColumns.from_requests(
             requests, self.regions.code, self.modes.code)
-        return self.allocate_columns(cols).tolist()
+        slots = self.allocate_columns(cols).tolist()
+        for s, req in zip(slots, requests):
+            if req.roles:
+                self.m_roles[s] = req.roles
+        return slots
 
     def release(self, slots: Sequence[int] | np.ndarray) -> None:
         """Evict slots (matched / cancelled / timed out) from the mirror."""
@@ -246,6 +254,7 @@ class PlayerPool:
         for pid in ids[occupied].tolist():
             del self._slot_of[pid]
         self.m_id[arr] = None
+        self.m_roles[arr] = None
         if self._band_edges is not None:
             # Slots return to their HOME band (slot ranges are static), so
             # band occupancy self-heals as spilled players match out.

@@ -74,6 +74,12 @@ class CpuEngine(Engine):
 
         return any(is_wildcard(r) for r in self._entries)
 
+    def has_parties(self) -> bool:
+        """True if any waiting unit is a multi-player party — the other
+        re-promotion gate for role queues (the device role kernel packs
+        solo units only)."""
+        return any(r.party_size > 1 for r in self._entries)
+
     def restore(self, requests: Sequence[SearchRequest], now: float) -> None:
         for req in requests:
             if req.id not in self._by_id:

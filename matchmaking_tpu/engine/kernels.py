@@ -84,7 +84,8 @@ _pair_distance = scoring.distance
 
 
 def _admit_block(pool_block: dict[str, Any], start, blk: int,
-                 batch: dict[str, Any], eq=None) -> dict[str, Any]:
+                 batch: dict[str, Any], eq=None,
+                 fields: tuple[str, ...] = _ADMIT_FIELDS) -> dict[str, Any]:
     """Admission into one pool block, scatter-free.
 
     ``eq`` is the (blk, B) equality matrix between block positions and the
@@ -104,11 +105,11 @@ def _admit_block(pool_block: dict[str, Any], start, blk: int,
         eq = batch["slot"][None, :] == pos[:, None]
     hit = eq.any(axis=1)
     vals = jnp.stack(
-        [batch[f].astype(jnp.float32) for f in _ADMIT_FIELDS], axis=1)
+        [batch[f].astype(jnp.float32) for f in fields], axis=1)
     scat = jnp.matmul(eq.astype(jnp.float32), vals,
                       precision=lax.Precision.HIGHEST)    # (blk, n_fields)
     out = {}
-    for j, f in enumerate(_ADMIT_FIELDS):
+    for j, f in enumerate(fields):
         new = scat[:, j].astype(pool_block[f].dtype)
         out[f] = jnp.where(hit, new, pool_block[f])
     out["active"] = pool_block["active"] | hit
@@ -322,6 +323,11 @@ class KernelSet:
         self.search_step_packed_nofilter = jax.jit(
             functools.partial(self._search_step_packed, skip_filters=True),
             donate_argnums=0)
+        # Rescan variant: NO admission, lane validity gated by the
+        # device-side active flag (see _rescan_step). What makes rescans
+        # overlap in-flight windows AND span multiple chunks safely.
+        self.search_step_packed_rescan = jax.jit(
+            self._search_step_packed_rescan, donate_argnums=0)
 
     def _search_step_packed(self, pool, packed, skip_filters: bool = False):
         """Packed window step: batch rows per pool.PACKED_ROWS plus a 9th row
@@ -331,6 +337,43 @@ class KernelSet:
         now = packed[8, 0]
         pool, out_q, out_c, out_d = self._step_impl(pool, batch, now,
                                                     skip_filters)
+        out = jnp.stack([out_q.astype(jnp.float32),
+                         out_c.astype(jnp.float32), out_d])
+        return pool, out
+
+    def _rescan_step(self, pool: dict[str, Any], batch: dict[str, Any], now):
+        """No-admission window step for rescans.
+
+        The regular step's fused admission is what made rescans require a
+        drained pipeline: a window built from the not-yet-finalized host
+        mirror could re-admit (resurrect) a slot an in-flight window had
+        already matched and evicted on device. Here nothing is admitted and
+        every lane's validity is ANDed with the DEVICE-side active flag of
+        its own slot, so a stale lane is simply a no-op — which makes it
+        safe to (a) dispatch rescans while windows are in flight (steps
+        chain in order on the donated pool) and (b) split one rescan tick
+        into many chunks covering the whole pool (a later chunk cannot
+        re-match players an earlier chunk retired). Scoring, pairing, and
+        eviction are the dense step's; rescans are off the hot path, so no
+        nofilter/pruned variants."""
+        q_thr_eff = _effective_threshold(
+            batch["threshold"], batch["enqueue_t"], now,
+            self.widen_per_sec, self.max_threshold,
+        )
+        lane_act = jnp.take(pool["active"],
+                            jnp.clip(batch["slot"], 0, self.capacity - 1))
+        batch = dict(batch, valid=batch["valid"] & lane_act)
+        vals, idxs = self._candidates(batch, q_thr_eff, pool, now)
+        out_q, out_c, out_d = self.greedy_pair(vals, idxs, batch["slot"])
+        pool = self._evict(pool, jnp.concatenate([out_q, out_c]))
+        return pool, out_q, out_c, out_d
+
+    def _search_step_packed_rescan(self, pool, packed):
+        """Packed-I/O twin of _rescan_step (same layout as
+        _search_step_packed)."""
+        batch = unpack_batch(packed)
+        now = packed[8, 0]
+        pool, out_q, out_c, out_d = self._rescan_step(pool, batch, now)
         out = jnp.stack([out_q.astype(jnp.float32),
                          out_c.astype(jnp.float32), out_d])
         return pool, out

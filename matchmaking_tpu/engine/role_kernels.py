@@ -1,0 +1,260 @@
+"""Device-side role-queue matchmaking for SOLO players (BASELINE config #5).
+
+Round-4 state: role/party queues ran the host oracle only (``engine/roles.py``
+— O(n²·backtracking) per arrival), flagged by the round-4 verdict as the last
+BASELINE config without a TPU path. This module is the device path for the
+solo case; parties (and region/mode wildcards) still delegate to the oracle —
+``TpuEngine._maybe_delegate_team`` flips the queue over (and back, once they
+drain) exactly like team-queue wildcards.
+
+Why solos reduce cleanly (derived from ``roles.try_party_match``; the device
+path must be match-for-match identical to it):
+
+- Every unit has size 1, so the first-fit-decreasing pack of a rating-sorted
+  window always assigns the k lowest-rated members to team A and the next k
+  to team B — and any window larger than ``need = 2·team_size`` packs the
+  SAME first 2k members with a LARGER spread, so only minimal windows can
+  ever win. The oracle's window slide therefore collapses to: for each start
+  ``lo`` ascending, try the ``need`` consecutive sorted members.
+- ``_window_feasible`` is a necessary-condition prefilter (a successful pack
+  implies it), so the device path may skip it.
+- A window is valid iff spread ≤ min effective threshold AND the base split
+  (or the first swap-repair exchange, in the oracle's (i, j) scan order)
+  gives BOTH teams a perfect member→role-slot assignment.
+- Perfect assignment of k members to k role slots is decided by Hall's
+  condition over DISTINCT roles (slots of one role are interchangeable):
+  for every subset S of distinct roles, |{members eligible for some role in
+  S}| ≥ slots(S). With D ≤ ~5 distinct roles that is ≤ 31 subset checks of
+  dense bitmask math per team — a few shifted compares per window, no
+  backtracking, no data-dependent control flow.
+
+Pool layout = the standard POOL_FIELDS plus one extra column ``role_mask``
+(i32 bitmask over the queue's distinct roles; declared-role members carry
+their roles' bits, wildcard-role members carry ALL bits — mirroring
+``roles._roles_cover``'s "no roles = eligible for everything"). The packed
+batch gains one row for it (see ``pack_rows``).
+
+Selection is leftmost-first (the oracle returns the FIRST valid window by
+``lo``), unlike the plain team kernel's tightest-first — both use the same
+fixed-round parallel-greedy neighborhood scheme.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from matchmaking_tpu.engine.kernels import (
+    _ADMIT_FIELDS,
+    _admit_block,
+    _effective_threshold,
+    unpack_batch,
+)
+from matchmaking_tpu.engine.teams import TeamKernelSet, _BIG_I32, _INF
+from jax import lax
+
+
+class RoleKernelSet(TeamKernelSet):
+    """Compiled solo role-queue step. Call surface mirrors TeamKernelSet;
+    ``search_step`` returns ``(pool', slots i32[M, need], spread f32[M],
+    limit f32[M], split i32[M])`` where ``split`` bit i set ⇔ the i-th
+    window member (rating order) is on team A. Packed output stacks
+    ``need + 3`` rows (slots, spread, limit, split)."""
+
+    is_role = True
+    #: Extra device pool columns beyond POOL_FIELDS (engine bootstrap).
+    extra_pool_fields = {"role_mask": np.int32}
+    #: Packed batch rows: PACKED_ROWS + role_mask + now.
+    pack_rows = 10
+
+    def __init__(self, *, capacity: int, team_size: int,
+                 role_slots: tuple[str, ...],
+                 widen_per_sec: float, max_threshold: float,
+                 max_matches: int = 1024, rounds: int = 16,
+                 evict_bucket: int = 64):
+        assert role_slots, "role kernel needs role_slots"
+        assert len(role_slots) == team_size, (
+            "role_slots must name one role per team member")
+        super().__init__(capacity=capacity, team_size=team_size,
+                         widen_per_sec=widen_per_sec,
+                         max_threshold=max_threshold,
+                         max_matches=max_matches, rounds=rounds,
+                         evict_bucket=evict_bucket)
+        # Distinct roles in sorted order → bit index (deterministic).
+        self.distinct = tuple(sorted(set(role_slots)))
+        self._bit = {r: i for i, r in enumerate(self.distinct)}
+        d = len(self.distinct)
+        self.full_mask = (1 << d) - 1
+        # Static per-subset slot demand for ONE team.
+        self._subsets = tuple(range(1, 1 << d))
+        self._demand = tuple(
+            sum(1 for r in role_slots if (1 << self._bit[r]) & s)
+            for s in self._subsets)
+        # Role-aware packed entries override the base jits.
+        self.admit_packed = jax.jit(
+            lambda pool, packed: self._admit_roles(
+                pool, self._unpack(packed)[0]),
+            donate_argnums=0)
+        self.search_step = jax.jit(self._search_step, donate_argnums=0)
+        self.search_step_packed = jax.jit(self._search_step_packed,
+                                          donate_argnums=0)
+
+    # ---- host helpers ------------------------------------------------------
+
+    def mask_of(self, roles: tuple[str, ...]) -> int:
+        """Member roles → eligibility bitmask (oracle semantics: no declared
+        roles ⇒ eligible for every slot; out-of-vocabulary roles carry no
+        bits)."""
+        if not roles:
+            return self.full_mask
+        m = 0
+        for r in roles:
+            b = self._bit.get(r)
+            if b is not None:
+                m |= 1 << b
+        return m
+
+    # ---- device internals --------------------------------------------------
+
+    @staticmethod
+    def _unpack(packed):
+        batch = unpack_batch(packed)
+        batch["role_mask"] = packed[8].astype(jnp.int32)
+        return batch, packed[9, 0]
+
+    def _admit_roles(self, pool: dict[str, Any], batch: dict[str, Any]):
+        """Standard admission extended with the role_mask column (mask ints
+        ≪ 2^24 are f32-exact through the eq-matmul)."""
+        blk = self._base.pool_block
+        fields = (*_ADMIT_FIELDS, "role_mask")
+
+        def body(_, blk_i):
+            start = blk_i * blk
+            block = {f: lax.dynamic_slice_in_dim(pool[f], start, blk)
+                     for f in (*fields, "active")}
+            return None, _admit_block(block, start, blk, batch,
+                                      fields=fields)
+
+        _, blocks = lax.scan(body, None,
+                             jnp.arange(self._base.n_blocks, dtype=jnp.int32))
+        return {f: blocks[f].reshape(self.capacity) for f in blocks}
+
+    def _covers(self, masks):
+        """Hall check for one team per window: masks i32[n_win, k] →
+        bool[n_win]. For every nonempty subset S of distinct roles, the
+        team needs ≥ demand(S) members eligible inside S."""
+        ok = jnp.ones(masks.shape[0], bool)
+        for s, dem in zip(self._subsets, self._demand):
+            elig = ((masks & jnp.int32(s)) != 0).sum(axis=1)
+            ok = ok & (elig >= dem)
+        return ok
+
+    def _cover_split(self, member_masks):
+        """Oracle pack order over each window's ``need`` rating-sorted
+        members: base split (low k → A), then swap-repair exchanges in
+        (i, j) scan order; first split whose BOTH teams pass Hall wins.
+        Returns (ok bool[n_win], split i32[n_win] bitmask, bit i = member i
+        on team A)."""
+        k = self.team_size
+        a = member_masks[:, :k]                      # (n_win, k)
+        b = member_masks[:, k:]
+        base_bits = jnp.int32((1 << k) - 1)
+
+        oks = [self._covers(a) & self._covers(b)]
+        bits = [jnp.full(a.shape[0], base_bits, jnp.int32)]
+        for i in range(k):
+            for j in range(k):
+                swapped_a = jnp.concatenate(
+                    [a[:, :i], b[:, j:j + 1], a[:, i + 1:]], axis=1)
+                swapped_b = jnp.concatenate(
+                    [b[:, :j], a[:, i:i + 1], b[:, j + 1:]], axis=1)
+                oks.append(self._covers(swapped_a) & self._covers(swapped_b))
+                bits.append(jnp.full(
+                    a.shape[0],
+                    jnp.int32(((1 << k) - 1) ^ (1 << i) | (1 << (k + j))),
+                    jnp.int32))
+        ok_m = jnp.stack(oks, axis=1)                # (n_win, 1 + k²)
+        bit_m = jnp.stack(bits, axis=1)
+        prio = jnp.arange(ok_m.shape[1], dtype=jnp.int32)
+        first = jnp.argmin(jnp.where(ok_m, prio, _BIG_I32), axis=1)
+        ok = ok_m.any(axis=1)
+        split = jnp.take_along_axis(bit_m, first[:, None], axis=1)[:, 0]
+        return ok, jnp.where(ok, split, 0)
+
+    def _windows_roles(self, pool, order, group, now):
+        """Team-window validity + the role cover/split term."""
+        valid, spread, win_thr = self._windows(pool, order, group, now)
+        need = self.need
+        n_win = self.capacity - need + 1
+        m_s = pool["role_mask"][order]
+        cols = [lax.dynamic_slice_in_dim(m_s, i, n_win)
+                for i in range(need)]
+        member_masks = jnp.stack(cols, axis=1)       # (n_win, need)
+        cover_ok, split = self._cover_split(member_masks)
+        return valid & cover_ok, spread, win_thr, split
+
+    def _select_leftmost(self, valid):
+        """Leftmost-first disjoint selection (the oracle returns the FIRST
+        valid window by start index, not the tightest)."""
+        n_win = valid.shape[0]
+        idx = jnp.arange(n_win, dtype=jnp.int32)
+
+        def body(_, state):
+            valid, won = state
+            ci = jnp.where(valid, idx, _BIG_I32)
+            neigh_imin = self._neigh_reduce(ci, op=jnp.minimum, pad=_BIG_I32)
+            winner = valid & (ci == neigh_imin)
+            hit = self._neigh_reduce(winner, op=jnp.logical_or, pad=False)
+            return valid & ~hit, won | winner
+
+        _, won = jax.lax.fori_loop(
+            0, self.rounds, body, (valid, jnp.zeros_like(valid)))
+        return won
+
+    def _search_step(self, pool: dict[str, Any], batch: dict[str, Any], now):
+        pool = self._admit_roles(pool, batch)
+        order, group = self._sorted_order(pool)
+        valid, spread, win_thr, split = self._windows_roles(
+            pool, order, group, now)
+        won = self._select_leftmost(valid)
+
+        score = jnp.where(won, -jnp.arange(won.shape[0], dtype=jnp.int32),
+                          -_BIG_I32)
+        topv, topi = jax.lax.top_k(score, self.max_matches)
+        is_match = topv > -_BIG_I32
+        w = jnp.where(is_match, topi, 0)
+        member_pos = (w[:, None]
+                      + jnp.arange(self.need, dtype=jnp.int32)[None, :])
+        slots = order[member_pos]
+        slots = jnp.where(is_match[:, None], slots, self.capacity)
+        pool = self._base._evict(pool, slots.reshape(-1))
+        out_spread = jnp.where(is_match, spread[w], _INF)
+        out_thr = jnp.where(is_match, win_thr[w], 0.0)
+        out_split = jnp.where(is_match, split[w], 0)
+        return pool, slots, out_spread, out_thr, out_split
+
+    def _search_step_packed(self, pool, packed):
+        """Packed role step: f32[10, B] in (PACKED_ROWS + role_mask + now),
+        out f32[need + 3, M]: member slots, spread, limit, split bits."""
+        batch, now = self._unpack(packed)
+        pool, slots, spread, thr, split = self._search_step(pool, batch, now)
+        out = jnp.concatenate([slots.T.astype(jnp.float32),
+                               spread[None, :], thr[None, :],
+                               split.astype(jnp.float32)[None, :]])
+        return pool, out
+
+
+@functools.lru_cache(maxsize=None)
+def role_kernel_set(capacity: int, team_size: int,
+                    role_slots: tuple[str, ...], widen_per_sec: float,
+                    max_threshold: float, max_matches: int = 1024,
+                    rounds: int = 16) -> RoleKernelSet:
+    return RoleKernelSet(
+        capacity=capacity, team_size=team_size, role_slots=role_slots,
+        widen_per_sec=widen_per_sec, max_threshold=max_threshold,
+        max_matches=max_matches, rounds=rounds,
+    )

@@ -16,10 +16,12 @@ Host/device split (SURVEY.md §7):
   conflict-free pairing, eviction scatter — one fused jitted step.
 
 Team-balanced queues (BASELINE config #3) run on device via the batch
-team-window kernel (``engine/teams.py``); role/party queues (config #5) and
-multi-chip team queues run the host-side oracle algorithms over the
-authoritative mirror. The 1v1 paths (configs #1/#2/#4) — the north-star hot
-path — run on device single- or multi-chip.
+team-window kernel (``engine/teams.py``); role queues (config #5) run on
+device for solo traffic via ``engine/role_kernels.py``, delegating to the
+host oracle only while parties or region/mode wildcards are present (and
+promoting back once they drain). Sharded role queues run the host oracle.
+The 1v1 paths (configs #1/#2/#4) — the north-star hot path — run on device
+single- or multi-chip.
 """
 
 from __future__ import annotations
@@ -138,11 +140,30 @@ class TpuEngine(Engine):
 
         CompileCounter.install()
         ec = cfg.engine
-        # Role/party queues (config #5) run the host oracle over the mirror;
-        # plain team queues (config #3) and all 1v1 configs run on device,
-        # single- or multi-chip.
-        self._team_device = queue.team_size > 1 and not queue.role_slots
-        if self._team_device and ec.mesh_pool_axis > 1:
+        # Config #5 role queues run on device for SOLO traffic (round 5 —
+        # engine/role_kernels.py); parties and wildcards delegate to the
+        # host oracle via the same switch team-queue wildcards use. Sharded
+        # role queues stay host-side (the role sort/cover doesn't ship a
+        # sharded variant); plain team queues (config #3) and all 1v1
+        # configs run on device, single- or multi-chip.
+        self._role_device = (queue.team_size > 1 and bool(queue.role_slots)
+                             and ec.mesh_pool_axis == 1)
+        self._team_device = (queue.team_size > 1
+                             and (self._role_device
+                                  or not queue.role_slots))
+        if self._role_device:
+            from matchmaking_tpu.engine.role_kernels import role_kernel_set
+
+            self.kernels = role_kernel_set(
+                capacity=ec.pool_capacity,
+                team_size=queue.team_size,
+                role_slots=tuple(queue.role_slots),
+                widen_per_sec=queue.widen_per_sec,
+                max_threshold=queue.max_threshold,
+                max_matches=ec.team_max_matches,
+                rounds=ec.team_rounds,
+            )
+        elif self._team_device and ec.mesh_pool_axis > 1:
             from matchmaking_tpu.engine.teams import sharded_team_kernel_set
 
             self.kernels = sharded_team_kernel_set(
@@ -252,6 +273,17 @@ class TpuEngine(Engine):
         #: callers need the per-window attribution to nack exactly the failed
         #: window's deliveries; callers discard entries they consume.
         self.failed_tokens: set[int] = set()
+        #: Tokens of in-flight rescan windows — lets a shared collector
+        #: route their outcomes to the rescan publisher instead of the
+        #: request/delivery bookkeeping. Callers discard what they consume.
+        self.rescan_tokens: set[int] = set()
+        #: True when rescans may be dispatched with windows in flight (the
+        #: kernel set ships the no-admission rescan variant, or the team
+        #: step is inherently admission-free). The service skips its
+        #: pipeline drain — the round-4 rescan stall — when set.
+        self.rescan_overlap = (
+            self._team_device
+            or hasattr(self.kernels, "search_step_packed_rescan"))
         #: Stage spans (SURVEY.md §5 tracing): cumulative seconds + counts;
         #: read via span_report(). Written only on the caller thread.
         self.spans = {
@@ -482,62 +514,75 @@ class TpuEngine(Engine):
         team queues rescan via _rescan_team (pool-wide window formation
         with an all-invalid batch).
 
-        Safe by construction: the batch carries the players' EXISTING slots,
-        so the fused admit rewrites identical values; self-masking and the
-        conflict-free pairing handle rescanned lanes exactly like fresh
-        ones. ONE device chunk per call (the window caps at the largest
-        bucket): a second chunk would re-admit — from the not-yet-finalized
-        mirror — a slot the first chunk's in-flight step may already have
-        matched and evicted, resurrecting a matched player into a double
-        match. Periodic ticks cover pools larger than a bucket. The
-        resulting ColumnarOutcome's q_ids are the unmatched rescans —
-        callers must NOT re-ack them as newly queued."""
+        Overlap-safe (when the kernel set ships the no-admission rescan
+        variant — see kernels._rescan_step): lanes are validity-gated by
+        the DEVICE-side active flag, so windows may be in flight and the
+        tick may span MULTIPLE chunks covering up to ``max_window`` players
+        (a later chunk cannot re-match players an earlier chunk retired).
+        Kernel sets without the variant (sharded) keep the old contract:
+        one chunk, pipeline drained by the caller. The resulting
+        ColumnarOutcome's q_ids are the unmatched rescans — callers must
+        NOT re-ack them as newly queued. Tokens are recorded in
+        ``rescan_tokens`` so a collector can recognize them."""
         if self._team_delegate is not None:
             return None  # host-oracle team queues re-form on arrival only
         if self._team_device:
-            return self._rescan_team(now)
-        # The engine refuses, not just the service's lock convention: a
-        # rescan while a window is in flight re-admits — from the
-        # not-yet-finalized mirror — slots that window may already have
-        # matched and evicted, resurrecting a matched player into a double
-        # match (same hazard remove() guards against).
-        assert self._open == 0, (
-            "rescan_async() with windows in flight — collect with flush() first"
-        )
+            tok = self._rescan_team(now)
+            if tok is not None:
+                self.rescan_tokens.add(tok)
+            return tok
+        rescan_step = getattr(self.kernels, "search_step_packed_rescan", None)
+        if rescan_step is None:
+            # No no-admission variant: a rescan window would re-admit — from
+            # the not-yet-finalized mirror — slots an in-flight step may
+            # already have matched and evicted, resurrecting a matched
+            # player into a double match. Callers must drain first, and the
+            # tick covers one chunk.
+            assert self._open == 0, (
+                "rescan_async() with windows in flight — collect with "
+                "flush() first"
+            )
+            max_window = min(max_window, self.buckets[-1])
         pool = self.pool
         if len(pool) == 0:
             return None
-        max_window = min(max_window, self.buckets[-1])
         slots_all = pool.waiting_slots()
         if slots_all.size > max_window:
             enq = pool.m_enqueued[slots_all]
             order = np.argsort(enq, kind="stable")[:max_window]
-            slots = np.sort(slots_all[order]).astype(np.int32)
+            chosen = np.sort(slots_all[order]).astype(np.int32)
         else:
-            slots = np.sort(slots_all).astype(np.int32)
+            chosen = np.sort(slots_all).astype(np.int32)
         pending = _Pending(token=self._next_token,
                            created=time.perf_counter())
         pending.columnar = empty_columnar_outcome()
         self._next_token += 1
 
         t0 = self._rel_base(now)
-        cols = RequestColumns(
-            ids=pool.m_id[slots].copy(),
-            rating=pool.m_rating[slots].copy(),
-            rd=pool.m_rd[slots].copy(),
-            region=pool.m_region[slots].copy(),
-            mode=pool.m_mode[slots].copy(),
-            threshold=pool.m_threshold[slots].copy(),
-            enqueued_at=pool.m_enqueued[slots].copy(),
-            reply_to=pool.m_reply[slots].copy(),
-            correlation_id=pool.m_corr[slots].copy(),
-        )
-        batch = pool.batch_arrays_cols(cols, slots, self._bucket_for(slots.size), t0)
-        self._dev_pool, out = self._step_fn(batch)(
-            self._dev_pool, jnp.asarray(pack_batch(batch, now - t0))
-        )
-        pending.chunks.append(((cols, slots), (out,), now))
+        top = self.buckets[-1]
+        for start in range(0, chosen.size, top):
+            slots = chosen[start:start + top]
+            cols = RequestColumns(
+                ids=pool.m_id[slots].copy(),
+                rating=pool.m_rating[slots].copy(),
+                rd=pool.m_rd[slots].copy(),
+                region=pool.m_region[slots].copy(),
+                mode=pool.m_mode[slots].copy(),
+                threshold=pool.m_threshold[slots].copy(),
+                enqueued_at=pool.m_enqueued[slots].copy(),
+                reply_to=pool.m_reply[slots].copy(),
+                correlation_id=pool.m_corr[slots].copy(),
+            )
+            batch = pool.batch_arrays_cols(cols, slots,
+                                           self._bucket_for(slots.size), t0)
+            step = (rescan_step if rescan_step is not None
+                    else self._step_fn(batch))
+            self._dev_pool, out = step(
+                self._dev_pool, jnp.asarray(pack_batch(batch, now - t0))
+            )
+            pending.chunks.append(((cols, slots), (out,), now))
         self._submit(pending)
+        self.rescan_tokens.add(pending.token)
         return pending.token
 
     def _rescan_team(self, now: float) -> int | None:
@@ -546,10 +591,9 @@ class TpuEngine(Engine):
         match formation with CURRENT effective thresholds — without this,
         two waiting groups whose thresholds WIDENED into compatibility would
         never match under zero traffic (the same gap the 1v1 rescan closes;
-        config #3 enables widening)."""
-        assert self._open == 0, (
-            "rescan with windows in flight — collect with flush() first"
-        )
+        config #3 enables widening). Overlap-safe as-is: an all-invalid
+        batch admits nothing, and match formation reads only the on-device
+        pool, which chains in dispatch order behind in-flight windows."""
         if len(self.pool) < 2 * self.queue.team_size:
             return None
         bucket = self.buckets[0]
@@ -562,7 +606,7 @@ class TpuEngine(Engine):
                            created=time.perf_counter())
         self._next_token += 1
         self._dev_pool, out = self.kernels.search_step_packed(
-            self._dev_pool, jnp.asarray(pack_batch(batch, now - t0)))
+            self._dev_pool, jnp.asarray(self._pack(batch, now - t0)))
         pending.chunks.append(([], (out,), now))
         self._submit(pending)
         return pending.token
@@ -757,7 +801,7 @@ class TpuEngine(Engine):
             slots = self.pool.allocate(chunk)
             batch = self.pool.batch_arrays(chunk, slots, bucket, self._rel_base(now))
             self._dev_pool = self.kernels.admit_packed(
-                self._dev_pool, jnp.asarray(pack_batch(batch)))
+                self._dev_pool, jnp.asarray(self._pack(batch, 0.0, chunk)))
 
     # ---- internals --------------------------------------------------------
 
@@ -778,14 +822,18 @@ class TpuEngine(Engine):
             return False
         from matchmaking_tpu.service.contract import is_wildcard
 
-        if not any(is_wildcard(r) for r in requests):
+        if not any(self._device_blocker(r) for r in requests):
             return False
         logger.warning(
-            "team queue %r: wildcard region/mode request received — device "
-            "team kernel matches wildcards only against wildcards, so this "
-            "queue now delegates to the host oracle (exact oracle "
-            "semantics; lower throughput). Pin region+mode on every "
-            "request to stay on the device path.", self.queue.name)
+            "team queue %r: wildcard region/mode%s request received — the "
+            "device kernel groups by exact codes%s, so this queue now "
+            "delegates to the host oracle (exact oracle semantics; lower "
+            "throughput). %s", self.queue.name,
+            " or party" if self._role_device else "",
+            " and packs solo units only" if self._role_device else "",
+            "Solo requests with pinned region+mode stay on the device path."
+            if self._role_device else
+            "Pin region+mode on every request to stay on the device path.")
         from matchmaking_tpu.engine.cpu import CpuEngine
 
         if self._open:
@@ -822,20 +870,46 @@ class TpuEngine(Engine):
     def _fresh_device_pool(self):
         """Empty device-resident pool arrays for the current kernel set —
         the single bootstrap used by __init__ AND re-promotion (sharded
-        kernel sets place shards across the mesh; plain ones device_put)."""
+        kernel sets place shards across the mesh; plain ones device_put).
+        Kernel sets may declare extra columns beyond POOL_FIELDS (the role
+        kernel's role_mask)."""
         init = PlayerPool.empty_device_arrays(self.kernels.capacity)
+        for name, dt in getattr(self.kernels, "extra_pool_fields",
+                                {}).items():
+            init[name] = np.zeros(self.kernels.capacity, dt)
         place = getattr(self.kernels, "place_pool", None)
         if place is not None:
             return place(init)
         return jax.device_put({k: jnp.asarray(v) for k, v in init.items()})
 
-    def _note_wildcards(self, requests: Sequence[SearchRequest],
-                        now: float) -> None:
-        """While delegated: record wildcard arrivals (resets the quiet
-        period that gates re-promotion)."""
+    def _pack(self, batch, now_rel: float,
+              requests: Sequence[SearchRequest] = ()) -> np.ndarray:
+        """pack_batch plus, for role kernels, the role_mask row (inserted
+        before the trailing ``now`` row; padding lanes carry mask 0 —
+        invalid either way)."""
+        packed = pack_batch(batch, now_rel)
+        if not getattr(self.kernels, "is_role", False):
+            return packed
+        masks = np.zeros((1, packed.shape[1]), np.float32)
+        for j, req in enumerate(requests):
+            masks[0, j] = self.kernels.mask_of(req.roles)
+        return np.concatenate([packed[:8], masks, packed[8:]])
+
+    def _device_blocker(self, req: SearchRequest) -> bool:
+        """True if this request cannot be served by the device kernel:
+        region/mode wildcards (exact-group semantics) for every team-family
+        queue, plus parties on role queues (the device role kernel packs
+        solo units only)."""
         from matchmaking_tpu.service.contract import is_wildcard
 
-        if any(is_wildcard(r) for r in requests):
+        return is_wildcard(req) or (self._role_device and req.party_size > 1)
+
+    def _note_wildcards(self, requests: Sequence[SearchRequest],
+                        now: float) -> None:
+        """While delegated: record device-blocking arrivals (wildcards /
+        role-queue parties — resets the quiet period that gates
+        re-promotion)."""
+        if any(self._device_blocker(r) for r in requests):
             self._delegate_last_wc = now
 
     def _maybe_repromote_team(self, now: float) -> bool:
@@ -860,7 +934,7 @@ class TpuEngine(Engine):
             # re-check after the next quiet period.
             self._delegate_last_wc = now
             return False
-        if d.has_wildcards():
+        if d.has_wildcards() or (self._role_device and d.has_parties()):
             # Still trapped: restart the quiet period so the O(n) scan
             # runs at most once per period.
             self._delegate_last_wc = now
@@ -894,19 +968,21 @@ class TpuEngine(Engine):
             return
         assert self._open == 0, "warmup() with windows in flight"
         variants = [self.kernels.search_step_packed]
-        nf = getattr(self.kernels, "search_step_packed_nofilter", None)
-        if nf is not None:
-            variants.append(nf)
+        for name in ("search_step_packed_nofilter",
+                     "search_step_packed_rescan"):
+            fn = getattr(self.kernels, name, None)
+            if fn is not None:
+                variants.append(fn)
         for bucket in self.buckets:
             batch = self.pool.batch_arrays([], [], bucket)
-            packed = jnp.asarray(pack_batch(batch, 0.0))
+            packed = jnp.asarray(self._pack(batch, 0.0))
             for fn in variants:
                 self._dev_pool, out = fn(self._dev_pool, packed)
                 jax.block_until_ready(out)
             admit = getattr(self.kernels, "admit_packed", None)
             if admit is not None:
                 self._dev_pool = admit(self._dev_pool,
-                                       jnp.asarray(pack_batch(batch, 0.0)))
+                                       jnp.asarray(self._pack(batch, 0.0)))
         evict = getattr(self.kernels, "evict", None)
         if evict is not None:
             ev = jnp.full(self.kernels.evict_bucket, self.kernels.capacity,
@@ -956,7 +1032,7 @@ class TpuEngine(Engine):
         t0 = self._rel_base(now)
         batch = self.pool.batch_arrays(window, slots, bucket, t0)
         self._dev_pool, out = self._step_fn(batch)(
-            self._dev_pool, jnp.asarray(pack_batch(batch, now - t0))
+            self._dev_pool, jnp.asarray(self._pack(batch, now - t0, window))
         )
         pending.chunks.append((list(window), (out,), now))
 
@@ -1084,16 +1160,22 @@ class TpuEngine(Engine):
 
     def _finalize_team(self, pending: _Pending) -> None:
         """Map team-kernel results (slots M×need, spread, limit) back to
-        requests and split each window into two teams (scoring.snake_split —
-        the device kernel validated the sum constraint with the same signed
-        pattern, which is tie-order invariant, see scoring.snake_signs)."""
+        requests and split each window into two teams: snake split for plain
+        team queues (scoring.snake_split — the device kernel validated the
+        sum constraint with the same signed pattern, tie-order invariant),
+        or the kernel's own cover split (role queues append a bitmask row —
+        bit i set ⇔ rating-ordered member i is on team A, chosen by the
+        oracle's base/swap-repair order in role_kernels._cover_split)."""
         out = pending.outcome
         need = self.kernels.need
+        is_role = getattr(self.kernels, "is_role", False)
         for (window, _, now), (packed_out,) in zip(
                 pending.chunks, pending.raw or ()):
             slots = packed_out[:need].T.astype(np.int32)
             spread = packed_out[need]
             limit = packed_out[need + 1]
+            split = (packed_out[need + 2].astype(np.int32)
+                     if is_role else None)
             P = self.kernels.capacity
             matched_ids: set[str] = set()
             hit = slots[:, 0] < P
@@ -1101,7 +1183,14 @@ class TpuEngine(Engine):
                 row = slots[m].tolist()
                 members = [self.pool.request_at(s) for s in row]
                 matched_ids.update(r.id for r in members)
-                team_a, team_b = scoring.snake_split(members)
+                if is_role:
+                    bits = int(split[m])
+                    team_a = tuple(members[i] for i in range(need)
+                                   if bits >> i & 1)
+                    team_b = tuple(members[i] for i in range(need)
+                                   if not bits >> i & 1)
+                else:
+                    team_a, team_b = scoring.snake_split(members)
                 thr = float(limit[m])
                 qual = max(0.0, 1.0 - float(spread[m]) / thr) if thr > 0 else 0.0
                 out.matches.append(

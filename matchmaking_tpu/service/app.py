@@ -411,7 +411,23 @@ class _QueueRuntime:
 
     def _finish_token(self, tok: int, out, now: float) -> None:
         meta = self._inflight_meta.pop(tok, None)
-        if meta is None:  # rescan windows are handled by the rescan loop
+        if meta is None:
+            # Not a delivery-backed window: rescan ticks flow through the
+            # shared collector now that they overlap the pipeline.
+            if tok in getattr(self.engine, "rescan_tokens", ()):
+                self.engine.rescan_tokens.discard(tok)
+                if tok in self.engine.failed_tokens:
+                    self.engine.failed_tokens.discard(tok)
+                    log.error("rescan window %d failed on device; revive "
+                              "scheduled", tok)
+                    self.app.metrics.counters.inc("engine_crashes")
+                    # The device pool diverged at the failed step — flag the
+                    # deferred revive exactly like a failed delivery window,
+                    # or traffic keeps dispatching into the diverged pool
+                    # until the next rescan tick notices device_error.
+                    self._needs_revive = True
+                    return
+                self._publish_rescan_outcome(out, now)
             return
         by_id, deliveries = meta
         if tok in self.engine.failed_tokens:
@@ -710,52 +726,84 @@ class _QueueRuntime:
 
     async def _rescan_loop(self) -> None:
         interval = self.queue_cfg.rescan_interval_s
-        window = self.app.cfg.batcher.max_batch
+        window = (self.queue_cfg.rescan_window
+                  or self.app.cfg.batcher.max_batch)
         while True:
             await asyncio.sleep(interval)
             now = time.time()
-            outs: list = []
+            tok: int | None = None
             try:
                 async with self._engine_lock:
-                    # rescan_async requires _open == 0 (double-match hazard
-                    # re-admitting slots an in-flight window may evict).
-                    await self._drain_engine(now)
                     if hasattr(self.engine, "rescan_async"):
-                        def run():
-                            tok = self.engine.rescan_async(window, now)
-                            return self.engine.flush() if tok is not None else []
-                        outs = await asyncio.to_thread(run)
+                        # Overlap-capable engines dispatch the rescan INTO
+                        # the pipelined stream (no-admission step — see
+                        # kernels._rescan_step); the round-4 full pipeline
+                        # drain per tick is gone. Engines without the
+                        # variant keep the drained single-chunk contract.
+                        if not getattr(self.engine, "rescan_overlap", False):
+                            await self._drain_engine(now)
+                        tok = await asyncio.to_thread(
+                            self.engine.rescan_async, window, now)
+                    elif hasattr(self.engine, "rescan"):
+                        out = await asyncio.to_thread(
+                            self.engine.rescan, window, now)
+                        self._publish_rescan_outcome(out, now)
+                        continue
+            except Exception:
+                log.exception("rescan failed; reviving engine from mirror")
+                self.app.metrics.counters.inc("engine_crashes")
+                async with self._engine_lock:
+                    # _revive_locked, not a bare _revive_engine: the failure
+                    # may have set _needs_revive (failed delivery window
+                    # collected on this path) — clearing the flags here
+                    # prevents a second spurious revive of the fresh engine.
+                    self._revive_locked(now)
+                continue
+            if tok is None:
+                continue
+            # Wait for the tick's results WITHOUT draining: poll the shared
+            # collector (which routes rescan tokens to
+            # _publish_rescan_outcome via _finish_token). In-order FIFO
+            # finalization means the token lands once the windows dispatched
+            # before it have landed — traffic keeps flowing the whole time.
+            deadline = time.monotonic() + 30.0
+            try:
+                while time.monotonic() < deadline:
+                    async with self._engine_lock:
+                        self._collect_ready_locked(time.time())
+                        done = tok not in self.engine.rescan_tokens
                         if self.engine.device_error is not None:
                             err = self.engine.device_error
                             self.engine.device_error = None
                             raise err
-                    elif hasattr(self.engine, "rescan"):
-                        out = await asyncio.to_thread(
-                            self.engine.rescan, window, now)
-                        outs = [(0, out)]
+                    if done:
+                        break
+                    await asyncio.sleep(0.01)
             except Exception:
                 log.exception("rescan failed; reviving engine from mirror")
                 self.app.metrics.counters.inc("engine_crashes")
-                self._revive_engine(now)
-                continue
-            matched = 0
-            for _tok, out in outs:
-                if hasattr(out, "m_id_a"):  # ColumnarOutcome: matches only —
-                    # q_ids are unmatched RESCANS, not newly queued players.
-                    matched += out.n_matches
-                    self._publish_columnar_matches(out, now)
-                else:  # object outcome (CPU oracle): matches only, same rule
-                    matched += len(out.matches)
-                    if self._invariants is not None:
-                        self._invariants.observe_outcome(out)
-                    for match in out.matches:
-                        result = match.result()
-                        for req in match.requests():
-                            self._publish_matched(
-                                req.id, req.reply_to, req.correlation_id,
-                                req.enqueued_at, result, now)
-            if matched:
-                self.app.metrics.counters.inc("rescan_matches", matched)
+                async with self._engine_lock:
+                    self._revive_locked(now)
+
+    def _publish_rescan_outcome(self, out, now: float) -> None:
+        """Publish one rescan outcome's matches. q_ids / queued are
+        unmatched RESCANS, not newly queued players — never re-acked."""
+        matched = 0
+        if hasattr(out, "m_id_a"):  # ColumnarOutcome
+            matched += out.n_matches
+            self._publish_columnar_matches(out, now)
+        else:  # object outcome (CPU oracle / team queues)
+            matched += len(out.matches)
+            if self._invariants is not None:
+                self._invariants.observe_outcome(out)
+            for match in out.matches:
+                result = match.result()
+                for req in match.requests():
+                    self._publish_matched(
+                        req.id, req.reply_to, req.correlation_id,
+                        req.enqueued_at, result, now)
+        if matched:
+            self.app.metrics.counters.inc("rescan_matches", matched)
 
     # ---- timeout sweeper --------------------------------------------------
 

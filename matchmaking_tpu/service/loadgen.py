@@ -1,0 +1,103 @@
+"""Self-driving service worker: boot the app from env (the same snapshot
+plumbing ``service.multiproc`` workers use), offer a Poisson request load to
+its own in-process broker, and write one JSON result line to a file.
+
+Why this exists: the environment has no RabbitMQ (SURVEY.md §7 [ENV]), so a
+multi-process ingress benchmark cannot drive N workers through a shared
+network broker. Each worker instead drives itself — the full ingress path
+(broker → decode → middleware → batcher → engine → publish) runs in-process,
+which is exactly the per-consumer work the reference fans out across AMQP
+consumers. The supervisor-level bench (bench.py --multiproc phase) spawns N
+of these via WorkerSupervisor and sums the per-worker throughput.
+
+Env contract (set by the bench on top of the multiproc worker env):
+    MM_LOADGEN_RATE     offered req/s (Poisson)
+    MM_LOADGEN_SECONDS  measured duration
+    MM_LOADGEN_OUT      path for the one-line JSON result
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import time
+
+import numpy as np
+
+
+async def _run() -> dict:
+    from matchmaking_tpu.config import Config
+    from matchmaking_tpu.service.app import MatchmakingApp
+    from matchmaking_tpu.service.broker import Properties
+
+    cfg = Config.from_env()
+    rate = float(os.environ.get("MM_LOADGEN_RATE", "10000"))
+    duration = float(os.environ.get("MM_LOADGEN_SECONDS", "4"))
+    app = MatchmakingApp(cfg)
+    await app.start()
+    queue = cfg.queues[0].name
+
+    reply_q = "loadgen.replies"
+    app.broker.declare_queue(reply_q)
+    replies = {"n": 0, "matched": 0}
+
+    async def on_reply(delivery) -> None:
+        replies["n"] += 1
+        if b'"matched"' in delivery.body:
+            replies["matched"] += 1
+
+    app.broker.basic_consume(reply_q, on_reply, prefetch=1_000_000)
+
+    rng = np.random.default_rng(os.getpid())
+    n_max = int(rate * duration * 2) + 16
+    # Consecutive near-equal ratings: arrivals pair off almost immediately,
+    # keeping the CPU-oracle pool tiny so the measured cost is INGRESS
+    # (decode → middleware → batcher → publish), not the O(pool) scan.
+    ratings = np.repeat(rng.normal(1500.0, 300.0, size=n_max // 2 + 1), 2)
+    gaps = rng.exponential(1.0 / rate, size=n_max)
+    sched = np.cumsum(gaps)
+    t0 = time.perf_counter()
+    i = 0
+    while i < n_max and sched[i] <= duration:
+        now_rel = time.perf_counter() - t0
+        while i < n_max and sched[i] <= min(now_rel, duration):
+            pid = f"g{os.getpid()}_{i}"
+            app.broker.publish(
+                queue,
+                f'{{"id":"{pid}","rating":{ratings[i]:.2f}}}'.encode(),
+                Properties(reply_to=reply_q, correlation_id=pid))
+            i += 1
+        if i < n_max and sched[i] > now_rel:
+            await asyncio.sleep(min(sched[i] - now_rel, 0.005))
+    span = time.perf_counter() - t0
+    for _ in range(200):  # drain
+        await asyncio.sleep(0.025)
+        if (app.broker.queue_depth(queue) == 0
+                and app.broker.handlers_idle()):
+            break
+    out = {
+        "pid": os.getpid(),
+        "queue": queue,
+        "offered_req_s": rate,
+        "sent": i,
+        "sent_req_s": round(i / span, 1),
+        "players_matched": replies["matched"],
+        "matched_per_s": round(replies["matched"] / span, 1),
+    }
+    await app.stop()
+    return out
+
+
+def main() -> None:
+    result = asyncio.run(_run())
+    path = os.environ.get("MM_LOADGEN_OUT", "")
+    line = json.dumps(result, sort_keys=True)
+    if path:
+        with open(path, "w") as f:
+            f.write(line + "\n")
+    print(line, flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -10,10 +10,11 @@ in BENCH_CONFIGS.md. Configs:
 2. multiqueue_filters   columnar with region/mode hard filters in-kernel
 3. team_5v5             device team kernel (object API windows)
 4. glicko2              columnar with rating-deviation-weighted distance
-5. role_party           host-side oracle — measured at a LADDER of pool
-                        sizes to record its scale ceiling (it is O(n^2)
-                        windows x backtracking by design, config-gated off
-                        the 1v1 hot path)
+5. role_solo_device     device role kernel (round 5) — solo role traffic
+                        at the team bench's scale
+   role_party           host-side oracle (parties delegate there) — a
+                        LADDER of pool sizes records its scale ceiling
+                        (O(n^2) windows x backtracking by design)
 
 Run with PYTHONPATH=/root/repo:/root/.axon_site on the TPU, or
 JAX_PLATFORMS=cpu for a mechanics smoke.
@@ -161,6 +162,101 @@ def bench_team_5v5(*, pool, capacity, window, windows, depth=2):
             "path": f"device team kernel (pipelined depth={depth})"}
 
 
+def bench_role_solo_device(*, pool, capacity, window, windows, depth=2):
+    """Device role kernel (round 5 — engine/role_kernels.py) through the
+    pipelined object API: solo players with declared roles at the team
+    bench's scale. The round-4 host ladder ceiling was ~2-4k pool at 8 ms
+    per arrival; this is the ≥10× device answer for solo traffic (parties
+    still delegate — the ladder below keeps their honest oracle numbers).
+    Role mix is dps-heavy (55% dps / 15% tank / 15% healer / 15% any) so
+    matches gate on scarce roles like production."""
+    from matchmaking_tpu.config import Config, EngineConfig, QueueConfig
+    from matchmaking_tpu.engine.interface import make_engine
+    from matchmaking_tpu.service.contract import SearchRequest
+
+    roles = ("tank", "healer", "dps", "dps", "dps")
+    cfg = Config(
+        queues=(QueueConfig(team_size=5, rating_threshold=120.0,
+                            role_slots=roles,
+                            widen_per_sec=2.0, max_threshold=300.0),),
+        engine=EngineConfig(backend="tpu", pool_capacity=capacity,
+                            team_max_matches=512,
+                            batch_buckets=(16, 64, 256, window)),
+    )
+    engine = make_engine(cfg, cfg.queues[0])
+    rng = np.random.default_rng(21)
+    next_id = 0
+
+    def reqs(n, now):
+        nonlocal next_id
+        picks = rng.random(n)
+        out = []
+        for i in range(n):
+            if picks[i] < 0.55:
+                rr = ("dps",)
+            elif picks[i] < 0.70:
+                rr = ("tank",)
+            elif picks[i] < 0.85:
+                rr = ("healer",)
+            else:
+                rr = ()
+            out.append(SearchRequest(
+                id=f"s{next_id + i}", rating=float(rng.normal(1500, 150)),
+                region="eu", game_mode="ranked", roles=rr, enqueued_at=now))
+        next_id += n
+        return out
+
+    def refill(now):
+        deficit = pool - engine.pool_size()
+        while deficit > 0:
+            chunk = min(deficit, 4096)
+            engine.restore(reqs(chunk, now), now)
+            deficit -= chunk
+
+    now = 1.0
+    refill(now)
+    log(f"[role_solo] pool filled to {engine.pool_size()}")
+    lats, players = [], 0
+    submit_t, timed = {}, {}
+    t_start = t_last = None
+
+    def handle(tok, out):
+        nonlocal players, t_last
+        lat = time.perf_counter() - submit_t.pop(tok)
+        if timed.pop(tok):
+            lats.append(lat)
+            players += sum(len(t) for m in out.matches for t in m.teams)
+            t_last = time.perf_counter()
+
+    for i in range(3 + windows):
+        window_reqs = reqs(window, now)
+        if i == 3:
+            t_start = time.perf_counter()
+        tok, _ = engine.search_async(window_reqs, now)
+        submit_t[tok] = time.perf_counter()
+        timed[tok] = i >= 3
+        now += 1e-3
+        for tok2, out in engine.collect_ready():
+            handle(tok2, out)
+        while engine.inflight() >= depth:
+            got = engine.collect_ready()
+            if not got:
+                time.sleep(0.0005)
+            for tok2, out in got:
+                handle(tok2, out)
+        refill(now)
+    for tok2, out in engine.flush():
+        handle(tok2, out)
+    span = (t_last - t_start) if (t_start and t_last and t_last > t_start) \
+        else 0.0
+    p50, p99 = _pctls(lats)
+    return {"config": "role_solo_device",
+            "matches_per_sec": round(players / 10.0 / span, 1) if span else 0.0,
+            "players_matched_per_sec": round(players / span, 1) if span else 0.0,
+            "p50_ms": p50, "p99_ms": p99, "pool": pool, "window": window,
+            "path": f"device role kernel (pipelined depth={depth})"}
+
+
 def bench_role_party_ladder(*, windows=8):
     """Host-oracle role/party path: latency vs pool size ladder → the
     measured scale ceiling (largest pool with p99 window < 250 ms).
@@ -293,6 +389,9 @@ def main() -> None:
             windows=args.windows, depth=args.depth,
             gen_kwargs=dict(rd=True)))
     if 5 in which:
+        results.append(bench_role_solo_device(
+            pool=args.team_pool, capacity=args.team_capacity,
+            window=args.team_window, windows=args.team_windows))
         results.append(bench_role_party_ladder())
 
     for r in results:

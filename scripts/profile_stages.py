@@ -166,6 +166,80 @@ def mode_prunecheck(args):
             f"{min(times):.3f}/{statistics.median(times):.3f}/{max(times):.3f}")
 
 
+def mode_rescanstall(args):
+    """Throughput/latency dent of a rescan tick under sustained load:
+    streams pipelined windows (depth 4) with one rescan every
+    --rescan-every windows, comparing the round-5 OVERLAP discipline (the
+    no-admission rescan step joins the pipelined stream) against the
+    round-4 DRAIN discipline (flush the pipeline, rescan, flush again).
+    The windows keep matching ~everything, so pool size is held by refill
+    and the rescan itself finds nothing — isolating pure scheduling cost."""
+    import statistics as st
+
+    for discipline in ("overlap", "drain"):
+        engine, rng, next_id = build_engine(
+            args.pool, args.capacity, args.window,
+            pool_block=args.pool_block, readback_group=args.readback_group)
+        engine.warmup()   # all step variants incl. the rescan one: no
+        # mid-measurement XLA compile can pollute either discipline.
+
+        def refill(now):
+            nonlocal next_id
+            while engine.pool_size() < args.pool:
+                chunk = min(args.pool - engine.pool_size(), 8192)
+                engine.restore_columns(
+                    make_columns(rng, chunk, next_id, now), now)
+                next_id += chunk
+
+        lat, matches = [], 0
+        submit = {}
+        t0 = time.perf_counter()
+
+        def wall():
+            return time.perf_counter() - t0
+
+        def drainall():
+            for tok, out in engine.flush():
+                if tok in submit:
+                    lat.append(time.perf_counter() - submit.pop(tok))
+            engine.rescan_tokens.clear()
+
+        n = args.iters * args.reps
+        t_start = None
+        for i in range(n + 5):
+            if i == 5:
+                t_start = time.perf_counter()
+                matches = 0
+            if i % args.rescan_every == 0 and i > 0:
+                if discipline == "drain":
+                    drainall()
+                    engine.rescan_async(args.window, wall())
+                    drainall()
+                else:
+                    engine.rescan_async(args.window, wall())
+            cols = make_columns(rng, args.window, next_id, wall())
+            next_id += args.window
+            tok = engine.search_columns_async(cols, wall())
+            submit[tok] = time.perf_counter()
+            while engine.inflight() >= args.depth:
+                got = engine.collect_ready()
+                if not got:
+                    time.sleep(0.0005)
+                for tok2, out in got:
+                    if tok2 in submit:
+                        lat.append(time.perf_counter() - submit.pop(tok2))
+                        matches += getattr(out, "n_matches", 0)
+                    engine.rescan_tokens.discard(tok2)
+            refill(wall())
+        drainall()
+        span = time.perf_counter() - t_start
+        ls = sorted(lat)
+        log(f"[rescanstall {discipline}] {matches / span:,.0f} matches/s, "
+            f"window p50 {st.median(ls) * 1e3:.1f} ms, "
+            f"p99 {ls[int(len(ls) * 0.99) - 1] * 1e3:.1f} ms "
+            f"({n} windows, rescan every {args.rescan_every})")
+
+
 def mode_dispatch(args):
     """Host cost of one cached dispatch at increasing numbers of
     already-enqueued (unconsumed) steps — exposes tunnel backpressure."""
@@ -278,8 +352,10 @@ def mode_sweep(args):
 def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--mode", choices=("device", "dispatch", "window", "sweep",
-                                      "prunecheck"),
+                                      "prunecheck", "rescanstall"),
                    default="device")
+    p.add_argument("--rescan-every", type=int, default=10,
+                   help="rescanstall: windows between rescan ticks")
     p.add_argument("--pool", type=int, default=100_000)
     p.add_argument("--capacity", type=int, default=131_072)
     p.add_argument("--window", type=int, default=2048)
@@ -303,7 +379,8 @@ def main():
     log(f"jax {jax.__version__} devices={jax.devices()}")
     dict(device=mode_device, dispatch=mode_dispatch,
          window=mode_window, sweep=mode_sweep,
-         prunecheck=mode_prunecheck)[args.mode](args)
+         prunecheck=mode_prunecheck,
+         rescanstall=mode_rescanstall)[args.mode](args)
 
 
 if __name__ == "__main__":

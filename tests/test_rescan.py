@@ -59,20 +59,59 @@ class TestEngineRescan:
         eng = make_engine(_cfg(q), q)
         assert eng.rescan_async(16, now=1.0) is None
 
-    def test_rescan_with_window_in_flight_refuses(self):
-        """A rescan while a window is in flight could re-admit — from the
-        not-yet-finalized mirror — a slot that window already matched,
-        resurrecting a matched player into a double match. The ENGINE must
-        refuse (not just the service's lock convention)."""
+    def test_rescan_overlaps_in_flight_window_without_double_match(self):
+        """The no-admission rescan step (kernels._rescan_step) makes the
+        round-4 hazard structurally impossible: a rescan dispatched while a
+        window is IN FLIGHT builds its lanes from the stale mirror — which
+        still lists players the in-flight window is matching — but the
+        device-side active gate turns those lanes into no-ops instead of
+        resurrecting them."""
         q = _q()
         eng = make_engine(_cfg(q), q)
+        # A and C wait (restore never matches); B arrives and will take A
+        # (d=2 beats d=3) in a window we deliberately do NOT collect.
         eng.restore([_req(0, 1500.0, 0.0), _req(1, 1505.0, 0.0)], 0.0)
-        eng.search_async([_req(2, 1502.0, 0.0)], 0.0)  # in flight
-        with pytest.raises(AssertionError):
-            eng.rescan_async(16, now=1.0)
-        eng.flush()
-        assert eng.rescan_async(16, now=1.0) is not None  # fine after flush
-        eng.flush()
+        eng.search_async([_req(2, 1502.0, 0.0)], 0.5)          # in flight
+        tok = eng.rescan_async(16, now=1.0)                    # overlapped
+        assert tok is not None and tok in eng.rescan_tokens
+        outs = dict(eng.flush())
+        matched = []
+        for out in outs.values():
+            if hasattr(out, "m_id_a"):           # columnar (the rescan)
+                matched += list(out.m_id_a) + list(out.m_id_b)
+            else:                                # object (the search window)
+                matched += [r.id for m in out.matches for t in m.teams
+                            for r in t]
+        assert sorted(matched) == ["p0", "p2"]   # A+B once; C untouched
+        assert eng.pool_size() == 1              # C still waits
+        # The token stays routable until a collector consumes it (the
+        # service's _finish_token discards it when publishing).
+        assert tok in eng.rescan_tokens
+        eng.rescan_tokens.discard(tok)
+
+    def test_multi_chunk_rescan_resolves_whole_pool_in_one_tick(self):
+        """rescan_window > one bucket: the tick spans multiple no-admission
+        chunks, so pool-wide widening resolution no longer takes one bucket
+        per tick — and chunks cannot double-match across each other (later
+        chunks see earlier chunks' retirements via the device pool)."""
+        q = _q()
+        eng = make_engine(_cfg(q), q)   # buckets (16,); threshold 80
+        # 20 latent pairs, pair i at rating 5000*i (+0/+5): partners match
+        # (d=5), nothing else comes close. 40 players = 3 chunks of 16.
+        reqs = []
+        for i in range(20):
+            reqs.append(_req(2 * i, 5000.0 * i, 0.0))
+            reqs.append(_req(2 * i + 1, 5000.0 * i + 5.0, 0.0))
+        eng.restore(reqs, 0.0)
+        assert eng.rescan_async(64, now=1.0) is not None
+        outs = dict(eng.flush())
+        pairs = set()
+        for out in outs.values():
+            for a, b in zip(out.m_id_a, out.m_id_b):
+                pairs.add(tuple(sorted((a, b))))
+        assert len(pairs) == 20
+        assert all(int(a[1:]) // 2 == int(b[1:]) // 2 for a, b in pairs)
+        assert eng.pool_size() == 0
 
     def test_oldest_players_prioritized(self):
         q = _q()

@@ -1,0 +1,209 @@
+"""Device role-queue kernel (engine/role_kernels.py) — BASELINE config #5.
+
+Layering mirrors test_teams_device.py: sequential oracle equivalence (the
+reference's one-scan-per-request semantics) against the role/party oracle
+(engine/roles.py via CpuEngine), targeted cover/swap-repair cases, then the
+party/wildcard delegation round-trip."""
+
+import numpy as np
+import pytest
+
+from matchmaking_tpu.config import Config, EngineConfig, QueueConfig
+from matchmaking_tpu.engine.cpu import CpuEngine
+from matchmaking_tpu.engine.interface import make_engine
+from matchmaking_tpu.service.contract import PartyMember, SearchRequest
+
+SLOTS5 = ("tank", "healer", "dps", "dps", "dps")
+SLOTS2 = ("tank", "dps")
+
+
+def _req(i, rating, roles=(), region="eu", mode="std", thr=None, party=()):
+    return SearchRequest(id=f"p{i}", rating=float(rating), region=region,
+                         game_mode=mode, rating_threshold=thr,
+                         roles=tuple(roles), party=tuple(party),
+                         enqueued_at=0.0)
+
+
+def _cfg(slots, capacity=256, max_matches=32, **qkw):
+    q = QueueConfig(team_size=len(slots), role_slots=tuple(slots),
+                    rating_threshold=50.0, **qkw)
+    return Config(queues=(q,), engine=EngineConfig(
+        backend="tpu", pool_capacity=capacity, pool_block=64,
+        batch_buckets=(16, 64), team_max_matches=max_matches))
+
+
+def _match_key(match):
+    teams = tuple(sorted(tuple(sorted(r.id for r in team))
+                         for team in match.teams))
+    return teams
+
+
+class TestSequentialOracleEquivalence:
+    @pytest.mark.parametrize("slots", [SLOTS2, SLOTS5])
+    def test_matches_identical_to_oracle(self, slots):
+        """Distinct ratings, solo players with random declared roles (incl.
+        the wildcard empty set): the device step must form match-for-match
+        identical teams to the role oracle, arrival by arrival — including
+        the TEAM SPLIT (the cover/swap-repair choice), not just the member
+        set."""
+        cfg = _cfg(slots)
+        tpu = make_engine(cfg, cfg.queues[0])
+        cpu = CpuEngine(cfg, cfg.queues[0])
+        rng = np.random.default_rng(23)
+        ratings = rng.permutation(600)[:140] + 1200   # distinct
+        vocab = tuple(sorted(set(slots))) + ((),)     # () = any role
+
+        for i, r in enumerate(ratings):
+            pick = rng.integers(0, len(vocab) + 1)
+            if pick >= len(vocab):
+                roles = tuple(rng.permutation(
+                    np.array(sorted(set(slots))))[:2])  # two-role players
+            else:
+                roles = vocab[pick] if isinstance(vocab[pick], tuple) \
+                    else (vocab[pick],)
+            now = float(i)
+            out_t = tpu.search([_req(i, r, roles)], now)
+            out_c = cpu.search([_req(i, r, roles)], now)
+            assert len(out_t.matches) == len(out_c.matches), f"step {i}"
+            for mt, mc in zip(out_t.matches, out_c.matches):
+                assert _match_key(mt) == _match_key(mc), f"step {i}"
+                # Split equality: same unordered team partition.
+                ta_t = {r.id for r in mt.teams[0]}
+                ta_c = {r.id for r in mc.teams[0]}
+                assert ta_t in ({r.id for r in mc.teams[0]},
+                                {r.id for r in mc.teams[1]}), f"step {i}"
+                assert mt.quality == pytest.approx(mc.quality, abs=1e-4)
+            assert tpu.pool_size() == cpu.pool_size(), f"step {i}"
+
+    def test_equivalence_with_widening(self):
+        q = QueueConfig(team_size=2, role_slots=SLOTS2,
+                        rating_threshold=20.0, widen_per_sec=4.0,
+                        max_threshold=120.0)
+        cfg = Config(queues=(q,), engine=EngineConfig(
+            backend="tpu", pool_capacity=128, pool_block=64,
+            batch_buckets=(16,), team_max_matches=16))
+        tpu = make_engine(cfg, q)
+        cpu = CpuEngine(cfg, q)
+        rng = np.random.default_rng(5)
+        ratings = rng.permutation(500)[:60] + 1000
+        roles_cycle = [("tank",), ("dps",), (), ("tank", "dps")]
+        for i, r in enumerate(ratings):
+            now = float(i) * 2.0
+            req = _req(i, int(r), roles_cycle[i % 4])
+            out_t = tpu.search([req], now)
+            out_c = cpu.search([_req(i, int(r), roles_cycle[i % 4])], now)
+            assert [_match_key(m) for m in out_t.matches] == \
+                [_match_key(m) for m in out_c.matches], f"step {i}"
+
+
+class TestCoverSemantics:
+    def test_no_match_without_required_roles(self):
+        """Four dps-only players cannot fill 2x(tank, dps) — the window must
+        stay unmatched on device exactly as the oracle leaves it."""
+        cfg = _cfg(SLOTS2)
+        tpu = make_engine(cfg, cfg.queues[0])
+        out = tpu.search([_req(i, 1500 + i, ("dps",)) for i in range(4)], 0.0)
+        assert not out.matches
+        assert tpu.pool_size() == 4
+        # One tank arrives: still not enough (need 2 tanks).
+        out = tpu.search([_req(10, 1502, ("tank",))], 1.0)
+        assert not out.matches
+        # The second tank completes the match.
+        out = tpu.search([_req(11, 1503, ("tank",))], 2.0)
+        assert len(out.matches) == 1
+        m = out.matches[0]
+        for team in m.teams:
+            roles = [r.roles for r in team]
+            assert ("tank",) in roles        # each team got one tank
+        assert tpu.pool_size() == 2          # two dps left over
+
+    def test_swap_repair_split_matches_oracle(self):
+        """Ratings arranged so the base low-k/high-k split puts both tanks
+        on one team: the kernel must pick the same swap the oracle's
+        (i, j)-ordered repair pass picks."""
+        cfg = _cfg(SLOTS2)
+        tpu = make_engine(cfg, cfg.queues[0])
+        cpu = CpuEngine(cfg, cfg.queues[0])
+        reqs = [
+            _req(0, 1500, ("tank",)),
+            _req(1, 1501, ("tank",)),    # base split: both tanks in team A
+            _req(2, 1502, ("dps",)),
+            _req(3, 1503, ("dps",)),
+        ]
+        for j, r in enumerate(reqs):
+            out_t = tpu.search([r], float(j))
+            out_c = cpu.search([SearchRequest(**{**r.__dict__})], float(j))
+            assert len(out_t.matches) == len(out_c.matches)
+        assert out_t.matches and out_c.matches
+        mt, mc = out_t.matches[0], out_c.matches[0]
+        assert _match_key(mt) == _match_key(mc)
+        ta_t = {r.id for r in mt.teams[0]}
+        assert ta_t in ({r.id for r in mc.teams[0]},
+                        {r.id for r in mc.teams[1]})
+        for team in mt.teams:               # every team covers (tank, dps)
+            roles = {r.roles[0] for r in team}
+            assert roles == {"tank", "dps"}
+
+    def test_wildcard_role_players_fill_anything(self):
+        cfg = _cfg(SLOTS2)
+        tpu = make_engine(cfg, cfg.queues[0])
+        out = tpu.search([_req(i, 1500 + i) for i in range(4)], 0.0)
+        assert len(out.matches) == 1         # no declared roles = any slot
+        assert tpu.pool_size() == 0
+
+
+class TestDelegation:
+    def test_party_request_delegates_and_matches_via_oracle(self):
+        """A party request flips the role queue to the host oracle (device
+        packs solo units only), where it matches with the waiting solos."""
+        cfg = _cfg(SLOTS2)
+        tpu = make_engine(cfg, cfg.queues[0])
+        solos = [_req(0, 1500, ("tank",)), _req(1, 1501, ("dps",)),
+                 _req(2, 1502, ("tank",))]
+        out = tpu.search(solos, 0.0)
+        assert not out.matches and tpu._team_delegate is None
+        party = _req(9, 1503, ("tank",),
+                     party=(PartyMember("p9b", 1504.0, roles=("dps",)),))
+        # Party of 2 covering (tank, dps): fills one whole team.
+        out = tpu.search([party], 1.0)
+        assert tpu._team_delegate is not None
+        assert tpu.counters["team_delegated"] == 1
+        assert len(out.matches) == 1
+        ids = {i for t in out.matches[0].teams for p in t
+               for i in p.all_ids()}
+        assert {"p9", "p9b"} <= ids
+
+    def test_repromotes_after_parties_drain(self):
+        cfg = _cfg(SLOTS2)
+        tpu = make_engine(cfg, cfg.queues[0])
+        party = _req(0, 1500, ("tank",),
+                     party=(PartyMember("p0b", 1501.0, roles=("dps",)),))
+        tpu.search([party], 0.0)
+        assert tpu._team_delegate is not None
+        assert tpu.remove("p0") is not None          # cancel the party
+        out = tpu.search([_req(1, 1510, ("tank",))], 10.0)  # quiet elapsed
+        assert tpu._team_delegate is None            # promoted back
+        assert tpu.counters["team_repromoted"] == 1
+        # Device path live again: complete a full 2v2.
+        out = tpu.search([_req(2, 1511, ("dps",)), _req(3, 1512, ("tank",)),
+                          _req(4, 1513, ("dps",))], 11.0)
+        assert len(out.matches) == 1
+        assert tpu.pool_size() == 0
+
+
+def test_checkpoint_roundtrip_preserves_roles():
+    """waiting() → restore() must carry declared roles through the mirror
+    (m_roles): a restored pool forms the same role-valid matches."""
+    cfg = _cfg(SLOTS2)
+    a = make_engine(cfg, cfg.queues[0])
+    a.search([_req(0, 1500, ("tank",)), _req(1, 1501, ("dps",)),
+              _req(2, 1502, ("tank",))], 0.0)
+    snap = a.waiting()
+    assert {tuple(r.roles) for r in snap} == {("tank",), ("dps",)}
+    b = make_engine(cfg, cfg.queues[0])
+    b.restore(snap, 1.0)
+    assert b.pool_size() == 3
+    out = b.search([_req(3, 1503, ("dps",))], 2.0)
+    assert len(out.matches) == 1
+    for team in out.matches[0].teams:
+        assert {r.roles[0] for r in team} == {"tank", "dps"}
