@@ -9,6 +9,7 @@ FROM ${BASE_IMAGE}
 WORKDIR /app
 COPY matchmaking_tpu/ matchmaking_tpu/
 COPY native/ native/
+COPY configs/ configs/
 COPY bench.py README.md ./
 
 # Native codec: build ahead of time when a toolchain is present (the Python

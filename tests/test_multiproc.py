@@ -211,3 +211,29 @@ def test_two_real_serve_workers_boot_and_stop():
             assert p.wait(timeout=30.0) == 0       # clean SIGTERM shutdown
     finally:
         sup.stop()
+
+
+def test_loadgen_worker_under_supervisor(tmp_path):
+    """The multiproc bench contract: a supervised self-driving loadgen
+    worker (service/loadgen.py) boots from the config snapshot, offers its
+    Poisson load to its own in-proc broker, writes a JSON result, and
+    exits 0."""
+    out = tmp_path / "lg.json"
+    cfg = Config(queues=(QueueConfig(name="lg0", send_queued_ack=False),),
+                 engine=EngineConfig(backend="cpu", pool_capacity=1024))
+    sup = _fast_children(WorkerSupervisor(
+        cfg, 1,
+        command=[sys.executable, "-m", "matchmaking_tpu.service.loadgen"],
+        extra_env={0: {"MM_LOADGEN_RATE": "3000",
+                       "MM_LOADGEN_SECONDS": "1.0",
+                       "MM_LOADGEN_OUT": str(out)}}))
+    sup.start()
+    try:
+        assert sup.workers[0].proc.wait(timeout=60) == 0
+    finally:
+        sup.stop()
+    r = json.loads(out.read_text())
+    assert r["queue"] == "lg0"
+    assert r["sent"] > 1000
+    # Paired consecutive ratings: nearly everything matches immediately.
+    assert r["players_matched"] >= 0.9 * r["sent"]

@@ -28,8 +28,13 @@ def test_soak_faulty_broker_no_double_match(readback_group):
     path (full stacks, loose stale seals, flush force-seals) under the same
     drop/dup fault injection and pipelined service flushes."""
     async def run():
+        # rescan_window > the top bucket: every tick is a MULTI-CHUNK
+        # overlapped rescan (round 5's no-admission step) racing the
+        # pipelined flushes under fault injection — the invariant checker
+        # would catch any resurrection/double-match it allowed.
         q = QueueConfig(rating_threshold=60.0, widen_per_sec=20.0,
-                        max_threshold=300.0, rescan_interval_s=0.05)
+                        max_threshold=300.0, rescan_interval_s=0.05,
+                        rescan_window=1024)
         cfg = Config(
             queues=(q,),
             engine=EngineConfig(backend="tpu", pool_capacity=1024,
