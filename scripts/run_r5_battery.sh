@@ -1,0 +1,41 @@
+#!/bin/bash
+# Round-5 TPU measurement battery. Waits for the axon tunnel to recover,
+# then runs every pending measurement in priority order, leaving logs in
+# the repo root (*.log is gitignored; committed artifacts are written by
+# the tools themselves, e.g. BENCH_CONFIGS.md).
+set -u
+cd /root/repo
+export PYTHONPATH=/root/repo:/root/.axon_site
+
+probe() {
+  timeout 70 python -u -c \
+    "import jax, jax.numpy as jnp; (jnp.ones(8)+1).block_until_ready()" \
+    2>/dev/null
+}
+
+echo "[battery] waiting for tunnel ($(date +%H:%M))"
+for i in $(seq 1 200); do
+  if probe; then echo "[battery] tunnel up after $i probes ($(date +%H:%M))"; break; fi
+  if [ "$i" = 200 ]; then echo "[battery] gave up"; exit 1; fi
+  sleep 45
+done
+
+echo "[battery] 1/4 bench_configs --out BENCH_CONFIGS.md"
+timeout 2400 python scripts/bench_configs.py --out BENCH_CONFIGS.md \
+  > bench_configs_r5.json 2> bench_configs_r5.log
+echo "[battery] bench_configs rc=$?"
+
+echo "[battery] 2/4 full bench"
+timeout 1800 python bench.py > bench_r5.json 2> bench_r5.log
+echo "[battery] bench rc=$?"
+
+echo "[battery] 3/4 latency mode"
+timeout 1200 python bench.py --latency > bench_r5_latency.json 2> bench_r5_latency.log
+echo "[battery] latency rc=$?"
+
+echo "[battery] 4/4 rescanstall"
+timeout 1200 python scripts/profile_stages.py --mode rescanstall \
+  --window 2048 --iters 15 --reps 2 --rescan-every 10 \
+  > /dev/null 2> rescanstall_r5.log
+echo "[battery] rescanstall rc=$?"
+echo "[battery] DONE ($(date +%H:%M))"
