@@ -51,10 +51,16 @@ import numpy as np
 from matchmaking_tpu.engine.kernels import (
     _ADMIT_FIELDS,
     _admit_block,
-    _effective_threshold,
     unpack_batch,
 )
-from matchmaking_tpu.engine.teams import TeamKernelSet, _BIG_I32, _INF
+from matchmaking_tpu.engine.teams import (
+    TeamKernelSet,
+    _BIG_I32,
+    _INF,
+    extract_windows,
+    shard_evict,
+    shard_localize,
+)
 from jax import lax
 
 
@@ -221,16 +227,8 @@ class RoleKernelSet(TeamKernelSet):
         valid, spread, win_thr, split = self._windows_roles(
             pool, order, group, now)
         won = self._select_leftmost(valid)
-
-        score = jnp.where(won, -jnp.arange(won.shape[0], dtype=jnp.int32),
-                          -_BIG_I32)
-        topv, topi = jax.lax.top_k(score, self.max_matches)
-        is_match = topv > -_BIG_I32
-        w = jnp.where(is_match, topi, 0)
-        member_pos = (w[:, None]
-                      + jnp.arange(self.need, dtype=jnp.int32)[None, :])
-        slots = order[member_pos]
-        slots = jnp.where(is_match[:, None], slots, self.capacity)
+        slots, is_match, w = extract_windows(
+            won, self.need, self.max_matches, order, self.capacity)
         pool = self._base._evict(pool, slots.reshape(-1))
         out_spread = jnp.where(is_match, spread[w], _INF)
         out_thr = jnp.where(is_match, win_thr[w], 0.0)
@@ -257,4 +255,132 @@ def role_kernel_set(capacity: int, team_size: int,
         capacity=capacity, team_size=team_size, role_slots=role_slots,
         widen_per_sec=widen_per_sec, max_threshold=max_threshold,
         max_matches=max_matches, rounds=rounds,
+    )
+
+
+class ShardedRoleKernelSet:
+    """Multi-chip solo role-queue matching: pool sharded over mesh axis
+    ``"pool"``, window formation replicated on gathered columns — the same
+    shape as ShardedTeamKernelSet (teams.py), plus the role_mask column in
+    both the shard slice and the gather. Call surface mirrors
+    RoleKernelSet's packed API; TpuEngine swaps it in when
+    ``mesh_pool_axis > 1`` on a role queue."""
+
+    is_role = True
+    extra_pool_fields = RoleKernelSet.extra_pool_fields
+    pack_rows = RoleKernelSet.pack_rows
+
+    _GATHER = ("rating", "region", "mode", "threshold", "enqueue_t",
+               "active", "role_mask")
+
+    def __init__(self, *, capacity: int, team_size: int,
+                 role_slots: tuple[str, ...], widen_per_sec: float,
+                 max_threshold: float, mesh, max_matches: int = 1024,
+                 rounds: int = 16, evict_bucket: int = 64):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from matchmaking_tpu.engine.sharded import AXIS, _shard_map
+
+        self.mesh = mesh
+        self.n_shards = mesh.devices.size
+        if capacity % self.n_shards != 0:
+            capacity += self.n_shards - capacity % self.n_shards
+        self.capacity = capacity
+        self.local_capacity = capacity // self.n_shards
+        self.team_size = team_size
+        self.need = 2 * team_size
+        self.evict_bucket = evict_bucket
+        # Global window/cover math on gathered columns.
+        self._global = RoleKernelSet(
+            capacity=capacity, team_size=team_size, role_slots=role_slots,
+            widen_per_sec=widen_per_sec, max_threshold=max_threshold,
+            max_matches=max_matches, rounds=rounds)
+        self.max_matches = self._global.max_matches
+        # Shard-local role-aware admit + evict.
+        self._local = RoleKernelSet(
+            capacity=self.local_capacity, team_size=team_size,
+            role_slots=role_slots, widen_per_sec=widen_per_sec,
+            max_threshold=max_threshold, max_matches=max_matches,
+            rounds=rounds, evict_bucket=evict_bucket)
+
+        pool_spec = {k: P(AXIS) for k in
+                     ("rating", "rd", "region", "mode", "threshold",
+                      "enqueue_t", "active", "role_mask")}
+        rep = P()
+        self.search_step_packed = jax.jit(
+            _shard_map(self._step_shard, mesh=mesh,
+                       in_specs=(pool_spec, rep),
+                       out_specs=(pool_spec, rep), check_vma=False),
+            donate_argnums=0)
+        self.admit_packed = jax.jit(
+            _shard_map(self._admit_shard, mesh=mesh,
+                       in_specs=(pool_spec, rep), out_specs=pool_spec,
+                       check_vma=False),
+            donate_argnums=0)
+        self.evict = jax.jit(
+            _shard_map(self._evict_shard, mesh=mesh,
+                       in_specs=(pool_spec, rep), out_specs=pool_spec,
+                       check_vma=False),
+            donate_argnums=0)
+        self._sharding = NamedSharding(mesh, P(AXIS))
+
+    def mask_of(self, roles: tuple[str, ...]) -> int:
+        return self._global.mask_of(roles)
+
+    # ---- shard-local (inside shard_map) ------------------------------------
+
+    def _admit_shard(self, pool, packed):
+        batch, _now = RoleKernelSet._unpack(packed)
+        return self._local._admit_roles(
+            pool, shard_localize(batch, self.local_capacity))
+
+    def _evict_shard(self, pool, slots):
+        return shard_evict(self._local._base, pool, slots,
+                           self.local_capacity)
+
+    def _step_shard(self, pool, packed):
+        from jax import lax as _lax
+
+        from matchmaking_tpu.engine.sharded import AXIS
+
+        batch, now = RoleKernelSet._unpack(packed)
+        pool = self._local._admit_roles(
+            pool, shard_localize(batch, self.local_capacity))
+
+        full = {f: _lax.all_gather(pool[f], AXIS, tiled=True)
+                for f in self._GATHER}
+        g = self._global
+        order, group = g._sorted_order(full)
+        valid, spread, win_thr, split = g._windows_roles(full, order, group,
+                                                         now)
+        won = g._select_leftmost(valid)
+        slots, is_match, w = extract_windows(
+            won, g.need, g.max_matches, order, self.capacity)
+        pool = shard_evict(self._local._base, pool, slots,
+                           self.local_capacity)
+
+        out = jnp.concatenate([
+            slots.T.astype(jnp.float32),
+            jnp.where(is_match, spread[w], jnp.inf)[None, :],
+            jnp.where(is_match, win_thr[w], 0.0)[None, :],
+            jnp.where(is_match, split[w], 0).astype(jnp.float32)[None, :]])
+        return pool, out
+
+    def place_pool(self, arrays):
+        return {k: jax.device_put(jnp.asarray(v), self._sharding)
+                for k, v in arrays.items()}
+
+
+@functools.lru_cache(maxsize=None)
+def sharded_role_kernel_set(capacity: int, team_size: int,
+                            role_slots: tuple[str, ...],
+                            widen_per_sec: float, max_threshold: float,
+                            n_shards: int, max_matches: int = 1024,
+                            rounds: int = 16) -> ShardedRoleKernelSet:
+    from matchmaking_tpu.engine.sharded import pool_mesh
+
+    return ShardedRoleKernelSet(
+        capacity=capacity, team_size=team_size, role_slots=role_slots,
+        widen_per_sec=widen_per_sec, max_threshold=max_threshold,
+        mesh=pool_mesh(n_shards), max_matches=max_matches, rounds=rounds,
     )

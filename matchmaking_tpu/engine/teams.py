@@ -60,6 +60,47 @@ _BIG_I32 = jnp.int32(1 << 30)
 _INF = jnp.float32(jnp.inf)
 
 
+def extract_windows(won, need: int, max_matches: int, order, capacity: int):
+    """Winner window starts → member slots: (slots i32[M, need], is_match
+    bool[M], w i32[M]). Shared by the team and role kernels, single-device
+    and sharded (order within M is irrelevant — winners are disjoint; the
+    host sorts for determinism)."""
+    score = jnp.where(won, -jnp.arange(won.shape[0], dtype=jnp.int32),
+                      -_BIG_I32)
+    topv, topi = jax.lax.top_k(score, max_matches)
+    is_match = topv > -_BIG_I32
+    w = jnp.where(is_match, topi, 0)
+    member_pos = w[:, None] + jnp.arange(need, dtype=jnp.int32)[None, :]
+    slots = order[member_pos]
+    return jnp.where(is_match[:, None], slots, capacity), is_match, w
+
+
+def shard_localize(batch, local_capacity: int):
+    """Global batch slot ids → this shard's local frame (non-local ids map
+    to the local sentinel). Must run inside shard_map."""
+    from jax import lax
+
+    from matchmaking_tpu.engine.sharded import AXIS
+
+    offset = lax.axis_index(AXIS) * local_capacity
+    local = batch["slot"] - offset
+    mine = (local >= 0) & (local < local_capacity)
+    return dict(batch, slot=jnp.where(mine, local, local_capacity))
+
+
+def shard_evict(local_kernel, pool, slots, local_capacity: int):
+    """Evict this shard's slice of globally-indexed ``slots`` (sentinel for
+    the rest). Must run inside shard_map."""
+    from jax import lax
+
+    from matchmaking_tpu.engine.sharded import AXIS
+
+    offset = lax.axis_index(AXIS) * local_capacity
+    local = slots.reshape(-1).astype(jnp.int32) - offset
+    mine = (local >= 0) & (local < local_capacity)
+    return local_kernel._evict(pool, jnp.where(mine, local, local_capacity))
+
+
 class TeamKernelSet:
     """Compiled team-match step for one (pool geometry × queue config).
 
@@ -189,18 +230,8 @@ class TeamKernelSet:
         order, group = self._sorted_order(pool)
         valid, spread, win_thr = self._windows(pool, order, group, now)
         won = self._select_windows(valid, spread)
-
-        # Extract up to M winner window starts (order within M irrelevant —
-        # winners are disjoint; host sorts by slot for determinism).
-        score = jnp.where(won, -jnp.arange(won.shape[0], dtype=jnp.int32), -_BIG_I32)
-        topv, topi = jax.lax.top_k(score, self.max_matches)
-        is_match = topv > -_BIG_I32
-        w = jnp.where(is_match, topi, 0)
-
-        # Window members: sorted positions w..w+need-1 → original slots.
-        member_pos = w[:, None] + jnp.arange(self.need, dtype=jnp.int32)[None, :]
-        slots = order[member_pos]
-        slots = jnp.where(is_match[:, None], slots, self.capacity)
+        slots, is_match, w = extract_windows(
+            won, self.need, self.max_matches, order, self.capacity)
 
         # Compare-masked eviction (scatter-free — see kernels.py header).
         pool = self._base._evict(pool, slots.reshape(-1))
@@ -290,14 +321,7 @@ class ShardedTeamKernelSet:
     # ---- shard-local helpers (inside shard_map) ---------------------------
 
     def _localize(self, batch):
-        from jax import lax
-
-        from matchmaking_tpu.engine.sharded import AXIS
-
-        offset = lax.axis_index(AXIS) * self.local_capacity
-        local = batch["slot"] - offset
-        mine = (local >= 0) & (local < self.local_capacity)
-        return dict(batch, slot=jnp.where(mine, local, self.local_capacity))
+        return shard_localize(batch, self.local_capacity)
 
     def _admit_shard(self, pool, packed):
         from matchmaking_tpu.engine.kernels import unpack_batch
@@ -305,15 +329,7 @@ class ShardedTeamKernelSet:
         return self._local._admit(pool, self._localize(unpack_batch(packed)))
 
     def _evict_shard(self, pool, slots):
-        from jax import lax
-
-        from matchmaking_tpu.engine.sharded import AXIS
-
-        offset = lax.axis_index(AXIS) * self.local_capacity
-        local = slots.astype(jnp.int32) - offset
-        mine = (local >= 0) & (local < self.local_capacity)
-        return self._local._evict(
-            pool, jnp.where(mine, local, self.local_capacity))
+        return shard_evict(self._local, pool, slots, self.local_capacity)
 
     def _step_shard(self, pool, packed):
         from jax import lax
@@ -333,22 +349,11 @@ class ShardedTeamKernelSet:
         order, group = g._sorted_order(full)
         valid, spread, win_thr = g._windows(full, order, group, now)
         won = g._select_windows(valid, spread)
-
-        score = jnp.where(won, -jnp.arange(won.shape[0], dtype=jnp.int32),
-                          -_BIG_I32)
-        topv, topi = jax.lax.top_k(score, g.max_matches)
-        is_match = topv > -_BIG_I32
-        w = jnp.where(is_match, topi, 0)
-        member_pos = w[:, None] + jnp.arange(g.need, dtype=jnp.int32)[None, :]
-        slots = order[member_pos]
-        slots = jnp.where(is_match[:, None], slots, self.capacity)
+        slots, is_match, w = extract_windows(
+            won, g.need, g.max_matches, order, self.capacity)
 
         # Evict this shard's slice of every matched slot.
-        offset = lax.axis_index(AXIS) * self.local_capacity
-        flat = slots.reshape(-1) - offset
-        mine = (flat >= 0) & (flat < self.local_capacity)
-        pool = self._local._evict(
-            pool, jnp.where(mine, flat, self.local_capacity))
+        pool = shard_evict(self._local, pool, slots, self.local_capacity)
 
         out = jnp.concatenate([slots.T.astype(jnp.float32),
                                jnp.where(is_match, spread[w], _INF)[None, :],

@@ -17,9 +17,9 @@ Host/device split (SURVEY.md §7):
 
 Team-balanced queues (BASELINE config #3) run on device via the batch
 team-window kernel (``engine/teams.py``); role queues (config #5) run on
-device for solo traffic via ``engine/role_kernels.py``, delegating to the
-host oracle only while parties or region/mode wildcards are present (and
-promoting back once they drain). Sharded role queues run the host oracle.
+device for solo traffic via ``engine/role_kernels.py`` — single- or
+multi-chip — delegating to the host oracle only while parties or
+region/mode wildcards are present (and promoting back once they drain).
 The 1v1 paths (configs #1/#2/#4) — the north-star hot path — run on device
 single- or multi-chip.
 """
@@ -141,17 +141,28 @@ class TpuEngine(Engine):
         CompileCounter.install()
         ec = cfg.engine
         # Config #5 role queues run on device for SOLO traffic (round 5 —
-        # engine/role_kernels.py); parties and wildcards delegate to the
-        # host oracle via the same switch team-queue wildcards use. Sharded
-        # role queues stay host-side (the role sort/cover doesn't ship a
-        # sharded variant); plain team queues (config #3) and all 1v1
-        # configs run on device, single- or multi-chip.
-        self._role_device = (queue.team_size > 1 and bool(queue.role_slots)
-                             and ec.mesh_pool_axis == 1)
-        self._team_device = (queue.team_size > 1
-                             and (self._role_device
-                                  or not queue.role_slots))
-        if self._role_device:
+        # engine/role_kernels.py, single- or multi-chip); parties and
+        # wildcards delegate to the host oracle via the same switch
+        # team-queue wildcards use. Plain team queues (config #3) and all
+        # 1v1 configs run on device, single- or multi-chip.
+        self._role_device = queue.team_size > 1 and bool(queue.role_slots)
+        self._team_device = queue.team_size > 1
+        if self._role_device and ec.mesh_pool_axis > 1:
+            from matchmaking_tpu.engine.role_kernels import (
+                sharded_role_kernel_set,
+            )
+
+            self.kernels = sharded_role_kernel_set(
+                capacity=ec.pool_capacity,
+                team_size=queue.team_size,
+                role_slots=tuple(queue.role_slots),
+                widen_per_sec=queue.widen_per_sec,
+                max_threshold=queue.max_threshold,
+                n_shards=ec.mesh_pool_axis,
+                max_matches=ec.team_max_matches,
+                rounds=ec.team_rounds,
+            )
+        elif self._role_device:
             from matchmaking_tpu.engine.role_kernels import role_kernel_set
 
             self.kernels = role_kernel_set(
@@ -229,13 +240,10 @@ class TpuEngine(Engine):
         # magnitude), so all device-visible times are relative to the first
         # timestamp this engine sees.
         self._t0: float | None = None
-        # Role/party + sharded-team queues: host-side matching over the
-        # mirror (same oracle semantics as CpuEngine).
+        # Every team-family queue starts on device; the host oracle takes
+        # over only DYNAMICALLY (wildcards / role-queue parties) via
+        # _maybe_delegate_team, and hands back via _maybe_repromote_team.
         self._team_delegate = None
-        if queue.team_size > 1 and not self._team_device:
-            from matchmaking_tpu.engine.cpu import CpuEngine
-
-            self._team_delegate = CpuEngine(cfg, queue)
         #: Lifecycle counters surfaced in /metrics (engine_counters):
         #: team_delegated / team_repromoted record every wildcard
         #: delegation round-trip (SURVEY.md §5 observability).

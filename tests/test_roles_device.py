@@ -207,3 +207,33 @@ def test_checkpoint_roundtrip_preserves_roles():
     assert len(out.matches) == 1
     for team in out.matches[0].teams:
         assert {r.roles[0] for r in team} == {"tank", "dps"}
+
+
+def test_sharded_role_engine_matches_single_device():
+    """Role queue over an 8-shard pool mesh: identical matches (members AND
+    split) to the single-device role kernel, arrival by arrival — the
+    gathered-columns window formation is replicated, so shards agree."""
+    def build(mesh):
+        q = QueueConfig(team_size=2, role_slots=SLOTS2,
+                        rating_threshold=50.0)
+        cfg = Config(queues=(q,), engine=EngineConfig(
+            backend="tpu", pool_capacity=256, pool_block=64,
+            batch_buckets=(16,), team_max_matches=16,
+            mesh_pool_axis=mesh))
+        return make_engine(cfg, cfg.queues[0])
+
+    single, sharded = build(1), build(8)
+    rng = np.random.default_rng(31)
+    ratings = rng.permutation(500)[:80] + 1200
+    roles_cycle = [("tank",), ("dps",), (), ("dps",)]
+    for i, r in enumerate(ratings):
+        req = _req(i, int(r), roles_cycle[i % 4])
+        now = float(i)
+        out_s = single.search([req], now)
+        out_m = sharded.search([_req(i, int(r), roles_cycle[i % 4])], now)
+        assert len(out_s.matches) == len(out_m.matches), f"step {i}"
+        for ms, mm in zip(out_s.matches, out_m.matches):
+            assert _match_key(ms) == _match_key(mm), f"step {i}"
+            assert {p.id for p in ms.teams[0]} in (
+                {p.id for p in mm.teams[0]}, {p.id for p in mm.teams[1]})
+        assert single.pool_size() == sharded.pool_size(), f"step {i}"
