@@ -533,7 +533,12 @@ class TpuEngine(Engine):
         NOT re-ack them as newly queued. Tokens are recorded in
         ``rescan_tokens`` so a collector can recognize them."""
         if self._team_delegate is not None:
-            return None  # host-oracle team queues re-form on arrival only
+            # The periodic rescan tick is also the re-promotion heartbeat
+            # for an IDLE delegated queue: with no arrivals and no expiry
+            # sweep, nothing else would ever notice the wildcards/parties
+            # draining.
+            if not self._maybe_repromote_team(now):
+                return None  # still delegated: oracle re-forms on arrival
         if self._team_device:
             tok = self._rescan_team(now)
             if tok is not None:
