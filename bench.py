@@ -682,6 +682,112 @@ def bench_multiproc(args) -> dict:
     return {"multiproc": rows, "multiproc_host_cores": os.cpu_count()}
 
 
+def comms_accounting_rows(*, capacity: int = 65_536, team_size: int = 5,
+                          frontier_k: int = 1024,
+                          shard_counts=(2, 4, 8)) -> list[dict]:
+    """The sharded team/role comms phase (ISSUE 1 tentpole artifact): for
+    each mesh size D, build BOTH sharded paths and report per-device
+    per-step ICI bytes + formation rows — allgather-replicated is O(P)
+    regardless of D, the ppermute ring frontier is O(P/D + K·D). Each row
+    also EXECUTES one step per path on an identical seeded pool and
+    records whether the packed outputs are byte-identical, so the table
+    is a measured artifact, not prose. Runs on any backend with >= D
+    devices (tests/CI: the 8-virtual-device CPU mesh; set
+    XLA_FLAGS=--xla_force_host_platform_device_count=8)."""
+    import jax
+    import jax.numpy as jnp
+
+    from matchmaking_tpu.engine.role_kernels import ShardedRoleKernelSet
+    from matchmaking_tpu.engine.sharded import pool_mesh
+    from matchmaking_tpu.engine.teams import ShardedTeamKernelSet
+
+    if frontier_k <= 0:
+        raise SystemExit(
+            "--comms needs --comms-frontier-k > 0: the comms phase compares "
+            "the ring path against the allgather fallback, and the kernel "
+            "sets only compile the ring step when frontier_k is set")
+    n_dev = len(jax.devices())
+    rows = []
+    for D in shard_counts:
+        if D > n_dev:
+            rows.append({"n_shards": D, "skipped": f"only {n_dev} devices"})
+            continue
+        for family in ("team", "role"):
+            if family == "team":
+                ks = ShardedTeamKernelSet(
+                    capacity=capacity, team_size=team_size,
+                    widen_per_sec=0.0, max_threshold=400.0,
+                    mesh=pool_mesh(D), max_matches=64,
+                    frontier_k=frontier_k)
+                pack_rows, mask_of = 9, None
+            else:
+                ks = ShardedRoleKernelSet(
+                    capacity=capacity, team_size=team_size,
+                    role_slots=("tank", "healer", "dps", "dps", "dps"),
+                    widen_per_sec=0.0, max_threshold=400.0,
+                    mesh=pool_mesh(D), max_matches=64,
+                    frontier_k=frontier_k)
+                pack_rows, mask_of = 10, ks.mask_of
+            acct = ks.comms_accounting()
+            # One executed step per path on an identical seeded pool:
+            # occupancy under K per shard, so ring must be bit-identical.
+            P = ks.capacity
+            rng = np.random.default_rng(17)
+            n_active = min(frontier_k, ks.local_capacity, 512)
+            arrays = {
+                "rating": np.zeros(P, np.float32),
+                "rd": np.zeros(P, np.float32),
+                "region": np.zeros(P, np.int32),
+                "mode": np.zeros(P, np.int32),
+                "threshold": np.full(P, 120.0, np.float32),
+                "enqueue_t": np.zeros(P, np.float32),
+                "active": np.zeros(P, bool),
+            }
+            arrays["rating"][:n_active] = rng.normal(1500.0, 60.0, n_active)
+            arrays["region"][:n_active] = 1
+            arrays["mode"][:n_active] = 1
+            arrays["active"][:n_active] = True
+            if mask_of is not None:
+                arrays["role_mask"] = np.zeros(P, np.int32)
+                arrays["role_mask"][:n_active] = [
+                    [mask_of(("tank",)), mask_of(("healer",)),
+                     mask_of(("dps",)), mask_of(())][i % 4]
+                    for i in range(n_active)]
+            packed = np.zeros((pack_rows, 16), np.float32)
+            packed[0] = float(P)
+            packed[pack_rows - 1] = 1.0
+            t0 = time.perf_counter()
+            _, out_rep = ks.search_step_packed(
+                ks.place_pool(arrays), jnp.asarray(packed))
+            out_rep = np.asarray(out_rep)
+            t_rep = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            _, out_ring = ks.search_step_packed_ring(
+                ks.place_pool(arrays), jnp.asarray(packed))
+            out_ring = np.asarray(out_ring)
+            t_ring = time.perf_counter() - t0
+            rows.append({
+                "family": family, "n_shards": D, "capacity": P,
+                "frontier_k": ks.frontier_k,
+                "allgather_ici_recv_bytes": acct["allgather"]["ici_recv_bytes"],
+                "ring_ici_recv_bytes": acct["ring"]["ici_recv_bytes"],
+                "allgather_formation_rows": acct["allgather"]["formation_rows"],
+                "ring_formation_rows": acct["ring"]["formation_rows"],
+                "outputs_bit_identical": bool(
+                    np.array_equal(out_rep, out_ring)),
+                "matches_formed": int((out_rep[0] < P).sum()),
+                "step_ms_allgather_cold": round(t_rep * 1e3, 1),
+                "step_ms_ring_cold": round(t_ring * 1e3, 1),
+            })
+            log(f"[comms] {family} D={D}: gather "
+                f"{acct['allgather']['ici_recv_bytes']} B vs ring "
+                f"{acct['ring']['ici_recv_bytes']} B, formation rows "
+                f"{acct['allgather']['formation_rows']} vs "
+                f"{acct['ring']['formation_rows']}, bit_identical="
+                f"{rows[-1]['outputs_bit_identical']}")
+    return rows
+
+
 def bench_cpu_oracle(args) -> dict:
     """Reference-semantics oracle at the reference's ~2k-player scale."""
     from matchmaking_tpu.config import Config, QueueConfig
@@ -775,7 +881,23 @@ def main() -> None:
                         "LATENCY claim; the default mode optimizes "
                         "throughput (BENCH_SWEEP.md §4)")
     p.add_argument("--latency-window", type=int, default=512)
+    p.add_argument("--comms", action="store_true",
+                   help="comms-accounting mode: build the sharded team/"
+                        "role kernel sets at D=2/4/8, print one JSON row "
+                        "per (family, D) with per-device per-step ICI "
+                        "bytes + formation rows for the allgather vs ring "
+                        "paths and an executed bit-exactness check, then "
+                        "exit (BENCH_SWEEP.md §8). Needs >= D devices: "
+                        "on CPU set "
+                        "XLA_FLAGS=--xla_force_host_platform_device_count=8")
+    p.add_argument("--comms-capacity", type=int, default=65_536)
+    p.add_argument("--comms-frontier-k", type=int, default=1024)
     args = p.parse_args()
+    if args.comms:
+        for row in comms_accounting_rows(capacity=args.comms_capacity,
+                                         frontier_k=args.comms_frontier_k):
+            print(json.dumps(row), flush=True)
+        return
     if args.latency:
         # Latency operating point: one small window in flight, no
         # grouping (grouping trades first-window latency for transfer

@@ -63,9 +63,12 @@ class QueueConfig:
     #: Players covered per rescan tick (0 → the batcher's max_batch).
     #: Device 1v1 queues rescan through a no-admission step that is safe to
     #: overlap in-flight windows AND to split into multiple device chunks
-    #: (kernels._rescan_step), so this may exceed one batch bucket — set it
-    #: ≳ pool size to resolve widening pool-wide in a single tick instead
-    #: of one bucket per tick.
+    #: (kernels._rescan_step), so this may exceed one batch bucket. A tick
+    #: dispatches at most ``EngineConfig.pipeline_depth`` chunks (largest
+    #: bucket each) so a pool-sized window cannot queue tens of device
+    #: steps ahead of traffic; the oldest-first pick rolls the remainder
+    #: into later ticks — size pipeline_depth × largest bucket ≳ pool to
+    #: resolve widening pool-wide in a single tick.
     rescan_window: int = 0
 
 
@@ -102,6 +105,18 @@ class EngineConfig:
     #: parallel-greedy window-selection rounds (engine/teams.py).
     team_max_matches: int = 1024
     team_rounds: int = 16
+    #: Ring-scaled sharded team/role window formation (mesh_pool_axis > 1).
+    #: 0 = replicated fallback only: every step all_gathers the full pool
+    #: columns — O(P) ICI bytes and O(P) per-device window math regardless
+    #: of shard count. N > 0 = per-shard top-N candidate frontier: each
+    #: shard compacts its (group, rating)-sorted slice to N rows and the
+    #: frontiers travel the ICI ring via ppermute (D−1 neighbor hops) —
+    #: O(P/D + N·D) per device, bit-identical to the fallback while pool
+    #: occupancy stays <= N (the host checks per window and silently falls
+    #: back above it, counted in engine_counters team_ring_fallback). Size
+    #: N at the expected concurrent WAITING population, not capacity; see
+    #: BENCH_SWEEP.md §8 for the measured crossover.
+    team_ring_k: int = 0
     #: Max dispatched-but-uncollected windows the SERVICE keeps in flight on
     #: the pipelined columnar path (1 = the old dispatch-then-block flush).
     #: Pipelining hides the host↔device round trip — measured on the axon

@@ -260,9 +260,13 @@ def role_kernel_set(capacity: int, team_size: int,
 
 class ShardedRoleKernelSet:
     """Multi-chip solo role-queue matching: pool sharded over mesh axis
-    ``"pool"``, window formation replicated on gathered columns — the same
-    shape as ShardedTeamKernelSet (teams.py), plus the role_mask column in
-    both the shard slice and the gather. Call surface mirrors
+    ``"pool"`` — the same two paths as ShardedTeamKernelSet (teams.py):
+    replicated window formation on all_gathered columns (fallback), and,
+    when ``frontier_k > 0``, the ring-scaled variant that ppermutes a
+    fixed-size per-shard candidate frontier instead (bit-identical while
+    no shard holds more than K active rows; the host gates on occupancy —
+    see the team class docstring). The role family adds the role_mask
+    column to both the gather and the frontier. Call surface mirrors
     RoleKernelSet's packed API; TpuEngine swaps it in when
     ``mesh_pool_axis > 1`` on a role queue."""
 
@@ -276,7 +280,8 @@ class ShardedRoleKernelSet:
     def __init__(self, *, capacity: int, team_size: int,
                  role_slots: tuple[str, ...], widen_per_sec: float,
                  max_threshold: float, mesh, max_matches: int = 1024,
-                 rounds: int = 16, evict_bucket: int = 64):
+                 rounds: int = 16, evict_bucket: int = 64,
+                 frontier_k: int = 0):
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from matchmaking_tpu.engine.sharded import AXIS, _shard_map
@@ -285,6 +290,12 @@ class ShardedRoleKernelSet:
         self.n_shards = mesh.devices.size
         if capacity % self.n_shards != 0:
             capacity += self.n_shards - capacity % self.n_shards
+        if capacity >= (1 << 24):
+            # Not an assert: under python -O a stripped check would let the
+            # frontier pack slot ids into f32 rows past exactness and the
+            # ring step would silently evict the wrong players.
+            raise ValueError(
+                f"capacity {capacity} >= 2**24: slot ids must stay f32-exact")
         self.capacity = capacity
         self.local_capacity = capacity // self.n_shards
         self.team_size = team_size
@@ -302,6 +313,9 @@ class ShardedRoleKernelSet:
             role_slots=role_slots, widen_per_sec=widen_per_sec,
             max_threshold=max_threshold, max_matches=max_matches,
             rounds=rounds, evict_bucket=evict_bucket)
+        self.frontier_k = (min(max(frontier_k, self.need),
+                               self.local_capacity)
+                           if frontier_k > 0 else 0)
 
         pool_spec = {k: P(AXIS) for k in
                      ("rating", "rd", "region", "mode", "threshold",
@@ -312,6 +326,17 @@ class ShardedRoleKernelSet:
                        in_specs=(pool_spec, rep),
                        out_specs=(pool_spec, rep), check_vma=False),
             donate_argnums=0)
+        if self.frontier_k:
+            self._ring_form = RoleKernelSet(
+                capacity=self.n_shards * self.frontier_k,
+                team_size=team_size, role_slots=role_slots,
+                widen_per_sec=widen_per_sec, max_threshold=max_threshold,
+                max_matches=self.max_matches, rounds=rounds)
+            self.search_step_packed_ring = jax.jit(
+                _shard_map(self._step_shard_ring, mesh=mesh,
+                           in_specs=(pool_spec, rep),
+                           out_specs=(pool_spec, rep), check_vma=False),
+                donate_argnums=0)
         self.admit_packed = jax.jit(
             _shard_map(self._admit_shard, mesh=mesh,
                        in_specs=(pool_spec, rep), out_specs=pool_spec,
@@ -366,6 +391,56 @@ class ShardedRoleKernelSet:
             jnp.where(is_match, split[w], 0).astype(jnp.float32)[None, :]])
         return pool, out
 
+    def _step_shard_ring(self, pool, packed):
+        """Ring-scaled role step: frontier compaction (incl. role_mask) →
+        ppermute ring → replicated leftmost-first cover selection on the
+        merged D·K-row buffer. Host-gated on occupancy <= frontier_k; then
+        bit-identical to ``_step_shard``."""
+        from matchmaking_tpu.engine.sharded import ring_all_gather
+        from matchmaking_tpu.engine.teams import (
+            pack_frontier,
+            pad_match_columns,
+            unpack_frontier,
+        )
+
+        batch, now = RoleKernelSet._unpack(packed)
+        pool = self._local._admit_roles(
+            pool, shard_localize(batch, self.local_capacity))
+
+        frontier = pack_frontier(pool, self._GATHER, self.frontier_k,
+                                 self.local_capacity, self.capacity)
+        (buf,) = ring_all_gather((frontier,), self.n_shards)
+        full, gslot = unpack_frontier(buf, self._GATHER)
+        g = self._ring_form
+        order, group = g._sorted_order(full)
+        valid, spread, win_thr, split = g._windows_roles(full, order, group,
+                                                         now)
+        won = g._select_leftmost(valid)
+        slots_b, is_match, w = extract_windows(
+            won, g.need, g.max_matches, order, g.capacity)
+        gs = jnp.concatenate([gslot,
+                              jnp.array([self.capacity], jnp.int32)])
+        slots = gs[slots_b]
+        pool = shard_evict(self._local._base, pool, slots,
+                           self.local_capacity)
+
+        out = jnp.concatenate([
+            slots.T.astype(jnp.float32),
+            jnp.where(is_match, spread[w], jnp.inf)[None, :],
+            jnp.where(is_match, win_thr[w], 0.0)[None, :],
+            jnp.where(is_match, split[w], 0).astype(jnp.float32)[None, :]])
+        return pool, pad_match_columns(
+            out, self.max_matches - g.max_matches, self.need, self.capacity,
+            extra_zero_rows=1)
+
+    def comms_accounting(self) -> dict:
+        """Same accounting as the team family's (teams.py
+        shard_comms_accounting), with the extra role_mask column priced in
+        via this class's _GATHER."""
+        from matchmaking_tpu.engine.teams import shard_comms_accounting
+
+        return shard_comms_accounting(self)
+
     def place_pool(self, arrays):
         return {k: jax.device_put(jnp.asarray(v), self._sharding)
                 for k, v in arrays.items()}
@@ -376,11 +451,13 @@ def sharded_role_kernel_set(capacity: int, team_size: int,
                             role_slots: tuple[str, ...],
                             widen_per_sec: float, max_threshold: float,
                             n_shards: int, max_matches: int = 1024,
-                            rounds: int = 16) -> ShardedRoleKernelSet:
+                            rounds: int = 16,
+                            frontier_k: int = 0) -> ShardedRoleKernelSet:
     from matchmaking_tpu.engine.sharded import pool_mesh
 
     return ShardedRoleKernelSet(
         capacity=capacity, team_size=team_size, role_slots=role_slots,
         widen_per_sec=widen_per_sec, max_threshold=max_threshold,
         mesh=pool_mesh(n_shards), max_matches=max_matches, rounds=rounds,
+        frontier_k=frontier_k,
     )

@@ -40,9 +40,22 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 try:  # jax >= 0.6 exposes shard_map at the top level
-    from jax import shard_map as _shard_map
+    from jax import shard_map as _shard_map_impl
 except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map as _shard_map
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+
+def _shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
+    """shard_map across jax versions: new jax spells the replication-check
+    kwarg ``check_vma``, 0.4.x spells it ``check_rep`` (and hosts the
+    function under jax.experimental). One shim here serves every sharded
+    kernel family (1v1, team, role)."""
+    try:
+        return _shard_map_impl(f, mesh=mesh, in_specs=in_specs,
+                               out_specs=out_specs, check_vma=check_vma)
+    except TypeError:
+        return _shard_map_impl(f, mesh=mesh, in_specs=in_specs,
+                               out_specs=out_specs, check_rep=check_vma)
 
 from matchmaking_tpu.engine.kernels import (
     KernelSet,
@@ -52,6 +65,31 @@ from matchmaking_tpu.engine.kernels import (
 )
 
 AXIS = "pool"
+
+
+def ring_all_gather(xs: tuple, n: int, *, axis_name: str = AXIS) -> tuple:
+    """Collect each shard's arrays on every shard, in CANONICAL shard order,
+    with a ``ppermute`` neighbor ring instead of one ``all_gather``.
+
+    The ring-attention communication pattern shared by all three queue
+    families (1v1 candidate merge, team/role frontier exchange): the
+    ORIGINAL local arrays rotate one hop per step — D−1 hops, each talking
+    only to a neighbor — and every received block is scattered into its
+    source shard's slot, so the final buffers are identical on every shard.
+    Per-hop ICI traffic is the size of ONE shard's arrays, independent of
+    the global pool. Must run inside ``shard_map``.
+
+    Returns one array per input with a leading shard axis: ``(n, *x.shape)``.
+    """
+    my = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    outs = [jnp.zeros((n,) + x.shape, x.dtype).at[my].set(x) for x in xs]
+    rots = list(xs)
+    for h in range(1, n):
+        rots = [lax.ppermute(r, axis_name, perm) for r in rots]
+        src = (my - h) % n
+        outs = [o.at[src].set(r) for o, r in zip(outs, rots)]
+    return tuple(outs)
 
 
 def pool_mesh(n_devices: int, devices: list | None = None) -> Mesh:
@@ -197,22 +235,9 @@ class ShardedKernelSet:
             av = lax.all_gather(vals, AXIS)            # (n, B, k), axis order
             ai = lax.all_gather(gidx, AXIS)
         else:
-            # Ring collect: rotate the ORIGINAL local candidates one hop per
-            # step (the ring-attention communication pattern — each hop only
-            # talks to a neighbor) and scatter each received block into its
-            # source shard's slot, so the final merge sees the identical
-            # canonically-ordered buffer on every shard.
-            my = lax.axis_index(AXIS)
-            perm = [(i, (i + 1) % n) for i in range(n)]
-            av = jnp.zeros((n, b, k), vals.dtype).at[my].set(vals)
-            ai = jnp.full((n, b, k), self.capacity, gidx.dtype).at[my].set(gidx)
-            rot_v, rot_i = vals, gidx
-            for h in range(1, n):
-                rot_v = lax.ppermute(rot_v, AXIS, perm)
-                rot_i = lax.ppermute(rot_i, AXIS, perm)
-                src = (my - h) % n
-                av = av.at[src].set(rot_v)
-                ai = ai.at[src].set(rot_i)
+            # Ring collect (shared shard-exchange helper — the same
+            # ppermute ring the team/role frontier paths ride).
+            av, ai = ring_all_gather((vals, gidx), n)
         av = jnp.moveaxis(av, 0, 1).reshape(b, n * k)
         ai = jnp.moveaxis(ai, 0, 1).reshape(b, n * k)
         return av, ai

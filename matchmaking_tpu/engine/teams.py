@@ -75,6 +75,65 @@ def extract_windows(won, need: int, max_matches: int, order, capacity: int):
     return jnp.where(is_match[:, None], slots, capacity), is_match, w
 
 
+def sorted_group_order(pool: dict[str, Any]):
+    """Stable lexicographic order by (group, rating); inactive last.
+
+    Two stable passes: sort by rating, then by group — net effect is
+    (group asc, rating asc, slot asc), matching the oracle's per-group
+    rating sort (np.argsort stable). Shared by the single-device kernels
+    and the sharded frontier compaction, which must produce the identical
+    tie order for the ring path to be bit-exact."""
+    group = pool["region"] * jnp.int32(1 << 15) + pool["mode"]
+    group = jnp.where(pool["active"], group, _BIG_I32)
+    p1 = jnp.argsort(pool["rating"], stable=True)
+    p2 = jnp.argsort(group[p1], stable=True)
+    return p1[p2], group
+
+
+def pack_frontier(pool: dict[str, Any], fields: tuple[str, ...], k: int,
+                  local_capacity: int, capacity: int):
+    """Compact this shard's k best (group, rating)-sorted rows into ONE
+    f32[len(fields)+1, k] buffer — the fixed-size candidate frontier the
+    ring exchange ships instead of the full shard slice. The last row is
+    the row's GLOBAL slot id (capacity sentinel for inactive padding).
+
+    All packed values are f32-exact: region/mode codes < 2^15, role masks
+    < 2^5, slot ids < capacity (asserted < 2^24 at kernel-set build).
+    Active rows sort before inactive ones, so whenever this shard holds at
+    most k active rows the frontier contains ALL of them, in the exact
+    relative order the replicated global sort would give them — the no-
+    overflow precondition the host checks before picking the ring step.
+    Must run inside ``shard_map``."""
+    from jax import lax
+
+    from matchmaking_tpu.engine.sharded import AXIS
+
+    offset = lax.axis_index(AXIS) * local_capacity
+    order, _ = sorted_group_order(pool)
+    top = order[:k]
+    act = pool["active"][top]
+    rows = [pool[f][top].astype(jnp.float32) for f in fields]
+    gslot = jnp.where(act, top + offset, capacity).astype(jnp.float32)
+    return jnp.stack(rows + [gslot])
+
+
+def unpack_frontier(buf, fields: tuple[str, ...]):
+    """Ring-gathered frontier buffers f32[n, len(fields)+1, k] → pool-dict
+    columns of length n·k in canonical shard order, plus the global slot id
+    column. Inverse of ``pack_frontier`` after ``ring_all_gather``."""
+    n, c, k = buf.shape
+    flat = jnp.moveaxis(buf, 1, 0).reshape(c, n * k)
+    cols: dict[str, Any] = {}
+    for i, f in enumerate(fields):
+        if f == "active":
+            cols[f] = flat[i] > 0.5
+        elif f in ("region", "mode", "role_mask"):
+            cols[f] = flat[i].astype(jnp.int32)
+        else:
+            cols[f] = flat[i]
+    return cols, flat[len(fields)].astype(jnp.int32)
+
+
 def shard_localize(batch, local_capacity: int):
     """Global batch slot ids → this shard's local frame (non-local ids map
     to the local sentinel). Must run inside shard_map."""
@@ -151,15 +210,7 @@ class TeamKernelSet:
     # ---- internals --------------------------------------------------------
 
     def _sorted_order(self, pool: dict[str, Any]):
-        """Stable lexicographic order by (group, rating); inactive last."""
-        group = pool["region"] * jnp.int32(1 << 15) + pool["mode"]
-        group = jnp.where(pool["active"], group, _BIG_I32)
-        # Two stable passes: sort by rating, then by group — net effect is
-        # (group asc, rating asc), matching the oracle's per-group rating
-        # sort (np.argsort stable).
-        p1 = jnp.argsort(pool["rating"], stable=True)
-        p2 = jnp.argsort(group[p1], stable=True)
-        return p1[p2], group
+        return sorted_group_order(pool)
 
     def _windows(self, pool: dict[str, Any], order, group, now):
         """Validity + stats for every window start w ∈ [0, P - need]."""
@@ -254,21 +305,43 @@ class ShardedTeamKernelSet:
     """Multi-chip team matching: pool sharded over mesh axis ``"pool"``.
 
     Team-window formation needs a GLOBAL (group, rating) sort, which does
-    not decompose across shards the way 1v1 top-k does. The pool columns the
-    sort needs are tiny (5 × f32[P] ≈ 2.6 MB at P=131k), so each step
-    ``all_gather``s them over ICI and runs the window selection REPLICATED —
-    deterministic, so every shard extracts the identical matches — then each
-    shard evicts its local slice. Communication per step: one all_gather of
-    the column pack; no per-window host round trips.
+    not decompose across shards the way 1v1 top-k does. Two device paths:
+
+    - **Replicated fallback** (``search_step_packed``): each step
+      ``all_gather``s the window-selection columns (6 × f32[P]) over ICI
+      and runs selection REPLICATED — per-step ICI traffic and per-device
+      window math are O(P) regardless of shard count.
+    - **Ring-scaled** (``search_step_packed_ring``, built when
+      ``frontier_k > 0``): each shard compacts its LOCAL (group, rating)-
+      sorted slice into a fixed-size top-K candidate frontier
+      (``pack_frontier``), the frontiers travel the ICI ring via
+      ``ppermute`` (D−1 neighbor hops, O(K) rows per hop —
+      ``sharded.ring_all_gather``), and the deterministic window selection
+      runs on the D·K-row merged buffer: O(P/D) local compaction +
+      O(K·D) exchange/formation instead of O(P). Whenever no shard holds
+      more than K active rows the merged buffer contains exactly the
+      global active rows in the replicated sort's order, so the selected
+      windows are BIT-IDENTICAL to the fallback's (pinned by
+      tests/test_teams_device.py::TestRingShardedTeams). The HOST picks
+      the step per window: the mirror's occupancy upper-bounds every
+      shard's active rows, so ``occupancy <= frontier_k`` guarantees no
+      overflow; otherwise the window runs the replicated fallback
+      (TpuEngine._step_fn; counters team_ring_steps / team_ring_fallback).
 
     Call surface mirrors TeamKernelSet's packed API so TpuEngine swaps it in
     when ``mesh_pool_axis > 1`` on a plain team queue.
     """
 
+    #: Columns the window formation needs (gathered whole in the fallback,
+    #: frontier-compacted in the ring path). The frontier adds one global-
+    #: slot row on top.
+    _GATHER = ("rating", "region", "mode", "threshold", "enqueue_t",
+               "active")
+
     def __init__(self, *, capacity: int, team_size: int,
                  widen_per_sec: float, max_threshold: float, mesh,
                  max_matches: int = 1024, rounds: int = 16,
-                 evict_bucket: int = 64):
+                 evict_bucket: int = 64, frontier_k: int = 0):
         import numpy as np
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -278,6 +351,12 @@ class ShardedTeamKernelSet:
         self.n_shards = mesh.devices.size
         if capacity % self.n_shards != 0:
             capacity += self.n_shards - capacity % self.n_shards
+        if capacity >= (1 << 24):
+            # Not an assert: under python -O a stripped check would let the
+            # frontier pack slot ids into f32 rows past exactness and the
+            # ring step would silently evict the wrong players.
+            raise ValueError(
+                f"capacity {capacity} >= 2**24: slot ids must stay f32-exact")
         self.capacity = capacity
         self.local_capacity = capacity // self.n_shards
         self.team_size = team_size
@@ -296,6 +375,12 @@ class ShardedTeamKernelSet:
             widen_per_sec=widen_per_sec, max_threshold=max_threshold,
             evict_bucket=evict_bucket)
         self._np = np
+        #: Per-shard frontier row budget for the ring path (0 = ring off —
+        #: replicated allgather only). The host routes a window to the ring
+        #: step only when pool occupancy <= frontier_k.
+        self.frontier_k = (min(max(frontier_k, self.need),
+                               self.local_capacity)
+                           if frontier_k > 0 else 0)
 
         pool_spec = {k: P(AXIS) for k in
                      ("rating", "rd", "region", "mode", "threshold",
@@ -306,6 +391,21 @@ class ShardedTeamKernelSet:
                        in_specs=(pool_spec, rep),
                        out_specs=(pool_spec, rep), check_vma=False),
             donate_argnums=0)
+        if self.frontier_k:
+            # Formation instance over the merged D·K-row frontier buffer;
+            # max_matches mirrors the fallback's so both steps share one
+            # output shape (disjoint windows over D·K rows can never
+            # exceed D·K // need, so the clamp loses no matches).
+            self._ring_form = TeamKernelSet(
+                capacity=self.n_shards * self.frontier_k,
+                team_size=team_size, widen_per_sec=widen_per_sec,
+                max_threshold=max_threshold, max_matches=self.max_matches,
+                rounds=rounds)
+            self.search_step_packed_ring = jax.jit(
+                _shard_map(self._step_shard_ring, mesh=mesh,
+                           in_specs=(pool_spec, rep),
+                           out_specs=(pool_spec, rep), check_vma=False),
+                donate_argnums=0)
         self.admit_packed = jax.jit(
             _shard_map(self._admit_shard, mesh=mesh,
                        in_specs=(pool_spec, rep), out_specs=pool_spec,
@@ -343,8 +443,7 @@ class ShardedTeamKernelSet:
 
         # Gather the window-selection columns globally (tiled → f32/i32[P]).
         full = {f: lax.all_gather(pool[f], AXIS, tiled=True)
-                for f in ("rating", "region", "mode", "threshold",
-                          "enqueue_t", "active")}
+                for f in self._GATHER}
         g = self._global
         order, group = g._sorted_order(full)
         valid, spread, win_thr = g._windows(full, order, group, now)
@@ -360,20 +459,110 @@ class ShardedTeamKernelSet:
                                jnp.where(is_match, win_thr[w], 0.0)[None, :]])
         return pool, out
 
+    def _step_shard_ring(self, pool, packed):
+        """Ring-scaled step: local frontier compaction → ppermute ring →
+        deterministic selection on the merged D·K-row buffer. Valid only
+        when no shard holds more than frontier_k active rows (host-gated);
+        then bit-identical to ``_step_shard``."""
+        from matchmaking_tpu.engine.kernels import unpack_batch
+        from matchmaking_tpu.engine.sharded import ring_all_gather
+
+        batch = unpack_batch(packed)
+        now = packed[8, 0]
+        pool = self._local._admit(pool, self._localize(batch))
+
+        frontier = pack_frontier(pool, self._GATHER, self.frontier_k,
+                                 self.local_capacity, self.capacity)
+        (buf,) = ring_all_gather((frontier,), self.n_shards)
+        full, gslot = unpack_frontier(buf, self._GATHER)
+        g = self._ring_form
+        order, group = g._sorted_order(full)
+        valid, spread, win_thr = g._windows(full, order, group, now)
+        won = g._select_windows(valid, spread)
+        slots_b, is_match, w = extract_windows(
+            won, g.need, g.max_matches, order, g.capacity)
+        # Buffer rows → global slot ids (row g.capacity = padding sentinel).
+        gs = jnp.concatenate([gslot,
+                              jnp.array([self.capacity], jnp.int32)])
+        slots = gs[slots_b]
+        pool = shard_evict(self._local, pool, slots, self.local_capacity)
+
+        out = jnp.concatenate([slots.T.astype(jnp.float32),
+                               jnp.where(is_match, spread[w], _INF)[None, :],
+                               jnp.where(is_match, win_thr[w], 0.0)[None, :]])
+        return pool, pad_match_columns(
+            out, self.max_matches - g.max_matches, self.need, self.capacity)
+
+    def comms_accounting(self) -> dict:
+        return shard_comms_accounting(self)
+
     def place_pool(self, arrays):
         return {k: jax.device_put(jnp.asarray(v), self._sharding)
                 for k, v in arrays.items()}
+
+
+def shard_comms_accounting(ks) -> dict:
+    """Per-device per-step ICI traffic + formation workload for a sharded
+    team-family kernel set, derived from the ACTUAL buffer shapes the
+    compiled steps move: the fallback all_gathers the len(_GATHER) pool
+    columns at their POOL_FIELDS dtypes (active is 1-byte bool, the rest
+    4-byte; each device receives every other shard's slice → O(P) bytes
+    regardless of D); the ring ships one (len(_GATHER)+1, K) all-f32
+    frontier per hop for D−1 hops → O(K·D) bytes, and its formation
+    runs over P/D local + D·K merged rows instead of P. The bench's comms
+    phase turns this into the O(P) vs O(P/D + K·D) table."""
+    import numpy as np
+
+    from matchmaking_tpu.core.pool import POOL_FIELDS
+
+    cols = len(ks._GATHER)
+    dtypes = dict(POOL_FIELDS)
+    dtypes.update(getattr(ks, "extra_pool_fields", {}))
+    row_bytes = sum(np.dtype(dtypes[f]).itemsize for f in ks._GATHER)
+    acct = {
+        "n_shards": ks.n_shards,
+        "capacity": ks.capacity,
+        "gather_cols": cols,
+        "allgather": {
+            "ici_recv_bytes": (ks.capacity - ks.local_capacity) * row_bytes,
+            "formation_rows": ks.capacity,
+        },
+    }
+    if ks.frontier_k:
+        k = ks.frontier_k
+        acct["ring"] = {
+            "frontier_k": k,
+            "ici_recv_bytes": (ks.n_shards - 1) * (cols + 1) * k * 4,
+            "formation_rows": ks.local_capacity + ks.n_shards * k,
+        }
+    return acct
+
+
+def pad_match_columns(out, pad: int, need: int, capacity: int,
+                      extra_zero_rows: int = 0):
+    """Pad a packed (need+2+extra, M) match result to M+pad columns carrying
+    the canonical non-match sentinels (slots=capacity, spread=inf, the
+    limit — and any extra rows — zero), so the ring step's output shape and
+    padding rows are bit-identical to the replicated fallback's."""
+    if pad <= 0:
+        return out
+    col = jnp.concatenate([
+        jnp.full((need, pad), float(capacity), jnp.float32),
+        jnp.full((1, pad), _INF, jnp.float32),
+        jnp.zeros((1 + extra_zero_rows, pad), jnp.float32)])
+    return jnp.concatenate([out, col], axis=1)
 
 
 @functools.lru_cache(maxsize=None)
 def sharded_team_kernel_set(capacity: int, team_size: int,
                             widen_per_sec: float, max_threshold: float,
                             n_shards: int, max_matches: int = 1024,
-                            rounds: int = 16) -> ShardedTeamKernelSet:
+                            rounds: int = 16,
+                            frontier_k: int = 0) -> ShardedTeamKernelSet:
     from matchmaking_tpu.engine.sharded import pool_mesh
 
     return ShardedTeamKernelSet(
         capacity=capacity, team_size=team_size, widen_per_sec=widen_per_sec,
         max_threshold=max_threshold, mesh=pool_mesh(n_shards),
-        max_matches=max_matches, rounds=rounds,
+        max_matches=max_matches, rounds=rounds, frontier_k=frontier_k,
     )

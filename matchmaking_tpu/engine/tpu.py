@@ -161,6 +161,7 @@ class TpuEngine(Engine):
                 n_shards=ec.mesh_pool_axis,
                 max_matches=ec.team_max_matches,
                 rounds=ec.team_rounds,
+                frontier_k=ec.team_ring_k,
             )
         elif self._role_device:
             from matchmaking_tpu.engine.role_kernels import role_kernel_set
@@ -185,6 +186,7 @@ class TpuEngine(Engine):
                 n_shards=ec.mesh_pool_axis,
                 max_matches=ec.team_max_matches,
                 rounds=ec.team_rounds,
+                frontier_k=ec.team_ring_k,
             )
         elif self._team_device:
             from matchmaking_tpu.engine.teams import team_kernel_set
@@ -292,6 +294,13 @@ class TpuEngine(Engine):
         self.rescan_overlap = (
             self._team_device
             or hasattr(self.kernels, "search_step_packed_rescan"))
+        #: Device-step budget for one overlapped rescan tick: a pool-sized
+        #: tick split into ceil(window/bucket) chunks would queue tens of
+        #: device steps ahead of traffic windows (the pipeline_depth
+        #: backpressure counts PENDINGS, not chunks — ADVICE round-5 #1),
+        #: so a tick dispatches at most pipeline_depth chunks and the
+        #: oldest-first selection covers the rest on later ticks.
+        self._rescan_chunk_cap = max(1, cfg.engine.pipeline_depth)
         #: Stage spans (SURVEY.md §5 tracing): cumulative seconds + counts;
         #: read via span_report(). Written only on the caller thread.
         self.spans = {
@@ -526,7 +535,10 @@ class TpuEngine(Engine):
         variant — see kernels._rescan_step): lanes are validity-gated by
         the DEVICE-side active flag, so windows may be in flight and the
         tick may span MULTIPLE chunks covering up to ``max_window`` players
-        (a later chunk cannot re-match players an earlier chunk retired).
+        (a later chunk cannot re-match players an earlier chunk retired) —
+        capped at ``pipeline_depth`` chunks per tick so one tick cannot
+        queue a pool's worth of device steps ahead of traffic windows;
+        oldest-first selection rolls the remainder into later ticks.
         Kernel sets without the variant (sharded) keep the old contract:
         one chunk, pipeline drained by the caller. The resulting
         ColumnarOutcome's q_ids are the unmatched rescans — callers must
@@ -556,6 +568,12 @@ class TpuEngine(Engine):
                 "flush() first"
             )
             max_window = min(max_window, self.buckets[-1])
+        else:
+            # Overlapped multi-chunk ticks are budgeted: at most
+            # _rescan_chunk_cap device steps per tick, so a pool-wide
+            # rescan can't starve traffic windows of device slots.
+            max_window = min(max_window,
+                             self._rescan_chunk_cap * self.buckets[-1])
         pool = self.pool
         if len(pool) == 0:
             return None
@@ -618,7 +636,7 @@ class TpuEngine(Engine):
         pending = _Pending(token=self._next_token,
                            created=time.perf_counter())
         self._next_token += 1
-        self._dev_pool, out = self.kernels.search_step_packed(
+        self._dev_pool, out = self._step_fn(batch)(
             self._dev_pool, jnp.asarray(self._pack(batch, now - t0)))
         pending.chunks.append(([], (out,), now))
         self._submit(pending)
@@ -940,11 +958,15 @@ class TpuEngine(Engine):
             return False
         if now - self._delegate_last_wc < self.TEAM_REPROMOTE_QUIET_S:
             return False
-        if d.pool_size() > self.kernels.capacity:
-            # The oracle pool is unbounded; the device pool is not. A
-            # promotion that cannot re-admit everyone would drop players
-            # (restore has no partial-admission path) — stay delegated and
-            # re-check after the next quiet period.
+        # The oracle pool is unbounded; the device pool is not. A promotion
+        # that cannot re-admit everyone would drop players (restore has no
+        # partial-admission path), and one at EXACTLY-full capacity leaves
+        # zero free slots — the next arrival batch then crashes restore
+        # into the revive path (ADVICE round-5 #4). Require headroom for
+        # one arrival batch (clamped for tiny test pools) before promoting;
+        # otherwise stay delegated and re-check after the next quiet period.
+        headroom = min(self.buckets[-1], self.kernels.capacity // 4)
+        if d.pool_size() > self.kernels.capacity - headroom:
             self._delegate_last_wc = now
             return False
         if d.has_wildcards() or (self._role_device and d.has_parties()):
@@ -982,7 +1004,8 @@ class TpuEngine(Engine):
         assert self._open == 0, "warmup() with windows in flight"
         variants = [self.kernels.search_step_packed]
         for name in ("search_step_packed_nofilter",
-                     "search_step_packed_rescan"):
+                     "search_step_packed_rescan",
+                     "search_step_packed_ring"):
             fn = getattr(self.kernels, name, None)
             if fn is not None:
                 variants.append(fn)
@@ -1009,7 +1032,24 @@ class TpuEngine(Engine):
         window lane carries a filter, see kernels._score_block) or the full
         one. Host check is O(B) on the padded batch; padding lanes hold
         code 0 so they never force the filtered variant. Team/sharded
-        kernel sets don't ship the variant — getattr falls back."""
+        kernel sets don't ship the variant — getattr falls back.
+
+        Sharded team/role kernel sets may additionally ship the RING-scaled
+        step (EngineConfig.team_ring_k): picked whenever the mirror's
+        occupancy — an upper bound on every shard's active rows, since the
+        mirror only releases slots after device eviction — fits the
+        per-shard frontier, which is exactly the precondition under which
+        the ring step is bit-identical to the replicated fallback. The
+        choice is recorded in counters (team_ring_steps /
+        team_ring_fallback) so a mis-sized frontier is visible, not silent."""
+        ring = getattr(self.kernels, "search_step_packed_ring", None)
+        if ring is not None:
+            if len(self.pool) <= self.kernels.frontier_k:
+                self.counters["team_ring_steps"] = (
+                    self.counters.get("team_ring_steps", 0) + 1)
+                return ring
+            self.counters["team_ring_fallback"] = (
+                self.counters.get("team_ring_fallback", 0) + 1)
         nf = getattr(self.kernels, "search_step_packed_nofilter", None)
         if nf is not None and not batch.region.any() and not batch.mode.any():
             return nf

@@ -695,6 +695,13 @@ class _QueueRuntime:
     def _remember(self, player_id: str, body: bytes, now: float) -> None:
         self._recent[player_id] = (body, now + self.queue_cfg.dedup_ttl_s)
 
+    def dedup_cache_size(self) -> int:
+        """Public dedup-cache occupancy for observability (/metrics reads
+        this instead of reaching into the private ``_recent`` dict, so a
+        cache rename/restructure breaks loudly here instead of silently
+        dropping the metric)."""
+        return len(self._recent)
+
     def _prune_recent(self, now: float) -> None:
         # Time-throttled: a full-dict rebuild on every window would be O(n)
         # hot-path overhead under sustained load; expiry only moves at TTL
@@ -728,10 +735,25 @@ class _QueueRuntime:
         interval = self.queue_cfg.rescan_interval_s
         window = (self.queue_cfg.rescan_window
                   or self.app.cfg.batcher.max_batch)
+        #: Token of the previous tick's rescan, if it never collected
+        #: within the deadline. A stalled device (or a tick longer than
+        #: rescan_interval_s) must not stack another full-pool rescan per
+        #: interval, unbounded and unlogged (ADVICE round-5 #2).
+        outstanding: int | None = None
         while True:
             await asyncio.sleep(interval)
             now = time.time()
             tok: int | None = None
+            if (outstanding is not None
+                    and outstanding in getattr(self.engine,
+                                               "rescan_tokens", ())):
+                log.warning(
+                    "queue %r: previous rescan (token %d) still "
+                    "outstanding — skipping this tick",
+                    self.queue_cfg.name, outstanding)
+                self.app.metrics.counters.inc("rescan_skipped_outstanding")
+                continue
+            outstanding = None
             try:
                 async with self._engine_lock:
                     if hasattr(self.engine, "rescan_async"):
@@ -767,6 +789,7 @@ class _QueueRuntime:
             # finalization means the token lands once the windows dispatched
             # before it have landed — traffic keeps flowing the whole time.
             deadline = time.monotonic() + 30.0
+            done = False
             try:
                 while time.monotonic() < deadline:
                     async with self._engine_lock:
@@ -779,6 +802,17 @@ class _QueueRuntime:
                     if done:
                         break
                     await asyncio.sleep(0.01)
+                if not done:
+                    # Deadline exceeded: the token stays routable (the
+                    # shared collector publishes it whenever it lands);
+                    # remember it so the next tick skips instead of
+                    # silently stacking another full-pool rescan.
+                    outstanding = tok
+                    log.warning(
+                        "queue %r: rescan (token %d) exceeded its 30 s "
+                        "collection deadline; next tick will skip while "
+                        "it is outstanding", self.queue_cfg.name, tok)
+                    self.app.metrics.counters.inc("rescan_deadline_exceeded")
             except Exception:
                 log.exception("rescan failed; reviving engine from mirror")
                 self.app.metrics.counters.inc("engine_crashes")

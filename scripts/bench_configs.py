@@ -32,7 +32,11 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from bench import make_columns, run_engine_pipelined  # noqa: E402
+from bench import (  # noqa: E402
+    comms_accounting_rows,
+    make_columns,
+    run_engine_pipelined,
+)
 
 
 def log(msg: str) -> None:
@@ -356,7 +360,11 @@ def main() -> None:
     p.add_argument("--team-window", type=int, default=1024)
     p.add_argument("--team-windows", type=int, default=15)
     p.add_argument("--configs", default="1,2,3,4,5",
-                   help="comma-separated subset to run")
+                   help="comma-separated subset to run (6 = sharded "
+                        "team/role comms accounting at D=2/4/8 — needs "
+                        ">= 8 devices, e.g. the virtual CPU mesh)")
+    p.add_argument("--comms-capacity", type=int, default=65_536)
+    p.add_argument("--comms-frontier-k", type=int, default=1024)
     p.add_argument("--out", default="",
                    help="write/refresh BENCH_CONFIGS.md at this path")
     args = p.parse_args()
@@ -393,6 +401,14 @@ def main() -> None:
             pool=args.team_pool, capacity=args.team_capacity,
             window=args.team_window, windows=args.team_windows))
         results.append(bench_role_party_ladder())
+    if 6 in which:
+        results.append({
+            "config": "sharded_comms",
+            "path": "allgather-replicated vs ppermute ring frontier",
+            "rows": comms_accounting_rows(
+                capacity=args.comms_capacity,
+                frontier_k=args.comms_frontier_k),
+        })
 
     for r in results:
         print(json.dumps(r), flush=True)
@@ -408,6 +424,8 @@ def main() -> None:
             "|---|---|---|---|---|---|---|",
         ]
         for r in results:
+            if r["config"] == "sharded_comms":
+                continue  # own section below
             if r["config"] == "role_party":
                 best = r["ladder"][-1] if r["ladder"] else {}
                 lines.append(
@@ -438,6 +456,26 @@ def main() -> None:
                 f"players**. Beyond that, role/party queues need sharding "
                 f"by region/mode (the config-gated host oracle is not the "
                 f"1v1 hot path by design).")
+        comms = next((r for r in results if r["config"] == "sharded_comms"),
+                     None)
+        if comms:
+            lines += ["", "## sharded team/role comms accounting "
+                          "(allgather vs ring frontier)", "",
+                      "| family | D | gather ICI B/dev/step | ring ICI "
+                      "B/dev/step | gather rows | ring rows | bit-exact |",
+                      "|---|---|---|---|---|---|---|"]
+            for row in comms["rows"]:
+                if "skipped" in row:
+                    lines.append(f"| — | {row['n_shards']} | "
+                                 f"{row['skipped']} | | | | |")
+                    continue
+                lines.append(
+                    f"| {row['family']} | {row['n_shards']} | "
+                    f"{row['allgather_ici_recv_bytes']} | "
+                    f"{row['ring_ici_recv_bytes']} | "
+                    f"{row['allgather_formation_rows']} | "
+                    f"{row['ring_formation_rows']} | "
+                    f"{row['outputs_bit_identical']} |")
         with open(args.out, "w") as f:
             f.write("\n".join(lines) + "\n")
         log(f"wrote {args.out}")

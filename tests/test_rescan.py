@@ -93,9 +93,14 @@ class TestEngineRescan:
         """rescan_window > one bucket: the tick spans multiple no-admission
         chunks, so pool-wide widening resolution no longer takes one bucket
         per tick — and chunks cannot double-match across each other (later
-        chunks see earlier chunks' retirements via the device pool)."""
+        chunks see earlier chunks' retirements via the device pool).
+        pipeline_depth=3 budgets the tick at 3 chunks × 16 lanes ≥ the
+        40-player pool (the per-tick chunk cap is tested below)."""
         q = _q()
-        eng = make_engine(_cfg(q), q)   # buckets (16,); threshold 80
+        cfg = Config(queues=(q,), engine=EngineConfig(
+            backend="tpu", pool_capacity=64, pool_block=64,
+            batch_buckets=(16,), pipeline_depth=3))
+        eng = make_engine(cfg, q)       # buckets (16,); threshold 80
         # 20 latent pairs, pair i at rating 5000*i (+0/+5): partners match
         # (d=5), nothing else comes close. 40 players = 3 chunks of 16.
         reqs = []
@@ -111,6 +116,36 @@ class TestEngineRescan:
                 pairs.add(tuple(sorted((a, b))))
         assert len(pairs) == 20
         assert all(int(a[1:]) // 2 == int(b[1:]) // 2 for a, b in pairs)
+        assert eng.pool_size() == 0
+
+    def test_rescan_tick_chunk_budget_caps_device_steps(self):
+        """A pool-sized rescan window must not queue unbounded device steps
+        ahead of traffic: one tick dispatches at most pipeline_depth chunks
+        (ADVICE round-5 #1), and oldest-first selection rolls the remainder
+        into the next tick."""
+        q = _q()
+        eng = make_engine(_cfg(q), q)   # buckets (16,); pipeline_depth 2
+        # 24 latent pairs far apart: partners match (d=5) once widened.
+        reqs = []
+        for i in range(24):
+            reqs.append(_req(2 * i, 5000.0 * i, 0.0))
+            reqs.append(_req(2 * i + 1, 5000.0 * i + 5.0, 0.0))
+        eng.restore(reqs, 0.0)
+        tok = eng.rescan_async(64, now=1.0)  # asks for 64 > 2 × 16 budget
+        assert tok is not None
+        assert len(eng._pending[-1].chunks) == 2   # capped, not 4
+        outs = dict(eng.flush())
+        pairs = {tuple(sorted((a, b)))
+                 for out in outs.values()
+                 for a, b in zip(out.m_id_a, out.m_id_b)}
+        assert len(pairs) == 16                    # 32 oldest players
+        # Next tick covers the rolled-over remainder.
+        eng.rescan_async(64, now=2.0)
+        outs = dict(eng.flush())
+        pairs |= {tuple(sorted((a, b)))
+                  for out in outs.values()
+                  for a, b in zip(out.m_id_a, out.m_id_b)}
+        assert len(pairs) == 24
         assert eng.pool_size() == 0
 
     def test_oldest_players_prioritized(self):

@@ -209,20 +209,21 @@ def test_checkpoint_roundtrip_preserves_roles():
         assert {r.roles[0] for r in team} == {"tank", "dps"}
 
 
+def _build_sharded(mesh, ring_k=0):
+    q = QueueConfig(team_size=2, role_slots=SLOTS2,
+                    rating_threshold=50.0)
+    cfg = Config(queues=(q,), engine=EngineConfig(
+        backend="tpu", pool_capacity=256, pool_block=64,
+        batch_buckets=(16,), team_max_matches=16,
+        mesh_pool_axis=mesh, team_ring_k=ring_k))
+    return make_engine(cfg, cfg.queues[0])
+
+
 def test_sharded_role_engine_matches_single_device():
     """Role queue over an 8-shard pool mesh: identical matches (members AND
     split) to the single-device role kernel, arrival by arrival — the
     gathered-columns window formation is replicated, so shards agree."""
-    def build(mesh):
-        q = QueueConfig(team_size=2, role_slots=SLOTS2,
-                        rating_threshold=50.0)
-        cfg = Config(queues=(q,), engine=EngineConfig(
-            backend="tpu", pool_capacity=256, pool_block=64,
-            batch_buckets=(16,), team_max_matches=16,
-            mesh_pool_axis=mesh))
-        return make_engine(cfg, cfg.queues[0])
-
-    single, sharded = build(1), build(8)
+    single, sharded = _build_sharded(1), _build_sharded(8)
     rng = np.random.default_rng(31)
     ratings = rng.permutation(500)[:80] + 1200
     roles_cycle = [("tank",), ("dps",), (), ("dps",)]
@@ -237,3 +238,80 @@ def test_sharded_role_engine_matches_single_device():
             assert {p.id for p in ms.teams[0]} in (
                 {p.id for p in mm.teams[0]}, {p.id for p in mm.teams[1]})
         assert single.pool_size() == sharded.pool_size(), f"step {i}"
+
+
+@pytest.mark.parametrize("mesh", [2, 4, 8])
+def test_ring_sharded_role_engine_bit_identical(mesh):
+    """Ring-scaled role path (team_ring_k > 0) vs the allgather-replicated
+    fallback at D=2/4/8: match members, SPLIT, and quality floats must be
+    exactly equal arrival by arrival (the ring step is bit-identical while
+    occupancy fits the frontier)."""
+    rep = _build_sharded(mesh, ring_k=0)
+    ring = _build_sharded(mesh, ring_k=96)
+    rng = np.random.default_rng(31)
+    ratings = rng.permutation(500)[:80] + 1200
+    roles_cycle = [("tank",), ("dps",), (), ("dps",)]
+    n_matches = 0
+    for i, r in enumerate(ratings):
+        now = float(i)
+        out_r = rep.search([_req(i, int(r), roles_cycle[i % 4])], now)
+        out_g = ring.search([_req(i, int(r), roles_cycle[i % 4])], now)
+        assert ([_match_key(m) for m in out_g.matches]
+                == [_match_key(m) for m in out_r.matches]), f"step {i}"
+        # Exact split equality (team A member sets), not just partitions.
+        assert ([tuple(sorted(p.id for p in m.teams[0]))
+                 for m in out_g.matches]
+                == [tuple(sorted(p.id for p in m.teams[0]))
+                    for m in out_r.matches]), f"step {i}"
+        assert ([m.quality for m in out_g.matches]
+                == [m.quality for m in out_r.matches]), f"step {i}"
+        assert ring.pool_size() == rep.pool_size(), f"step {i}"
+        n_matches += len(out_g.matches)
+    assert n_matches >= 3
+    assert ring.counters["team_ring_steps"] == len(ratings)
+    assert "team_ring_fallback" not in ring.counters
+
+
+def test_ring_role_step_raw_outputs_bit_identical():
+    """Kernel-level: replicated vs ring role steps on identical prefilled
+    pools (role_mask column included) return byte-identical packed
+    results."""
+    import jax.numpy as jnp
+
+    from matchmaking_tpu.engine.role_kernels import ShardedRoleKernelSet
+    from matchmaking_tpu.engine.sharded import pool_mesh
+
+    ks = ShardedRoleKernelSet(
+        capacity=64, team_size=2, role_slots=SLOTS2, widen_per_sec=0.0,
+        max_threshold=400.0, mesh=pool_mesh(4), max_matches=8,
+        frontier_k=16)
+    P = ks.capacity
+    rng = np.random.default_rng(5)
+    n_active = 20
+    arrays = {
+        "rating": np.zeros(P, np.float32),
+        "rd": np.zeros(P, np.float32),
+        "region": np.zeros(P, np.int32),
+        "mode": np.zeros(P, np.int32),
+        "threshold": np.full(P, 50.0, np.float32),
+        "enqueue_t": np.zeros(P, np.float32),
+        "active": np.zeros(P, bool),
+        "role_mask": np.zeros(P, np.int32),
+    }
+    arrays["rating"][:n_active] = 1500.0 + rng.permutation(n_active) * 6.0
+    arrays["region"][:n_active] = 1
+    arrays["mode"][:n_active] = 1
+    arrays["active"][:n_active] = True
+    # Alternate tank/dps declarations with a few wildcards (full mask).
+    masks = [ks.mask_of(("tank",)), ks.mask_of(("dps",)), ks.mask_of(())]
+    arrays["role_mask"][:n_active] = [masks[i % 3] for i in range(n_active)]
+    packed = np.zeros((10, 16), np.float32)  # role pack_rows
+    packed[0] = float(P)
+    packed[9] = 1.0  # now
+    pool_a = ks.place_pool(arrays)
+    pool_b = ks.place_pool(arrays)
+    _, out_rep = ks.search_step_packed(pool_a, jnp.asarray(packed))
+    _, out_ring = ks.search_step_packed_ring(pool_b, jnp.asarray(packed))
+    out_rep, out_ring = np.asarray(out_rep), np.asarray(out_ring)
+    assert (out_rep[0] < P).any()
+    np.testing.assert_array_equal(out_ring, out_rep)

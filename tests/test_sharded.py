@@ -97,6 +97,57 @@ def test_sharded_widening_and_glicko():
 
 
 @needs_8
+def test_comms_accounting_ring_scales_sublinearly():
+    """The tentpole's measured artifact: per-device per-step traffic and
+    formation workload for the sharded team/role paths, derived from the
+    compiled steps' actual buffer shapes (teams.shard_comms_accounting).
+    The allgather fallback is O(P) per device regardless of D; the ring
+    path is O(P/D + K·D) — its exchange bytes must stay far below the
+    gather's, its formation rows must SHRINK as D grows, and its exchange
+    bytes must be independent of pool capacity."""
+    from matchmaking_tpu.engine.role_kernels import ShardedRoleKernelSet
+    from matchmaking_tpu.engine.sharded import pool_mesh
+    from matchmaking_tpu.engine.teams import ShardedTeamKernelSet
+
+    def team_acct(capacity, D, k=64):
+        ks = ShardedTeamKernelSet(
+            capacity=capacity, team_size=5, widen_per_sec=0.0,
+            max_threshold=400.0, mesh=pool_mesh(D), frontier_k=k)
+        return ks.comms_accounting()
+
+    accts = {D: team_acct(8192, D) for D in (2, 4, 8)}
+    for D, a in accts.items():
+        # Exchange bytes: ring ≪ allgather at every D.
+        assert a["ring"]["ici_recv_bytes"] * 4 < a["allgather"]["ici_recv_bytes"]
+        # Fallback formation is O(P): every device processes the full pool.
+        assert a["allgather"]["formation_rows"] == 8192
+    # O(P/D + K·D): per-device formation rows shrink as D grows...
+    assert (accts[2]["ring"]["formation_rows"]
+            > accts[4]["ring"]["formation_rows"]
+            > accts[8]["ring"]["formation_rows"])
+    # ...while the fallback's O(P) gather bytes GROW with D (each device
+    # receives every other shard's slice).
+    assert (accts[2]["allgather"]["ici_recv_bytes"]
+            < accts[4]["allgather"]["ici_recv_bytes"]
+            < accts[8]["allgather"]["ici_recv_bytes"])
+    # Ring exchange bytes are occupancy-shaped (K), not capacity-shaped:
+    # 4× the pool, same frontier → identical ring bytes, 4× gather bytes.
+    big = team_acct(32768, 4)
+    assert big["ring"]["ici_recv_bytes"] == accts[4]["ring"]["ici_recv_bytes"]
+    assert big["allgather"]["ici_recv_bytes"] == \
+        4 * accts[4]["allgather"]["ici_recv_bytes"]
+    # The role family prices its extra role_mask column in.
+    rks = ShardedRoleKernelSet(
+        capacity=8192, team_size=5,
+        role_slots=("tank", "healer", "dps", "dps", "dps"),
+        widen_per_sec=0.0, max_threshold=400.0, mesh=pool_mesh(4),
+        frontier_k=64)
+    ra = rks.comms_accounting()
+    assert ra["gather_cols"] == accts[4]["gather_cols"] + 1
+    assert ra["ring"]["ici_recv_bytes"] > accts[4]["ring"]["ici_recv_bytes"]
+
+
+@needs_8
 @pytest.mark.parametrize("ring", [False, True], ids=["all_gather", "ring"])
 def test_sharded_exact_tie_stays_consistent(ring):
     # Two candidates exactly equidistant from the query, on different

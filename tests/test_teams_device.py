@@ -241,6 +241,151 @@ class TestShardedTeams:
         assert len([p for t in out.matches[0].teams for p in t]) == 4
 
 
+class TestRingShardedTeams:
+    """Ring-scaled sharded team path (EngineConfig.team_ring_k): frontier
+    compaction + ppermute ring + merged-buffer selection must be BIT-
+    identical to the allgather-replicated fallback — and both to the host
+    oracle — at D=2/4/8 on the virtual CPU mesh."""
+
+    def _build(self, mesh, ring_k, capacity=256):
+        cfg = Config(
+            queues=(QueueConfig(team_size=2, rating_threshold=50.0),),
+            engine=EngineConfig(backend="tpu", pool_capacity=capacity,
+                                pool_block=64, batch_buckets=(16, 64),
+                                team_max_matches=32, mesh_pool_axis=mesh,
+                                team_ring_k=ring_k),
+        )
+        return make_engine(cfg, cfg.queues[0])
+
+    @pytest.mark.parametrize("mesh", [2, 4, 8])
+    def test_ring_equals_replicated_and_oracle(self, mesh):
+        """Sequential distinct-rating arrivals through three engines: the
+        ring path must reproduce the replicated path exactly (members AND
+        quality floats) and the oracle's match sets."""
+        cfg = Config(
+            queues=(QueueConfig(team_size=2, rating_threshold=50.0),),
+            engine=EngineConfig(backend="tpu", pool_capacity=256,
+                                pool_block=64, batch_buckets=(16, 64),
+                                team_max_matches=32),
+        )
+        rep = self._build(mesh, 0)
+        ring = self._build(mesh, 128)
+        cpu = CpuEngine(cfg, cfg.queues[0])
+        rng = np.random.default_rng(21)
+        ratings = rng.permutation(700)[:90] + 1200  # distinct
+        n_matches = 0
+        for i, r in enumerate(ratings):
+            now = float(i)
+            out_rep = rep.search([_req(i, int(r))], now)
+            out_ring = ring.search([_req(i, int(r))], now)
+            out_cpu = cpu.search([_req(i, int(r))], now)
+            assert ([_match_key(m) for m in out_ring.matches]
+                    == [_match_key(m) for m in out_rep.matches]
+                    == [_match_key(m) for m in out_cpu.matches]), f"step {i}"
+            # Bit-exact: the device outputs feed identical host math, so
+            # the qualities must be EQUAL, not approximately equal.
+            assert ([m.quality for m in out_ring.matches]
+                    == [m.quality for m in out_rep.matches]), f"step {i}"
+            assert ring.pool_size() == rep.pool_size() == cpu.pool_size()
+            n_matches += len(out_ring.matches)
+        assert n_matches >= 3
+        assert ring.counters["team_ring_steps"] == len(ratings)
+        assert "team_ring_fallback" not in ring.counters
+
+    def test_ring_step_raw_outputs_bit_identical(self):
+        """Kernel-level: both compiled steps on identical prefilled pools
+        (uneven shard occupancy, each shard under frontier_k) must return
+        byte-identical packed results — padding sentinels included."""
+        import jax.numpy as jnp
+
+        from matchmaking_tpu.engine.sharded import pool_mesh
+        from matchmaking_tpu.engine.teams import ShardedTeamKernelSet
+
+        ks = ShardedTeamKernelSet(
+            capacity=64, team_size=2, widen_per_sec=0.0,
+            max_threshold=400.0, mesh=pool_mesh(4), max_matches=8,
+            frontier_k=16)
+        P = ks.capacity
+        rng = np.random.default_rng(3)
+        n_active = 24  # shard 0 full (16 rows), shard 1 half, shards 2-3 empty
+        arrays = {
+            "rating": np.zeros(P, np.float32),
+            "rd": np.zeros(P, np.float32),
+            "region": np.zeros(P, np.int32),
+            "mode": np.zeros(P, np.int32),
+            "threshold": np.full(P, 50.0, np.float32),
+            "enqueue_t": np.zeros(P, np.float32),
+            "active": np.zeros(P, bool),
+        }
+        arrays["rating"][:n_active] = (
+            1500.0 + rng.permutation(n_active) * 7.0)
+        arrays["region"][:n_active] = 1
+        arrays["mode"][:n_active] = 1
+        arrays["active"][:n_active] = True
+        # All-padding batch (slot sentinel, valid 0): the step only forms
+        # windows over the prefilled pool.
+        packed = np.zeros((9, 16), np.float32)
+        packed[0] = float(P)
+        packed[8] = 1.0  # now
+        pool_a = ks.place_pool(arrays)
+        pool_b = ks.place_pool(arrays)
+        _, out_rep = ks.search_step_packed(pool_a, jnp.asarray(packed))
+        _, out_ring = ks.search_step_packed_ring(pool_b, jnp.asarray(packed))
+        out_rep, out_ring = np.asarray(out_rep), np.asarray(out_ring)
+        assert (out_rep[0] < P).any()  # matches actually formed
+        np.testing.assert_array_equal(out_ring, out_rep)
+
+    def test_ring_falls_back_above_frontier_and_stays_correct(self):
+        """Occupancy beyond team_ring_k: the host must route windows to the
+        replicated fallback (counted), and the match stream must remain
+        identical to a replicated-only engine throughout."""
+        rep = self._build(4, 0)
+        ring = self._build(4, 8)  # tiny frontier: need=4 → k_eff=8
+        rng = np.random.default_rng(7)
+        ratings = rng.permutation(900)[:60] + 1000
+        for i, r in enumerate(ratings):
+            now = float(i)
+            out_rep = rep.search([_req(i, int(r))], now)
+            out_ring = ring.search([_req(i, int(r))], now)
+            assert ([_match_key(m) for m in out_ring.matches]
+                    == [_match_key(m) for m in out_rep.matches]), f"step {i}"
+            assert ring.pool_size() == rep.pool_size()
+        assert ring.counters.get("team_ring_fallback", 0) > 0
+        assert ring.counters.get("team_ring_steps", 0) > 0
+
+
+class TestRepromoteHeadroom:
+    def test_repromote_requires_arrival_headroom(self):
+        """Promotion at (nearly) full capacity would leave no free slots
+        for the next arrival batch — restore has no partial-admission path,
+        so the very next window would crash into the revive path. The gate
+        requires min(largest bucket, capacity // 4) free slots (ADVICE
+        round-5 #4)."""
+        import dataclasses
+
+        cfg = _team_cfg(2, capacity=16)  # headroom = min(64, 4) = 4
+        tpu = make_engine(cfg, cfg.queues[0])
+        tpu.search([_req(0, 1500, region="*")], now=0.0)
+        assert tpu._team_delegate is not None
+        # 13 concrete players, ratings 40 apart: any 4-window spread is
+        # 120 > threshold 50, so nobody matches.
+        reqs = [dataclasses.replace(
+                    _req(100 + i, 1000.0 + 40.0 * i), enqueued_at=0.5)
+                for i in range(13)]
+        tpu.search(reqs, now=1.0)
+        assert tpu.remove("p0") is not None          # wildcard drained
+        # Quiet elapsed, but 13 > 16 - 4: promotion must be deferred even
+        # though the pool WOULD fit the device capacity outright.
+        tpu.search([], now=10.0)
+        assert tpu._team_delegate is not None
+        assert tpu.counters.get("team_repromoted", 0) == 0
+        tpu.remove("p100")                           # 12 <= 16 - 4
+        tpu.search([], now=20.0)
+        assert tpu._team_delegate is None
+        assert tpu.counters["team_repromoted"] == 1
+        assert tpu.pool_size() == 12
+
+
 class TestEngineIntegration:
     def test_remove_and_restore_roundtrip(self):
         cfg = _team_cfg(2)
