@@ -166,6 +166,32 @@ class EngineConfig:
     #: "gaussian:MEAN:STD" (equal-mass bands — keeps band occupancy even
     #: under a normal rating distribution). One band per pool block.
     band_spec: str = ""
+    #: Device-engine circuit breaker (service/breaker.py): after this many
+    #: engine crashes within ``breaker_window_s`` the queue's breaker trips
+    #: OPEN and the queue is demoted to the host-oracle engine — matches
+    #: keep flowing at oracle throughput instead of revive-looping a
+    #: persistently failing device path at full traffic rate. 0 disables
+    #: (every crash revives the device engine immediately, the pre-breaker
+    #: behavior). Device (``backend="tpu"``) queues only.
+    breaker_threshold: int = 0
+    #: Sliding crash-count window for the trip decision (seconds).
+    breaker_window_s: float = 30.0
+    #: Half-open probe schedule while the breaker is open: the first probe
+    #: runs ``breaker_probe_initial_s`` after the trip; each FAILED probe
+    #: multiplies the delay by ``breaker_probe_backoff`` up to
+    #: ``breaker_probe_max_s`` (exponential backoff — a dead device is not
+    #: hammered). A probe builds a fresh device engine and runs one no-op
+    #: step end to end; success re-promotes the queue (pool transferred
+    #: back, breaker CLOSED).
+    breaker_probe_initial_s: float = 1.0
+    breaker_probe_backoff: float = 2.0
+    breaker_probe_max_s: float = 60.0
+    #: Dedicated low-frequency health timer (seconds; 0 disables). Drives
+    #: the half-open breaker probes AND the idle re-promotion heartbeat for
+    #: wildcard-delegated team/role queues — independent of ``_rescan_loop``,
+    #: so a delegated queue with ``rescan_interval_s=0`` still re-promotes
+    #: once its wildcards drain (ADVICE round-5 #3).
+    health_interval_s: float = 1.0
 
 
 @dataclass(frozen=True)
@@ -185,6 +211,83 @@ class BrokerConfig:
     drop_prob: float = 0.0
     dup_prob: float = 0.0
     delay_ms: float = 0.0
+
+
+@dataclass(frozen=True)
+class ChaosConfig:
+    """Deterministic, scriptable fault schedule (SURVEY.md §5 "Failure
+    detection") — the replay-exact successor to the probabilistic
+    ``BrokerConfig.drop_prob``/``dup_prob`` hooks. Two fault families:
+
+    - **Scripted** faults fire at exact sequence indices: per-queue publish
+      sequence numbers for broker faults (``drop_seqs``/``dup_seqs``/
+      ``partitions``), per-queue device SEARCH-step indices for engine
+      faults (``fail_steps``/``fail_step_ranges`` — admits, evicts and
+      restores are exempt so crash recovery itself cannot be failed).
+    - **Seeded** faults are decided by hashing ``(seed, stream, queue,
+      index[, attempt])`` — a pure function of each message's identity, so
+      two runs with the same seed inject bit-identical faults regardless of
+      event-loop interleaving. (``BrokerConfig.drop_prob`` draws from one
+      shared RNG whose call ORDER depends on scheduling — soak accounting
+      under it is irreproducible by construction.)
+
+    Engine step counters live in the app runtime (utils/chaos.py
+    ``ChaosState``), not the engine, so indices keep advancing across
+    engine revives — a schedule failing steps 0-2 trips the circuit breaker
+    instead of re-failing step 0 on every fresh engine forever.
+    """
+
+    seed: int = 0
+    #: Queues the broker faults apply to; () = every queue including reply
+    #: queues. Name the request queues to keep reply traffic fault-free
+    #: (response publishes interleave nondeterministically with requests,
+    #: so scripting them by index is rarely what a test wants).
+    queues: tuple[str, ...] = ()
+    # ---- seeded broker faults (pure function of (queue, seq, attempt)) ----
+    #: Consume-side drop probability: the delivery is "crashed" before
+    #: processing and requeued, exactly like BrokerConfig.drop_prob but
+    #: decided by hash(seed, queue, seq, attempt).
+    drop_prob: float = 0.0
+    #: Publish-side duplicate-delivery probability, hash-decided per seq.
+    dup_prob: float = 0.0
+    # ---- scripted broker faults (per-queue publish sequence indices) ------
+    #: Publish seqs whose FIRST delivery attempt is dropped.
+    drop_seqs: tuple[int, ...] = ()
+    #: Redelivery storms: (seq, extra_copies) — that publish is delivered
+    #: 1 + extra_copies times (dedup/idempotence must absorb the storm).
+    dup_seqs: tuple[tuple[int, int], ...] = ()
+    #: Broker partitions: [pause_seq, resume_seq) — consumers of the queue
+    #: pause when publish seq ``pause_seq`` is enqueued and resume when
+    #: ``resume_seq`` is (messages buffer meanwhile; at-least-once holds).
+    partitions: tuple[tuple[int, int], ...] = ()
+    #: Failsafe: a paused queue auto-resumes after this many seconds even if
+    #: the resume-seq publish never arrives (a mis-scripted schedule must
+    #: not wedge a drain forever; fault ACCOUNTING stays seq-deterministic).
+    partition_max_s: float = 5.0
+    # ---- scripted engine faults (per-queue device search-step indices) ----
+    #: Device search-step indices that raise ChaosInjectedError at dispatch.
+    fail_steps: tuple[int, ...] = ()
+    #: Same, as [start, stop) ranges — "raise on k consecutive windows".
+    fail_step_ranges: tuple[tuple[int, int], ...] = ()
+    #: The first N half-open breaker probes fail (a separate stream from
+    #: fail_steps, so probe outcomes are scriptable independently of how
+    #: many traffic steps the storm consumed).
+    fail_probes: int = 0
+
+    def enabled(self) -> bool:
+        return bool(
+            self.drop_prob > 0 or self.dup_prob > 0 or self.drop_seqs
+            or self.dup_seqs or self.partitions or self.fail_steps
+            or self.fail_step_ranges or self.fail_probes
+        )
+
+    def consume_faults(self) -> bool:
+        """Any consume-side broker fault configured? (broker hot-path gate)"""
+        return bool(self.drop_prob > 0 or self.drop_seqs)
+
+    def publish_faults(self) -> bool:
+        """Any publish-side broker fault configured? (broker hot-path gate)"""
+        return bool(self.dup_prob > 0 or self.dup_seqs or self.partitions)
 
 
 @dataclass(frozen=True)
@@ -215,6 +318,9 @@ class Config:
     broker: BrokerConfig = field(default_factory=BrokerConfig)
     batcher: BatcherConfig = field(default_factory=BatcherConfig)
     auth: AuthConfig = field(default_factory=AuthConfig)
+    #: Deterministic fault-injection schedule (off by default — every field
+    #: zero/empty means no chaos plumbing is touched on the hot path).
+    chaos: ChaosConfig = field(default_factory=ChaosConfig)
     #: Number of concurrent search workers draining batches (the reference's
     #: GenServer pool size analog — SURVEY.md §2 C7).
     workers: int = 2
@@ -244,6 +350,7 @@ class Config:
             ("broker", BrokerConfig),
             ("batcher", BatcherConfig),
             ("auth", AuthConfig),
+            ("chaos", ChaosConfig),
         ):
             if name in d:
                 sub = dict(d[name])
@@ -259,9 +366,15 @@ class Config:
                         "config: ignoring unknown %s.%s (removed or "
                         "misspelled)", name, extra)
                     del sub[extra]
+                def tuplify(v: Any) -> Any:
+                    # Recursive: chaos dup_seqs/partitions/fail_step_ranges
+                    # are tuples OF tuples in JSON ([[seq, n], ...]).
+                    return (tuple(tuplify(x) for x in v)
+                            if isinstance(v, list) else v)
+
                 for f in dataclasses.fields(cls):
                     if f.name in sub and isinstance(sub[f.name], list):
-                        sub[f.name] = tuple(sub[f.name])
+                        sub[f.name] = tuplify(sub[f.name])
                 kw[name] = cls(**sub)
         for scalar in ("workers", "seed", "debug_invariants", "metrics_port",
                        "metrics_host"):

@@ -38,9 +38,15 @@ class CpuEngine(Engine):
 
     def search(self, requests: Sequence[SearchRequest], now: float) -> SearchOutcome:
         out = SearchOutcome()
+        # Intra-window dedup (mirrors TpuEngine.search_async's seen_ids):
+        # this engine matches on arrival, so a pool-membership check alone
+        # lets a duplicate copy LATER in the same window re-admit a player
+        # the first copy just matched and evicted.
+        seen: set[str] = set()
         for req in requests:
-            if req.id in self._by_id:
+            if req.id in self._by_id or req.id in seen:
                 continue  # duplicate enqueue is a no-op (idempotent redelivery)
+            seen.add(req.id)
             if req.party_size > 1 and not self.queue.role_slots:
                 # Parties are only servable on role-slot team queues
                 # (BASELINE config #5); anywhere else they would sit in the

@@ -152,6 +152,21 @@ class Engine(abc.ABC):
         """Release engine resources (e.g. background threads) when the
         engine is replaced. Default: nothing to release."""
 
+    def heartbeat(self, now: float) -> bool:
+        """Low-frequency health tick (service health timer, independent of
+        rescans — a queue with ``rescan_interval_s=0`` still gets these).
+        Engines use it for idle housekeeping that nothing else would
+        trigger under zero traffic; TpuEngine re-promotes a
+        wildcard-delegated team/role queue here. Returns True when the tick
+        changed engine state. Default: nothing to do."""
+        return False
+
+    def probe(self) -> None:
+        """Run one end-to-end no-op step to prove the engine is healthy —
+        the circuit breaker's half-open probe (service/breaker.py). Raises
+        on an unhealthy backend. Default: host engines have no device path
+        to check, so they are always healthy."""
+
     def expire(self, now: float, timeout: float) -> list[SearchRequest]:
         """Evict every waiting request older than ``timeout`` and return
         them (the timeout sweeper's one call). Default: object-path scan —

@@ -301,6 +301,12 @@ class TpuEngine(Engine):
         #: so a tick dispatches at most pipeline_depth chunks and the
         #: oldest-first selection covers the rest on later ticks.
         self._rescan_chunk_cap = max(1, cfg.engine.pipeline_depth)
+        #: Chaos fault hook (utils/chaos.py EngineChaosHook), attached by
+        #: the queue runtime AFTER construction — the hook (and its step
+        #: counters) outlives this engine instance across revives. None =
+        #: no chaos. Covers SEARCH steps + probes only; admit/evict/restore
+        #: are exempt so crash recovery itself cannot be failed.
+        self.chaos_hook = None
         #: Stage spans (SURVEY.md §5 tracing): cumulative seconds + counts;
         #: read via span_report(). Written only on the caller thread.
         self.spans = {
@@ -310,6 +316,13 @@ class TpuEngine(Engine):
             "dedupe_s": 0.0, "alloc_s": 0.0, "pack_s": 0.0,
             "h2d_s": 0.0, "jit_s": 0.0,
         }
+
+    def _chaos_step(self) -> None:
+        """Scripted device-step fault point: called BEFORE any state is
+        touched for a search-step chunk, so an injected failure leaves the
+        mirror/pool exactly as a real dispatch-time crash would."""
+        if self.chaos_hook is not None:
+            self.chaos_hook.on_step()
 
     # ---- Engine API -------------------------------------------------------
 
@@ -592,6 +605,7 @@ class TpuEngine(Engine):
         t0 = self._rel_base(now)
         top = self.buckets[-1]
         for start in range(0, chosen.size, top):
+            self._chaos_step()
             slots = chosen[start:start + top]
             cols = RequestColumns(
                 ids=pool.m_id[slots].copy(),
@@ -627,6 +641,7 @@ class TpuEngine(Engine):
         pool, which chains in dispatch order behind in-flight windows."""
         if len(self.pool) < 2 * self.queue.team_size:
             return None
+        self._chaos_step()
         bucket = self.buckets[0]
         # All lanes are the canonical padding (slot = capacity sentinel,
         # valid = False) — the same never-matching batch that batch_arrays
@@ -677,6 +692,7 @@ class TpuEngine(Engine):
         """Columnar twin of _dispatch: admit + launch, no waiting."""
         if not len(cols):
             return
+        self._chaos_step()
         free = self.pool.free_count()
         if len(cols) > free:
             assert pending.columnar is not None
@@ -1026,6 +1042,29 @@ class TpuEngine(Engine):
             self._dev_pool = evict(self._dev_pool, ev)
         jax.block_until_ready(self._dev_pool)
 
+    def probe(self) -> None:
+        """Half-open breaker probe: one end-to-end no-op device step
+        (smallest bucket, all padding lanes — nothing admitted, matched, or
+        evicted), blocked until the result lands. Exercises compile,
+        dispatch, device execution and D2H for the hot step family; raises
+        whatever the device raises. Scriptable via the chaos hook's probe
+        stream, so fault soaks can pin probe-failure backoff."""
+        if self.chaos_hook is not None:
+            self.chaos_hook.on_probe()
+        batch = self.pool.batch_arrays([], [], self.buckets[0])
+        self._dev_pool, out = self._step_fn(batch)(
+            self._dev_pool, jnp.asarray(self._pack(batch, 0.0)))
+        jax.block_until_ready(out)
+
+    def heartbeat(self, now: float) -> bool:
+        """Health-timer tick: the idle re-promotion path for a
+        wildcard-delegated team/role queue (ADVICE round-5 #3 — with
+        ``rescan_interval_s=0`` and no expiry sweep, nothing else notices
+        the wildcards draining under zero traffic)."""
+        if self._team_delegate is not None:
+            return self._maybe_repromote_team(now)
+        return False
+
     def _step_fn(self, batch):
         """Pick the compiled step variant for this window: the all-ANY
         variant (region/mode mask math compiled out — bit-exact when no
@@ -1071,6 +1110,7 @@ class TpuEngine(Engine):
         """Admit + launch the device step for one window; no waiting."""
         if not window:
             return
+        self._chaos_step()
         # Admit only what fits; reject the overflow (the reference has no
         # capacity cap — ETS grows — so partial admission keeps us closest).
         free = self.pool.free_count()

@@ -32,6 +32,7 @@ from matchmaking_tpu.service.contract import (
     SearchResponse,
     encode_response,
 )
+from matchmaking_tpu.service.breaker import CLOSED, STATE_CODE, CircuitBreaker
 from matchmaking_tpu.service.middleware import (
     MessageContext,
     MiddlewareReject,
@@ -39,6 +40,7 @@ from matchmaking_tpu.service.middleware import (
     columnar_pipeline,
     default_pipeline,
 )
+from matchmaking_tpu.utils.chaos import ChaosState
 from matchmaking_tpu.utils.metrics import Metrics
 
 log = logging.getLogger(__name__)
@@ -50,17 +52,20 @@ class _QueueRuntime:
     def __init__(self, app: "MatchmakingApp", queue_cfg: QueueConfig):
         self.app = app
         self.queue_cfg = queue_cfg
-        self.engine: Engine = make_engine(app.cfg, queue_cfg)
-        # Columnar ingress (1v1 queues on a columnar-capable engine): decode
-        # is deferred to the batched native codec at flush time.
-        self._columnar = (
-            queue_cfg.team_size == 1 and not queue_cfg.role_slots
-            and hasattr(self.engine, "search_columns_async")
-        )
-        self.pipeline: Pipeline = (
-            columnar_pipeline(app.cfg.auth, app.broker) if self._columnar
-            else default_pipeline(app.cfg.auth, app.broker)
-        )
+        #: Chaos fault hook for this queue's engines (None = no chaos). The
+        #: hook's step counters live in the APP's ChaosState, not the
+        #: engine, so a scripted schedule keeps advancing across revives.
+        self._chaos_hook = (
+            app.chaos.engine_hook(queue_cfg.name)
+            if app.chaos is not None and app.chaos.applies(queue_cfg.name)
+            else None)
+        #: Device-engine circuit breaker. Host-backend queues have no lower
+        #: tier to demote to, so they run without one. Created even when
+        #: breaker_threshold=0 (disabled) so /healthz always reports state.
+        self.breaker: CircuitBreaker | None = (
+            CircuitBreaker(app.cfg.engine)
+            if app.cfg.engine.backend == "tpu" else None)
+        self._publish_breaker_gauges()
         self.batcher: Batcher = Batcher(app.cfg.batcher, self._flush)
         # Serializes ALL engine access (window flushes vs the timeout
         # sweeper): engines are single-writer objects with no internal locks.
@@ -71,15 +76,6 @@ class _QueueRuntime:
         # overlap on device — the discipline the bench measures, now in
         # production (round-3 verdict ask #3).
         self._inflight_meta: dict[int, tuple[dict[str, Delivery], list[Delivery]]] = {}
-        # Pipelining applies to BOTH ingress shapes: the columnar 1v1 fast
-        # path and the object path (device team queues, config #3) — any
-        # engine with the pipelined window API (search_async/collect_ready;
-        # the CPU oracle has neither and stays synchronous).
-        self._pipelined = (
-            hasattr(self.engine, "collect_ready")
-            and hasattr(self.engine, "search_async")
-            and app.cfg.engine.pipeline_depth > 1
-        )
         self._collector: asyncio.Task | None = None
         #: A collected window failed on device; revive once in-flight drains.
         self._needs_revive = False
@@ -88,8 +84,7 @@ class _QueueRuntime:
         #: during a long first-window compile both it and batcher.depth read
         #: 0 — drain/quiesce checks must consult this too.
         self._flushing = 0
-        if self._pipelined:
-            self._collector = asyncio.create_task(self._collector_loop())
+        self._bind_engine(self._make_engine())
         # At-least-once dedup: player id → (encoded terminal response BODY,
         # expiry). Bytes, not SearchResponse: the body is built exactly once
         # (possibly by the native batch encoder) and replays publish it
@@ -116,12 +111,114 @@ class _QueueRuntime:
             # widened thresholds); host-oracle team paths return None from
             # rescan_async and the tick is a no-op.
             self._rescanner = asyncio.create_task(self._rescan_loop())
+        #: Dedicated low-frequency health timer: drives breaker half-open
+        #: probes AND the idle re-promotion heartbeat for wildcard-delegated
+        #: team/role queues — independent of ``_rescan_loop``, so a
+        #: delegated queue with ``rescan_interval_s=0`` still re-promotes
+        #: once its wildcards drain (ADVICE round-5 #3, closed for real).
+        self._health: asyncio.Task | None = None
+        if app.cfg.engine.health_interval_s > 0 and self.breaker is not None:
+            # Device-backend queues only: host-backend queues have no
+            # breaker to probe and no delegate to re-promote, so the timer
+            # would just contend on the engine lock every tick for nothing.
+            self._health = asyncio.create_task(self._health_loop())
         # Online invariant checking (SURVEY.md §5 "Race detection").
         self._invariants = None
         if app.cfg.debug_invariants:
             from matchmaking_tpu.utils.invariants import InvariantChecker
 
             self._invariants = InvariantChecker(queue_cfg.team_size)
+
+    # ---- engine lifecycle (revive / breaker demotion / re-promotion) ------
+
+    def _make_engine(self) -> Engine:
+        """Build this queue's engine for the CURRENT breaker state: the
+        configured (device) engine while the breaker is closed, the
+        host-oracle fallback while it is open/half-open — graceful
+        degradation: matches keep flowing at oracle throughput instead of
+        revive-looping a persistently failing device path at traffic rate."""
+        if self.breaker is not None and self.breaker.state != CLOSED:
+            from matchmaking_tpu.engine.cpu import CpuEngine
+
+            self.app.metrics.counters.inc("breaker_degraded_revives")
+            log.warning(
+                "queue %r: breaker %s — running DEGRADED on the host oracle",
+                self.queue_cfg.name, self.breaker.state)
+            return CpuEngine(self.app.cfg, self.queue_cfg)
+        engine = make_engine(self.app.cfg, self.queue_cfg)
+        if self._chaos_hook is not None and hasattr(engine, "chaos_hook"):
+            engine.chaos_hook = self._chaos_hook
+        return engine
+
+    def _bind_engine(self, engine: Engine) -> None:
+        """Install ``engine`` and recompute every engine-shape-dependent
+        seam. The single place engine swaps land — boot, crash revive,
+        breaker demotion, and probe re-promotion all come through here,
+        because the device engine and the host oracle differ in ingress
+        shape (columnar vs object decode) and dispatch discipline
+        (pipelined vs synchronous)."""
+        self.engine = engine
+        # Columnar ingress (1v1 queues on a columnar-capable engine): decode
+        # is deferred to the batched native codec at flush time. A degraded
+        # (host-oracle) engine has no columnar API — deliveries decode per
+        # object in the flush instead.
+        self._columnar = (
+            self.queue_cfg.team_size == 1 and not self.queue_cfg.role_slots
+            and hasattr(engine, "search_columns_async")
+        )
+        self.pipeline: Pipeline = (
+            columnar_pipeline(self.app.cfg.auth, self.app.broker)
+            if self._columnar
+            else default_pipeline(self.app.cfg.auth, self.app.broker)
+        )
+        # Pipelining applies to BOTH ingress shapes: the columnar 1v1 fast
+        # path and the object path (device team queues, config #3) — any
+        # engine with the pipelined window API (search_async/collect_ready;
+        # the CPU oracle has neither and stays synchronous).
+        self._pipelined = (
+            hasattr(engine, "collect_ready")
+            and hasattr(engine, "search_async")
+            and self.app.cfg.engine.pipeline_depth > 1
+        )
+        # The collector task follows the pipelined flag: a degraded engine
+        # has no inflight()/collect_ready(), so its collector would only
+        # spin on AttributeError noise.
+        if self._pipelined and (self._collector is None
+                                or self._collector.done()):
+            self._collector = asyncio.create_task(self._collector_loop())
+        elif not self._pipelined and self._collector is not None:
+            self._collector.cancel()
+            self._collector = None
+
+    def _record_engine_crash(self, now: float) -> None:
+        """Count one engine crash and feed the circuit breaker. When this
+        crash trips the breaker, the NEXT engine rebuild (_make_engine —
+        every crash path ends in one) demotes the queue to the host oracle;
+        half-open probes on the health timer re-promote it later."""
+        self.app.metrics.counters.inc("engine_crashes")
+        if self.breaker is not None and self.breaker.record_crash(now):
+            self.app.metrics.counters.inc("breaker_trips")
+            self._publish_breaker_gauges()
+            log.error(
+                "queue %r: circuit breaker TRIPPED (%d engine crashes "
+                "within %.1fs) — demoting to the host oracle; first "
+                "half-open probe in %.2fs",
+                self.queue_cfg.name, self.breaker.threshold,
+                self.breaker.window_s, self.breaker.probe_delay_s)
+
+    def _publish_breaker_gauges(self) -> None:
+        """Mirror breaker state into the shared metrics gauges — /metrics
+        readers see state without the observability server having to reach
+        into runtimes. Called on every state transition (cheap: three dict
+        writes), so the gauge is never staler than the last transition."""
+        if self.breaker is None:
+            return
+        snap = self.breaker.snapshot(time.time())
+        q = self.queue_cfg.name
+        m = self.app.metrics
+        m.set_gauge(f"breaker_state[{q}]", STATE_CODE[snap["state"]])
+        m.set_gauge(f"breaker_probe_delay_s[{q}]", snap["probe_delay_s"])
+        m.set_gauge(f"breaker_time_degraded_s[{q}]", snap["time_degraded_s"])
 
     # ---- ingress ----------------------------------------------------------
 
@@ -148,6 +245,19 @@ class _QueueRuntime:
         self._flushing += 1
         try:
             await self._flush_inner(window)
+        except Exception:
+            # A breaker demotion/re-promotion can swap the engine while a
+            # flush that already chose the columnar/pipelined branch is
+            # parked on the engine lock. Whatever went wrong, the window's
+            # deliveries must be SETTLED — stranding them unacked eats
+            # broker prefetch slots until the queue stops consuming.
+            # Nack-requeue is the at-least-once answer (redeliveries are
+            # deduped against the pool / _recent).
+            log.exception("window flush failed; nacking its deliveries")
+            self.app.metrics.counters.inc("flush_errors")
+            for _, delivery in window:
+                self.app.broker.nack(self.consumer_tag, delivery.delivery_tag,
+                                     requeue=True)
         finally:
             self._flushing -= 1
 
@@ -155,6 +265,12 @@ class _QueueRuntime:
         if self._columnar:
             await self._flush_columnar([d for _, d in window])
             return
+        if any(req is None for req, _ in window):
+            # Transition stragglers: these deliveries entered through the
+            # columnar ingress (decode deferred to the batched codec), but
+            # the engine has since been demoted to the host oracle — decode
+            # them per object here; the shapes may be mixed in one window.
+            window = self._decode_deferred(window)
         now = time.time()
         # At-least-once dedup: a redelivered copy of a request whose player
         # already reached a terminal state must not re-enter the pool (the
@@ -182,12 +298,14 @@ class _QueueRuntime:
             # Object-path pipelining (device team queues + 1v1 object
             # ingress): the full SearchOutcome (incl. dispatch-time
             # rejections) arrives under the window's token at collection.
-            def dispatch():
-                tok, _ = self.engine.search_async(requests, now)
+            def dispatch(drop: set[str]):
+                reqs = ([r for r in requests if r.id not in drop]
+                        if drop else requests)
+                tok, _ = self.engine.search_async(reqs, now)
                 return tok
 
             await self._dispatch_pipelined(
-                dispatch, {r.id: d for r, d in window}, deliveries_in, now)
+                dispatch, [(r.id, d) for r, d in window], now)
             return
 
         try:
@@ -198,7 +316,7 @@ class _QueueRuntime:
                 outcome = await asyncio.to_thread(self.engine.search, requests, now)
         except Exception:
             log.exception("engine step crashed; reviving engine from mirror")
-            self.app.metrics.counters.inc("engine_crashes")
+            self._record_engine_crash(now)
             self._revive_engine(now)
             for delivery in deliveries_in:
                 self.app.broker.nack(self.consumer_tag, delivery.delivery_tag,
@@ -210,6 +328,56 @@ class _QueueRuntime:
         self.app.metrics.counters.inc("windows")
         self.app.metrics.counters.inc("requests_batched", len(window))
 
+    def _first_received(self, delivery: Delivery, now: float) -> float:
+        """Client-settable ``x-first-received`` header; a non-numeric value
+        must not crash the whole window flush (it would strand every
+        delivery in it)."""
+        try:
+            return float(delivery.properties.headers.get(
+                "x-first-received", now))
+        except (TypeError, ValueError):
+            return now
+
+    def _decode_or_reject(self, delivery: Delivery,
+                          now: float) -> SearchRequest | None:
+        """Decode one delivery through the semantic codec; a ContractError
+        is rejected + acked here and returns None. The ONE slow-path
+        decode, shared by the columnar flush's Python fallback and the
+        demoted-queue straggler path — reject handling must not diverge
+        between them."""
+        from matchmaking_tpu.service.contract import ContractError, decode_request
+
+        try:
+            return decode_request(
+                delivery.body,
+                reply_to=delivery.properties.reply_to,
+                correlation_id=delivery.properties.correlation_id,
+                queue=self.queue_cfg.name,
+                enqueued_at=self._first_received(delivery, now),
+            )
+        except ContractError as e:
+            self.app.metrics.counters.inc("rejected_by_middleware")
+            self._respond_error(delivery, e.code, e.reason)
+            self.app.broker.ack(self.consumer_tag, delivery.delivery_tag)
+            return None
+
+    def _decode_deferred(
+        self, window: list[tuple[SearchRequest | None, Delivery]]
+    ) -> list[tuple[SearchRequest, Delivery]]:
+        """Decode deliveries whose request is still None (columnar ingress
+        deferred decoding to the flush, then the engine lost its columnar
+        API to a breaker demotion). Malformed payloads are rejected+acked
+        here, exactly as the columnar flush would have."""
+        now = time.time()
+        out: list[tuple[SearchRequest, Delivery]] = []
+        for req, delivery in window:
+            if req is None:
+                req = self._decode_or_reject(delivery, now)
+                if req is None:
+                    continue
+            out.append((req, delivery))
+        return out
+
     async def _flush_columnar(self, deliveries: list[Delivery]) -> None:
         """Columnar window flush: batched native decode → RequestColumns →
         pipelined columnar engine step → responses from ColumnarOutcome.
@@ -220,25 +388,12 @@ class _QueueRuntime:
         import numpy as np
 
         from matchmaking_tpu.native import codec
-        from matchmaking_tpu.service.contract import (
-            ContractError,
-            RequestColumns,
-            decode_request,
-        )
+        from matchmaking_tpu.service.contract import RequestColumns
 
         now = time.time()
         self._prune_recent(now)
         bodies = [bytes(d.body) for d in deliveries]
         native = codec.decode_batch(bodies) if codec.available() else None
-
-        def first_received(delivery: Delivery) -> float:
-            # Client-settable header: a non-numeric value must not crash the
-            # whole window flush (it would strand every delivery in it).
-            try:
-                return float(delivery.properties.headers.get(
-                    "x-first-received", now))
-            except (TypeError, ValueError):
-                return now
 
         lanes: list[tuple[str, float, float, float, str, str, float, Delivery]] = []
         for i, delivery in enumerate(deliveries):
@@ -247,7 +402,8 @@ class _QueueRuntime:
                     native[0], native[1], native[2], native[3], native[4],
                     native[5], native[6])
                 row = (ids[i], float(rating[i]), float(rd[i]), float(thr[i]),
-                       regions[i], modes[i], first_received(delivery), delivery)
+                       regions[i], modes[i],
+                       self._first_received(delivery, now), delivery)
             elif native is not None and native[6][i] not in (codec.OK,
                                                              codec.NEEDS_PYTHON):
                 self.app.metrics.counters.inc("rejected_by_middleware")
@@ -257,18 +413,8 @@ class _QueueRuntime:
                 continue
             else:
                 # Python fallback (codec unavailable or NEEDS_PYTHON row).
-                try:
-                    req = decode_request(
-                        delivery.body,
-                        reply_to=delivery.properties.reply_to,
-                        correlation_id=delivery.properties.correlation_id,
-                        queue=self.queue_cfg.name,
-                        enqueued_at=first_received(delivery),
-                    )
-                except ContractError as e:
-                    self.app.metrics.counters.inc("rejected_by_middleware")
-                    self._respond_error(delivery, e.code, e.reason)
-                    self.app.broker.ack(self.consumer_tag, delivery.delivery_tag)
+                req = self._decode_or_reject(delivery, now)
+                if req is None:
                     continue
                 if req.party_size > 1:
                     # 1v1 queue: parties are unservable (oracle semantics).
@@ -320,9 +466,9 @@ class _QueueRuntime:
                 (r[7].properties.correlation_id for r in lanes), object, n),
         )
         by_id = {r[0]: r[7] for r in lanes}
-        deliveries_in = [r[7] for r in lanes]
 
         if not self._pipelined:
+            deliveries_in = [r[7] for r in lanes]
             # depth-1 mode (pipeline_depth <= 1, or an engine without the
             # pipelined API): dispatch + flush together, outcomes handled
             # inline — the pre-round-4 behavior.
@@ -342,7 +488,7 @@ class _QueueRuntime:
                     raise err
             except Exception:
                 log.exception("engine step crashed; reviving engine from mirror")
-                self.app.metrics.counters.inc("engine_crashes")
+                self._record_engine_crash(now)
                 self._revive_engine(now)
                 for d in deliveries_in:
                     self.app.broker.nack(self.consumer_tag,
@@ -355,21 +501,61 @@ class _QueueRuntime:
 
         # Pipelined path: dispatch without waiting; outcomes (publish + ack)
         # happen at collection — on later flushes or the collector tick.
+        def dispatch(drop: set[str]):
+            c = cols
+            if drop:
+                keep = np.fromiter((i not in drop for i in c.ids.tolist()),
+                                   bool, len(c))
+                c = c.take(keep)
+            return self.engine.search_columns_async(c, now)
+
         await self._dispatch_pipelined(
-            lambda: self.engine.search_columns_async(cols, now),
-            by_id, deliveries_in, now)
+            dispatch, [(r[0], r[7]) for r in lanes], now)
 
     # ---- pipelined collection ---------------------------------------------
 
-    async def _dispatch_pipelined(self, dispatch, by_id: dict[str, Delivery],
-                                  deliveries_in: list[Delivery],
+    def _settle_terminal_locked(self, pairs: list[tuple[str, Delivery]],
+                                now: float) -> set[str]:
+        """Second dedup-cache check, run under the engine lock immediately
+        before dispatch. The flush-time ``_recent`` check races pipelined
+        collection: a redelivered copy of player p can pass it while p's
+        first copy sits in an in-flight window; if that window collects
+        (evicting p from the pool and writing ``_recent``) before this
+        dispatch acquires the lock, the engine's pool-membership dedupe no
+        longer sees p and would admit it into a SECOND match. Delegated-
+        oracle windows widen the race to the whole dispatch→collection gap
+        (the oracle matches and evicts at dispatch; ``_remember`` runs at
+        collection) — hence the caller collects landed windows first.
+        Replays + acks stale rows; returns their ids for the dispatch to
+        drop."""
+        stale: set[str] = set()
+        for pid, delivery in pairs:
+            cached = self._recent.get(pid)
+            if cached is None or cached[1] <= now:
+                continue  # absent or expired (a genuine re-queue)
+            stale.add(pid)
+            self.app.metrics.counters.inc("deduped_replays")
+            self._publish_body(delivery.properties.reply_to,
+                               delivery.properties.correlation_id, cached[0])
+            self.app.broker.ack(self.consumer_tag, delivery.delivery_tag)
+        return stale
+
+    async def _dispatch_pipelined(self, dispatch,
+                                  pairs: list[tuple[str, Delivery]],
                                   now: float) -> None:
         """Shared pipelined dispatch (columnar AND object windows):
-        ``dispatch`` runs off the event loop and returns the window token.
-        Crash recovery and backpressure live HERE, once."""
+        ``dispatch(drop)`` runs off the event loop with the ids the
+        terminal re-check settled (excluded from the window) and returns
+        the window token. Crash recovery and backpressure live HERE, once."""
         recorded = False
+        deliveries_in = [d for _, d in pairs]
         try:
             async with self._engine_lock:
+                # Reap landed windows BEFORE the terminal re-check: a
+                # delegated-oracle window's outcome is already complete at
+                # dispatch, and collecting it here moves its matched players
+                # into _recent where _settle_terminal_locked can see them.
+                self._collect_ready_locked(time.time())
                 if self._needs_revive:
                     # A collected window failed on device: the device pool
                     # diverged from the mirror (its step may have matched
@@ -378,13 +564,19 @@ class _QueueRuntime:
                     # (under sustained traffic the collector's inflight()==0
                     # revive may otherwise never fire).
                     await self._drain_engine(now)
-                tok = await asyncio.to_thread(dispatch)
-                self._inflight_meta[tok] = (by_id, deliveries_in)
+                stale = self._settle_terminal_locked(pairs, now)
+                if stale:
+                    pairs = [(p, d) for p, d in pairs if p not in stale]
+                    deliveries_in = [d for _, d in pairs]
+                    if not pairs:
+                        return  # every row replayed + acked
+                tok = await asyncio.to_thread(dispatch, stale)
+                self._inflight_meta[tok] = (dict(pairs), deliveries_in)
                 recorded = True
                 self._collect_ready_locked(time.time())
         except Exception:
             log.exception("engine dispatch crashed; reviving engine from mirror")
-            self.app.metrics.counters.inc("engine_crashes")
+            self._record_engine_crash(now)
             # Once meta is recorded the revive path settles this window
             # exactly once (salvage-ack or stale-meta nack) — passing
             # extra_nack too would double-settle the same delivery tags.
@@ -393,9 +585,14 @@ class _QueueRuntime:
             return
         # Backpressure: hold THIS queue's batcher until a pipeline slot
         # frees (windows keep arriving from other queues; the collector
-        # task keeps collecting even when no flush is running).
+        # task keeps collecting even when no flush is running). The
+        # hasattr re-check matters: a breaker demotion can swap in the
+        # host oracle (no inflight()) while this loop is parked on the
+        # sleep — the swap already nacked our window's meta, so there is
+        # nothing left to wait for.
         depth = self.app.cfg.engine.pipeline_depth
-        while self.engine.inflight() >= depth:
+        while (hasattr(self.engine, "inflight")
+               and self.engine.inflight() >= depth):
             await asyncio.sleep(0.001)
             async with self._engine_lock:
                 self._collect_ready_locked(time.time())
@@ -420,7 +617,7 @@ class _QueueRuntime:
                     self.engine.failed_tokens.discard(tok)
                     log.error("rescan window %d failed on device; revive "
                               "scheduled", tok)
-                    self.app.metrics.counters.inc("engine_crashes")
+                    self._record_engine_crash(now)
                     # The device pool diverged at the failed step — flag the
                     # deferred revive exactly like a failed delivery window,
                     # or traffic keeps dispatching into the diverged pool
@@ -433,7 +630,7 @@ class _QueueRuntime:
         if tok in self.engine.failed_tokens:
             self.engine.failed_tokens.discard(tok)
             log.error("window %d failed on device; nack + revive scheduled", tok)
-            self.app.metrics.counters.inc("engine_crashes")
+            self._record_engine_crash(now)
             for d in deliveries:
                 self.app.broker.nack(self.consumer_tag, d.delivery_tag,
                                      requeue=True)
@@ -663,7 +860,7 @@ class _QueueRuntime:
             self.engine.close()
         except Exception:
             log.exception("old engine close failed")
-        self.engine = make_engine(self.app.cfg, self.queue_cfg)
+        self._bind_engine(self._make_engine())
         self.engine.restore(snapshot, now)
 
     # ---- egress -----------------------------------------------------------
@@ -773,7 +970,7 @@ class _QueueRuntime:
                         continue
             except Exception:
                 log.exception("rescan failed; reviving engine from mirror")
-                self.app.metrics.counters.inc("engine_crashes")
+                self._record_engine_crash(now)
                 async with self._engine_lock:
                     # _revive_locked, not a bare _revive_engine: the failure
                     # may have set _needs_revive (failed delivery window
@@ -812,10 +1009,10 @@ class _QueueRuntime:
                         "queue %r: rescan (token %d) exceeded its 30 s "
                         "collection deadline; next tick will skip while "
                         "it is outstanding", self.queue_cfg.name, tok)
-                    self.app.metrics.counters.inc("rescan_deadline_exceeded")
+                    self.app.metrics.counters.inc("rescan_deadline_overruns")
             except Exception:
                 log.exception("rescan failed; reviving engine from mirror")
-                self.app.metrics.counters.inc("engine_crashes")
+                self._record_engine_crash(now)
                 async with self._engine_lock:
                     self._revive_locked(now)
 
@@ -838,6 +1035,136 @@ class _QueueRuntime:
                         req.enqueued_at, result, now)
         if matched:
             self.app.metrics.counters.inc("rescan_matches", matched)
+
+    # ---- health timer: breaker probes + idle re-promotion heartbeat -------
+
+    async def _health_loop(self) -> None:
+        """Dedicated low-frequency health timer (EngineConfig.
+        health_interval_s). Two jobs, both of which nothing else covers
+        under zero traffic:
+
+        - ``engine.heartbeat``: idle re-promotion for wildcard-delegated
+          team/role queues (ADVICE round-5 #3 — previously rode rescan
+          ticks, which default to OFF for team/role queues, so an idle
+          delegated queue stayed on the O(n) host oracle until the next
+          arrival);
+        - half-open circuit-breaker probes with exponential backoff
+          (_probe_device).
+
+        Supervised like the collector: one bad tick must not kill the
+        timer — a dead health loop would strand a demoted queue degraded
+        forever."""
+        interval = self.app.cfg.engine.health_interval_s
+        while True:
+            await asyncio.sleep(interval)
+            now = time.time()
+            try:
+                changed = False
+                # Skip the lock + thread hop unless the tick can actually do
+                # something: heartbeat() only acts on a delegated queue, and
+                # a re-promotion does real device work (fresh pool build +
+                # restore) that must run off the event loop.
+                if getattr(self.engine, "_team_delegate", None) is not None:
+                    async with self._engine_lock:
+                        changed = await asyncio.to_thread(
+                            self.engine.heartbeat, now)
+                if changed:
+                    self.app.metrics.counters.inc("health_repromotions")
+                if self.breaker is not None and self.breaker.probe_due(now):
+                    await self._probe_device(now)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("health tick failed; retrying")
+                self.app.metrics.counters.inc("health_tick_errors")
+
+    def _probe_build(self) -> Engine:
+        """Build a fresh device engine and run its half-open probe (one
+        no-op device step, blocked until ready). Runs OFF the event loop —
+        probe failure must cost the degraded queue nothing but this thread's
+        time. Returns the proven engine; closes it and re-raises on probe
+        failure."""
+        engine = make_engine(self.app.cfg, self.queue_cfg)
+        if self._chaos_hook is not None and hasattr(engine, "chaos_hook"):
+            engine.chaos_hook = self._chaos_hook
+        try:
+            engine.probe()
+        except BaseException:
+            try:
+                engine.close()
+            except Exception:
+                log.exception("probe engine close failed")
+            raise
+        return engine
+
+    async def _probe_device(self, now: float) -> None:
+        """Half-open probe: try the device path with a FRESH engine while
+        the degraded host engine keeps serving traffic. Success swaps the
+        pool back onto the device engine (breaker closes); failure doubles
+        the probe backoff and stays degraded."""
+        assert self.breaker is not None
+        self.breaker.begin_probe(now)
+        self.app.metrics.counters.inc("breaker_probes")
+        self._publish_breaker_gauges()
+        try:
+            candidate = await asyncio.to_thread(self._probe_build)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            self.breaker.probe_failed(time.time())
+            self.app.metrics.counters.inc("breaker_probe_failures")
+            self._publish_breaker_gauges()
+            log.warning(
+                "queue %r: half-open device probe failed (%s); next probe "
+                "in %.2fs", self.queue_cfg.name, e,
+                self.breaker.probe_delay_s)
+            return
+        async with self._engine_lock:
+            swap_now = time.time()
+            # Degraded engines are synchronous (no pipeline), so the drain
+            # is a no-op today — kept for when a future degraded tier isn't.
+            await self._drain_engine(swap_now)
+            old = self.engine
+
+            def swap() -> int:
+                snapshot = old.waiting()
+                # Restore BEFORE closing the degraded engine: a transfer
+                # failure (the same flaky device the breaker exists for)
+                # must leave the old engine intact and serving.
+                candidate.restore(snapshot, swap_now)
+                try:
+                    old.close()
+                except Exception:
+                    log.exception("degraded engine close failed")
+                return len(snapshot)
+
+            try:
+                transferred = await asyncio.to_thread(swap)
+            except Exception as e:
+                # The no-op probe passed but the real pool transfer did
+                # not. Count it as a probe failure (back off, stay OPEN) —
+                # otherwise the breaker is stranded HALF_OPEN forever and
+                # probe_due() never fires again.
+                self.breaker.probe_failed(time.time())
+                self.app.metrics.counters.inc("breaker_probe_failures")
+                self._publish_breaker_gauges()
+                try:
+                    candidate.close()
+                except Exception:
+                    log.exception("probe engine close failed")
+                log.warning(
+                    "queue %r: pool transfer to the probed device engine "
+                    "failed (%s); staying degraded, next probe in %.2fs",
+                    self.queue_cfg.name, e, self.breaker.probe_delay_s)
+                return
+            self._bind_engine(candidate)
+            self.breaker.probe_succeeded(time.time())
+        self.app.metrics.counters.inc("breaker_closes")
+        self._publish_breaker_gauges()
+        log.info(
+            "queue %r: half-open probe succeeded — breaker CLOSED, device "
+            "engine restored (%d waiting players transferred)",
+            self.queue_cfg.name, transferred)
 
     # ---- timeout sweeper --------------------------------------------------
 
@@ -864,7 +1191,7 @@ class _QueueRuntime:
                         self.engine.expire, now, timeout)
             except Exception:
                 log.exception("timeout sweep failed; reviving engine from mirror")
-                self.app.metrics.counters.inc("engine_crashes")
+                self._record_engine_crash(now)
                 self._revive_engine(now)
                 continue
             for removed in expired:
@@ -882,6 +1209,8 @@ class _QueueRuntime:
             self._sweeper.cancel()
         if self._rescanner is not None:
             self._rescanner.cancel()
+        if self._health is not None:
+            self._health.cancel()
         # Drain the batcher BEFORE cancelling the consumer so the final
         # windows can still ack their deliveries; then collect any windows
         # the final flush left in flight.
@@ -898,7 +1227,13 @@ class MatchmakingApp:
 
     def __init__(self, cfg: Config | None = None, broker: InProcBroker | None = None):
         self.cfg = cfg or Config()
-        self.broker = broker or InProcBroker(self.cfg.broker, self.cfg.seed)
+        #: Deterministic chaos runtime (None when no schedule configured):
+        #: one shared state so broker faults and per-queue engine fault
+        #: hooks replay from a single script (utils/chaos.py).
+        self.chaos: ChaosState | None = (
+            ChaosState(self.cfg.chaos) if self.cfg.chaos.enabled() else None)
+        self.broker = broker or InProcBroker(self.cfg.broker, self.cfg.seed,
+                                             chaos=self.chaos)
         self.metrics = Metrics()
         self._runtimes: dict[str, _QueueRuntime] = {}
         self._started = False

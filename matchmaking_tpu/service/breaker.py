@@ -1,0 +1,124 @@
+"""Per-queue device-engine circuit breaker (SURVEY.md §5 "Failure
+detection").
+
+The pre-breaker revive path restores the device engine from the host mirror
+on *every* crash with no hysteresis: a persistently failing device path (bad
+shape bucket, OOM, flaky interconnect) revive-loops at full traffic rate —
+each window pays an engine rebuild + restore, and no match ever completes.
+The breaker adds the OTP-style escalation the reference's supervision tree
+implies: crash-storm detection, graceful degradation to the host oracle
+(matches keep flowing at oracle throughput), and exponential-backoff
+half-open probes that re-promote the device path once it heals.
+
+State machine (pure bookkeeping — the queue runtime in service/app.py owns
+the engine swaps; this class never touches an engine):
+
+    CLOSED ──(≥ threshold crashes in window_s)──▶ OPEN
+    OPEN ──(probe timer due)──▶ HALF_OPEN
+    HALF_OPEN ──probe ok──▶ CLOSED        (device engine restored)
+    HALF_OPEN ──probe failed──▶ OPEN      (probe delay ×= backoff, capped)
+
+All methods take ``now`` explicitly so tests drive the clock.
+"""
+
+from __future__ import annotations
+
+import collections
+
+from matchmaking_tpu.config import EngineConfig
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+#: State → numeric gauge code (monitorable threshold: anything > 0 means
+#: the queue is off its device path).
+STATE_CODE = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+
+class CircuitBreaker:
+    def __init__(self, cfg: EngineConfig):
+        self.threshold = cfg.breaker_threshold
+        self.window_s = cfg.breaker_window_s
+        self.probe_initial_s = cfg.breaker_probe_initial_s
+        self.probe_backoff = cfg.breaker_probe_backoff
+        self.probe_max_s = cfg.breaker_probe_max_s
+        self.state = CLOSED
+        self._crashes: collections.deque[float] = collections.deque()
+        self.probe_delay_s = self.probe_initial_s
+        self.next_probe_at = 0.0
+        # Lifetime accounting (surfaced via snapshot() → /metrics,/healthz).
+        self.trips = 0
+        self.probes = 0
+        self.probe_failures = 0
+        self.opened_at = 0.0
+        self.time_degraded_s = 0.0
+
+    @property
+    def enabled(self) -> bool:
+        return self.threshold > 0
+
+    def record_crash(self, now: float) -> bool:
+        """Count one engine crash; returns True when THIS crash trips the
+        breaker open (the caller demotes the queue and logs). Crashes while
+        already open/half-open don't re-trip — the queue is on the host
+        path and a host crash is a different failure class."""
+        if not self.enabled or self.state != CLOSED:
+            return False
+        self._crashes.append(now)
+        floor = now - self.window_s
+        while self._crashes and self._crashes[0] < floor:
+            self._crashes.popleft()
+        if len(self._crashes) < self.threshold:
+            return False
+        self.state = OPEN
+        self.trips += 1
+        self.opened_at = now
+        self.probe_delay_s = self.probe_initial_s
+        self.next_probe_at = now + self.probe_delay_s
+        self._crashes.clear()
+        return True
+
+    def probe_due(self, now: float) -> bool:
+        return self.state == OPEN and now >= self.next_probe_at
+
+    def begin_probe(self, now: float) -> None:
+        assert self.state == OPEN, "probe without an open breaker"
+        self.state = HALF_OPEN
+        self.probes += 1
+
+    def probe_failed(self, now: float) -> None:
+        """Half-open probe failed: back off exponentially and stay open."""
+        assert self.state == HALF_OPEN
+        self.state = OPEN
+        self.probe_failures += 1
+        self.probe_delay_s = min(self.probe_max_s,
+                                 self.probe_delay_s * self.probe_backoff)
+        self.next_probe_at = now + self.probe_delay_s
+
+    def probe_succeeded(self, now: float) -> None:
+        """Half-open probe succeeded: close (the caller has already swapped
+        the device engine back in)."""
+        assert self.state == HALF_OPEN
+        self.state = CLOSED
+        self.time_degraded_s += max(0.0, now - self.opened_at)
+        self.opened_at = 0.0
+        self.probe_delay_s = self.probe_initial_s
+        self.next_probe_at = 0.0
+        self._crashes.clear()
+
+    def snapshot(self, now: float | None = None) -> dict:
+        """JSON-ready state for /healthz and /metrics. ``time_degraded_s``
+        includes the current open stretch when ``now`` is given."""
+        degraded = self.time_degraded_s
+        if now is not None and self.state != CLOSED and self.opened_at:
+            degraded += max(0.0, now - self.opened_at)
+        return {
+            "state": self.state,
+            "enabled": self.enabled,
+            "trips": self.trips,
+            "probes": self.probes,
+            "probe_failures": self.probe_failures,
+            "probe_delay_s": round(self.probe_delay_s, 3),
+            "time_degraded_s": round(degraded, 3),
+        }

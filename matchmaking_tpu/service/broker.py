@@ -49,6 +49,10 @@ class Delivery:
     delivery_tag: int
     redelivered: bool = False
     redelivery_count: int = 0
+    #: Per-queue publish sequence number (chaos identity: fault decisions
+    #: are pure functions of it, so runs replay deterministically). -1 when
+    #: no chaos schedule covers the queue — nothing is counted.
+    seq: int = -1
 
 
 class _Queue:
@@ -56,6 +60,12 @@ class _Queue:
         self.name = name
         self.messages: asyncio.Queue[Delivery] = asyncio.Queue()
         self.consumers: list["_Consumer"] = []
+        #: Partition gate (chaos): set = consumers flow; cleared = paused.
+        self.gate = asyncio.Event()
+        self.gate.set()
+        #: Failsafe auto-resume timer for the CURRENT partition (cancelled
+        #: on scripted resume so it cannot fire into a LATER partition).
+        self.gate_timer: asyncio.TimerHandle | None = None
 
 
 class _BatchState:
@@ -121,6 +131,11 @@ class _Consumer:
         # strategies"): N in-flight handlers per consumer. batch_hint
         # consumers trade that for one task per drained burst (see above).
         while not self.cancelled:
+            if not self.queue.gate.is_set():
+                # Chaos partition: the queue's consumers pause here until
+                # the scripted resume publish (or the failsafe timer) opens
+                # the gate. Messages buffer; at-least-once holds.
+                await self.queue.gate.wait()
             await self._acquire()
             try:
                 delivery = await self.queue.messages.get()
@@ -178,15 +193,25 @@ class _Consumer:
             self._requeue_batch_rest(state)
 
     async def _handle(self, delivery: Delivery) -> None:
-        if self.broker.consume_faults_enabled:
-            await self.broker._inject_faults(self.queue, delivery)
-        if self.broker._should_drop():
-            # Fault injection: consumer "crashed" before processing —
-            # the delivery is requeued as AMQP would on channel close.
-            self.broker.stats["dropped"] += 1
-            self._release()
-            self.broker._requeue(self.queue, delivery)
-            return
+        broker = self.broker
+        if broker.consume_faults_enabled:
+            # The ONE consume-side fault gate: delay, seeded/scripted chaos
+            # drops, and probabilistic drops all live behind it, so a
+            # fault-free broker pays zero per-delivery overhead here.
+            if broker.cfg.delay_ms > 0:
+                broker.stats["delayed"] += 1
+                await asyncio.sleep(broker.cfg.delay_ms / 1000.0)
+            chaos = broker.chaos
+            if ((chaos is not None
+                 and chaos.should_drop(delivery.queue, delivery.seq,
+                                       delivery.redelivery_count))
+                    or broker._should_drop()):
+                # Fault injection: consumer "crashed" before processing —
+                # the delivery is requeued as AMQP would on channel close.
+                broker.stats["dropped"] += 1
+                self._release()
+                broker._requeue(self.queue, delivery)
+                return
         self.unacked[delivery.delivery_tag] = delivery
         try:
             await self.callback(delivery)
@@ -230,20 +255,37 @@ class _Consumer:
 class InProcBroker:
     """The broker. All methods are called from one event loop."""
 
-    def __init__(self, cfg: BrokerConfig | None = None, seed: int = 0):
+    def __init__(self, cfg: BrokerConfig | None = None, seed: int = 0,
+                 chaos: "Any | None" = None):
         self.cfg = cfg or BrokerConfig()
+        #: Deterministic chaos schedule (utils/chaos.py ChaosState), or
+        #: None. Owned by the app (shared with the engine hooks) so broker
+        #: and engine faults replay from one script.
+        self.chaos = chaos
         #: Any consume-side fault injection configured? The hot path skips
-        #: the per-delivery _inject_faults await entirely when False —
-        #: future fault kinds added to _inject_faults must extend THIS
-        #: flag, not get gated out by a field-specific check.
-        self.consume_faults_enabled = self.cfg.delay_ms > 0
+        #: the whole per-delivery fault block when False — future consume
+        #: fault kinds must extend THIS flag, not get gated out by a
+        #: field-specific check inside the block.
+        self.consume_faults_enabled = (
+            self.cfg.delay_ms > 0 or self.cfg.drop_prob > 0
+            or (chaos is not None and chaos.consume_faults())
+        )
+        #: Publish-side twin: dup copies and chaos storms/partitions.
+        self.publish_faults_enabled = (
+            self.cfg.dup_prob > 0
+            or (chaos is not None and chaos.publish_faults())
+        )
         self._queues: dict[str, _Queue] = {}
         self._tags = itertools.count(1)
         self._consumers: dict[str, _Consumer] = {}
         self._rng = random.Random(seed)
+        #: Per-queue publish sequence counters (chaos identity; only
+        #: advanced for queues a chaos schedule covers).
+        self._pub_seq: dict[str, int] = {}
         self.stats = {
             "published": 0, "acked": 0, "dropped": 0, "duplicated": 0,
-            "dead_lettered": 0, "consumer_errors": 0, "unroutable": 0,
+            "delayed": 0, "dead_lettered": 0, "consumer_errors": 0,
+            "unroutable": 0, "partitions": 0,
         }
 
     # ---- queue ops --------------------------------------------------------
@@ -280,12 +322,19 @@ class InProcBroker:
         if q is None:
             self.stats["unroutable"] += 1
             return
+        chaos = self.chaos
+        seq = -1
+        if chaos is not None and chaos.applies(queue):
+            seq = self._pub_seq.get(queue, 0)
+            self._pub_seq[queue] = seq + 1
         delivery = Delivery(
             body=bytes(body), properties=properties or Properties(),
-            queue=queue, delivery_tag=next(self._tags),
+            queue=queue, delivery_tag=next(self._tags), seq=seq,
         )
         self.stats["published"] += 1
         q.messages.put_nowait(delivery)
+        if not self.publish_faults_enabled:
+            return
         if self.cfg.dup_prob > 0 and self._rng.random() < self.cfg.dup_prob:
             # Fault injection: duplicate delivery (at-least-once world).
             self.stats["duplicated"] += 1
@@ -293,6 +342,24 @@ class InProcBroker:
                            queue=queue, delivery_tag=next(self._tags),
                            redelivered=True)
             q.messages.put_nowait(dup)
+        if chaos is None or seq < 0:
+            return
+        # Chaos storms: extra copies get their OWN publish seqs (they are
+        # distinct deliveries for drop accounting) but are never themselves
+        # re-evaluated for duplication — a storm cannot cascade.
+        for _ in range(chaos.dup_copies(queue, seq)):
+            cseq = self._pub_seq[queue]
+            self._pub_seq[queue] = cseq + 1
+            self.stats["duplicated"] += 1
+            q.messages.put_nowait(Delivery(
+                body=bytes(body), properties=delivery.properties,
+                queue=queue, delivery_tag=next(self._tags),
+                redelivered=True, seq=cseq))
+        action = chaos.partition_action(queue, seq)
+        if action == "pause":
+            self._pause(q)
+        elif action == "resume":
+            self._resume(q)
 
     def basic_consume(self, queue: str,
                       callback: Callable[[Delivery], Awaitable[None]],
@@ -364,9 +431,29 @@ class InProcBroker:
     def _should_drop(self) -> bool:
         return self.cfg.drop_prob > 0 and self._rng.random() < self.cfg.drop_prob
 
-    async def _inject_faults(self, queue: _Queue, delivery: Delivery) -> None:
-        if self.cfg.delay_ms > 0:
-            await asyncio.sleep(self.cfg.delay_ms / 1000.0)
+    def _pause(self, q: _Queue) -> None:
+        """Chaos partition: pause the queue's consumers. The scripted
+        resume publish re-opens the gate; a wall-clock failsafe
+        (ChaosConfig.partition_max_s) guards against schedules whose
+        resume seq never arrives — a chaos script must not wedge a drain."""
+        if not q.gate.is_set():
+            return
+        q.gate.clear()
+        self.stats["partitions"] += 1
+        max_s = self.chaos.cfg.partition_max_s if self.chaos else 0.0
+        if max_s > 0:
+            try:
+                q.gate_timer = asyncio.get_running_loop().call_later(
+                    max_s, lambda: self._resume(q))
+            except RuntimeError:  # pragma: no cover - no running loop
+                pass
+
+    def _resume(self, q: _Queue) -> None:
+        if q.gate_timer is not None:
+            q.gate_timer.cancel()
+            q.gate_timer = None
+        if not q.gate.is_set():
+            q.gate.set()
 
     def _requeue(self, queue: _Queue, delivery: Delivery) -> None:
         if delivery.redelivery_count >= self.cfg.max_redelivery:

@@ -10,6 +10,7 @@ liveness at /healthz (includes per-queue pool occupancy + engine backend).
 from __future__ import annotations
 
 import json
+import time
 from typing import Any
 
 try:
@@ -25,6 +26,18 @@ def _flatten_prom(report: dict[str, Any]) -> str:
         metric = f"matchmaking_{name}"
         lines.append(f"# TYPE {metric} counter")
         lines.append(f"{metric} {value}")
+    for name, value in sorted(report.get("gauges", {}).items()):
+        # Gauge names may carry a [queue] suffix → a prom label.
+        base, _, queue = name.partition("[")
+        metric = f"matchmaking_{base}"
+        if queue:
+            lines.append(f'{metric}{{queue="{queue.rstrip("]")}"}} {value}')
+        else:
+            lines.append(f"{metric} {value}")
+    for queue, snap in sorted(report.get("breakers", {}).items()):
+        for stat in ("trips", "probes", "probe_failures"):
+            lines.append(
+                f'matchmaking_breaker_{stat}{{queue="{queue}"}} {snap[stat]}')
     for series, summary in sorted(report.get("latency", {}).items()):
         for stat, value in sorted(summary.items()):
             metric = f"matchmaking_{series}_{stat}"
@@ -91,19 +104,45 @@ class ObservabilityServer:
         }
         if counters:
             report["engine_counters"] = counters
+        # Circuit-breaker state (service/breaker.py): live snapshots so
+        # time_degraded_s includes the current open stretch, not just the
+        # gauge written at the last transition.
+        now = time.time()
+        breakers = {
+            name: rt.breaker.snapshot(now)
+            for name, rt in self.app._runtimes.items()
+            if getattr(rt, "breaker", None) is not None
+        }
+        if breakers:
+            report["breakers"] = breakers
         return report
 
     async def _healthz(self, request) -> "web.Response":
+        now = time.time()
+        queues: dict[str, Any] = {}
+        degraded: list[str] = []
+        for name, rt in self.app._runtimes.items():
+            entry: dict[str, Any] = {
+                "backend": rt.app.cfg.engine.backend,
+                # The LIVE engine class, not the configured backend: a
+                # breaker-demoted queue reports the host oracle it is
+                # actually running on.
+                "engine": type(rt.engine).__name__,
+                "pool_size": rt.engine.pool_size(),
+                "team_size": rt.queue_cfg.team_size,
+            }
+            breaker = getattr(rt, "breaker", None)
+            if breaker is not None:
+                entry["breaker"] = breaker.snapshot(now)
+                if breaker.state != "closed":
+                    degraded.append(name)
+            queues[name] = entry
         body = {
-            "status": "ok",
-            "queues": {
-                name: {
-                    "backend": rt.app.cfg.engine.backend,
-                    "pool_size": rt.engine.pool_size(),
-                    "team_size": rt.queue_cfg.team_size,
-                }
-                for name, rt in self.app._runtimes.items()
-            },
+            # Degraded ≠ dead: matches still flow on the host path, so the
+            # service stays live — operators alert on the field instead.
+            "status": "degraded" if degraded else "ok",
+            "degraded_queues": degraded,
+            "queues": queues,
         }
         return web.json_response(body)
 

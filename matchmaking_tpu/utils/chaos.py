@@ -1,0 +1,156 @@
+"""Deterministic chaos-schedule runtime (config.ChaosConfig is the script).
+
+The reference's resilience story is probabilistic soak testing; the rebuild's
+fault soaks were timing-flaky because the broker's fault RNG is shared and
+its call ORDER depends on event-loop scheduling. This module makes every
+fault decision a pure function of *message identity* (per-queue publish
+sequence number + redelivery attempt) or *device-step index*, so a chaos run
+replays bit-identically under any interleaving:
+
+- ``ChaosState`` — one per app: per-queue broker fault decisions
+  (drop/dup/partition) and the registry of per-queue engine hooks.
+- ``EngineChaosHook`` — one per queue, owned by the QUEUE RUNTIME and
+  re-attached to every fresh engine, so device-step indices keep advancing
+  across engine revives: a schedule failing steps 0-2 trips the circuit
+  breaker instead of re-failing step 0 on each fresh engine forever.
+
+Engine hooks cover SEARCH steps and breaker probes only. Admission, evict
+and restore dispatches are exempt by design: they are the crash-recovery
+path itself, and a schedule that could fail a revive would turn every
+injected crash into unrecoverable pool loss instead of the degradation the
+breaker exists to test.
+"""
+
+from __future__ import annotations
+
+import zlib
+
+from matchmaking_tpu.config import ChaosConfig
+
+_MASK = (1 << 64) - 1
+
+
+class ChaosInjectedError(RuntimeError):
+    """Raised at a scripted chaos fault point (device step / probe)."""
+
+
+def _mix(h: int) -> int:
+    """splitmix64 finalizer — full-avalanche 64-bit mix."""
+    h = (h ^ (h >> 30)) * 0xBF58476D1CE4E5B9 & _MASK
+    h = (h ^ (h >> 27)) * 0x94D049BB133111EB & _MASK
+    return h ^ (h >> 31)
+
+
+def hash01(seed: int, *keys: "int | str") -> float:
+    """Deterministic uniform [0, 1) from (seed, *keys). Strings hash via
+    crc32, not builtin ``hash`` — PYTHONHASHSEED must not change a chaos
+    schedule between runs."""
+    h = _mix(seed & _MASK ^ 0x9E3779B97F4A7C15)
+    for k in keys:
+        if isinstance(k, str):
+            k = zlib.crc32(k.encode())
+        h = _mix(h ^ (k & _MASK))
+    return h / float(1 << 64)
+
+
+class EngineChaosHook:
+    """Per-queue device-step fault stream. The counters live HERE — outside
+    the engine — so scripted step indices survive engine revives (see module
+    docstring). Attached to engines by the queue runtime; ``None`` hook on
+    an engine means no chaos."""
+
+    __slots__ = ("cfg", "steps", "probes", "_fail", "_ranges")
+
+    def __init__(self, cfg: ChaosConfig):
+        self.cfg = cfg
+        self.steps = 0
+        self.probes = 0
+        self._fail = frozenset(cfg.fail_steps)
+        self._ranges = tuple(cfg.fail_step_ranges)
+
+    def on_step(self) -> None:
+        """One device SEARCH-step dispatch is about to run. Raises
+        ChaosInjectedError at scripted indices; the engine must call this
+        BEFORE mutating any state for the chunk."""
+        idx = self.steps
+        self.steps += 1
+        if idx in self._fail or any(a <= idx < b for a, b in self._ranges):
+            raise ChaosInjectedError(
+                f"chaos: scripted device-step failure at step index {idx}")
+
+    def on_probe(self) -> None:
+        """One half-open breaker probe is about to run (separate stream from
+        on_step so probe outcomes are scriptable independently of how many
+        traffic steps a crash storm consumed)."""
+        idx = self.probes
+        self.probes += 1
+        if idx < self.cfg.fail_probes:
+            raise ChaosInjectedError(
+                f"chaos: scripted probe failure (probe index {idx})")
+
+
+class ChaosState:
+    """Mutable per-run chaos bookkeeping. One instance per app; the broker
+    consults it for fault decisions, queue runtimes pull their engine hooks
+    from it. All decisions are pure functions of (seed, queue, seq[,
+    attempt]) — see module docstring."""
+
+    def __init__(self, cfg: ChaosConfig):
+        self.cfg = cfg
+        self._queues = frozenset(cfg.queues)
+        self._drop_seqs = frozenset(cfg.drop_seqs)
+        self._dup_seqs = {int(s): int(n) for s, n in cfg.dup_seqs}
+        self._hooks: dict[str, EngineChaosHook] = {}
+
+    def applies(self, queue: str) -> bool:
+        return not self._queues or queue in self._queues
+
+    # ---- broker faults ----------------------------------------------------
+
+    def consume_faults(self) -> bool:
+        return self.cfg.consume_faults()
+
+    def publish_faults(self) -> bool:
+        return self.cfg.publish_faults()
+
+    def should_drop(self, queue: str, seq: int, attempt: int) -> bool:
+        """Consume-side drop decision for delivery ``seq`` on its
+        ``attempt``-th processing try (0 = first). Scripted drop_seqs hit
+        the first attempt only — the redelivery must make progress."""
+        if seq < 0 or not self.applies(queue):
+            return False
+        if attempt == 0 and seq in self._drop_seqs:
+            return True
+        p = self.cfg.drop_prob
+        return p > 0 and hash01(self.cfg.seed, "drop", queue, seq, attempt) < p
+
+    def dup_copies(self, queue: str, seq: int) -> int:
+        """Extra delivery copies to enqueue for publish ``seq``."""
+        if not self.applies(queue):
+            return 0
+        extra = self._dup_seqs.get(seq, 0)
+        p = self.cfg.dup_prob
+        if p > 0 and hash01(self.cfg.seed, "dup", queue, seq) < p:
+            extra += 1
+        return extra
+
+    def partition_action(self, queue: str, seq: int) -> str | None:
+        """"pause"/"resume"/None for publish ``seq`` on ``queue``. Publishes
+        are sequential per queue, so exact-index matching suffices."""
+        if not self.applies(queue):
+            return None
+        for pause_seq, resume_seq in self.cfg.partitions:
+            if seq == resume_seq:
+                return "resume"
+            if seq == pause_seq:
+                return "pause"
+        return None
+
+    # ---- engine hooks -----------------------------------------------------
+
+    def engine_hook(self, queue: str) -> EngineChaosHook:
+        hook = self._hooks.get(queue)
+        if hook is None:
+            hook = EngineChaosHook(self.cfg)
+            self._hooks[queue] = hook
+        return hook

@@ -61,8 +61,14 @@ class InvariantChecker:
                 self.observe_queued(pid)
             return
         for match in outcome.matches:
+            # Expand parties: one request can carry several players, all of
+            # whom count toward the team size and all of whom the match
+            # consumes (a party member double-matched through a redelivered
+            # copy of its leader must still be caught).
             self.observe_match(
                 match.match_id,
-                tuple(tuple(r.id for r in team) for team in match.teams))
+                tuple(tuple(pid for r in team for pid in r.all_ids())
+                      for team in match.teams))
         for req in outcome.queued:
-            self.observe_queued(req.id)
+            for pid in req.all_ids():
+                self.observe_queued(pid)

@@ -130,6 +130,11 @@ class Metrics:
     def __init__(self) -> None:
         self.counters = Counter()
         self.latency: dict[str, LatencyRecorder] = defaultdict(LatencyRecorder)
+        #: Point-in-time gauges (set, not accumulated): circuit-breaker
+        #: state per queue (0=closed 1=half_open 2=open), time degraded,
+        #: current probe backoff — anything whose CURRENT value matters
+        #: more than its history.
+        self.gauges: dict[str, float] = {}
         # No CompileCounter.install() here: installing imports jax, which a
         # pure-CPU deployment (CpuEngine = numpy oracle) otherwise never
         # pays for. TpuEngine.__init__ installs it — exactly the processes
@@ -138,11 +143,15 @@ class Metrics:
     def record_latency(self, name: str, seconds: float) -> None:
         self.latency[name].record(seconds)
 
+    def set_gauge(self, name: str, value: float) -> None:
+        self.gauges[name] = float(value)
+
     def report(self) -> dict:
         counters = self.counters.snapshot()
         counters["xla_compiles"] = float(CompileCounter.count())
         return {
             "counters": counters,
+            "gauges": dict(self.gauges),
             "latency": {k: v.summary_ms() for k, v in self.latency.items()},
         }
 

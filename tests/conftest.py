@@ -37,6 +37,13 @@ def rng():
 # ``async def test_*`` runs under asyncio.run with its sync fixtures.
 def pytest_configure(config):
     config.addinivalue_line("markers", "asyncio: run coroutine test in an event loop")
+    config.addinivalue_line(
+        "markers",
+        "chaos: seeded fault-injection test (deterministic ChaosSchedule; "
+        "the fast ones run in tier-1, soaks additionally carry `slow`)")
+    config.addinivalue_line(
+        "markers", "slow: long soak — excluded from the tier-1 `-m 'not "
+        "slow'` run")
 
 
 @pytest.hookimpl(tryfirst=True)

@@ -1,0 +1,190 @@
+"""Unit tests: circuit-breaker state machine (service/breaker.py) and the
+deterministic chaos primitives (utils/chaos.py). Pure-host, no engine — the
+clock is driven explicitly, so every transition is pinned exactly."""
+
+import pytest
+
+from matchmaking_tpu.config import ChaosConfig, Config, EngineConfig
+from matchmaking_tpu.service.breaker import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    CircuitBreaker,
+)
+from matchmaking_tpu.utils.chaos import (
+    ChaosInjectedError,
+    ChaosState,
+    EngineChaosHook,
+    hash01,
+)
+
+
+def _breaker(threshold=3, window_s=10.0, initial=1.0, backoff=2.0,
+             max_s=8.0) -> CircuitBreaker:
+    return CircuitBreaker(EngineConfig(
+        breaker_threshold=threshold, breaker_window_s=window_s,
+        breaker_probe_initial_s=initial, breaker_probe_backoff=backoff,
+        breaker_probe_max_s=max_s))
+
+
+class TestCircuitBreaker:
+    def test_disabled_never_trips(self):
+        b = _breaker(threshold=0)
+        assert not b.enabled
+        for t in range(100):
+            assert b.record_crash(float(t)) is False
+        assert b.state == CLOSED
+        assert b.trips == 0
+
+    def test_trips_on_nth_crash_in_window(self):
+        b = _breaker(threshold=3, window_s=10.0)
+        assert b.record_crash(0.0) is False
+        assert b.record_crash(1.0) is False
+        assert b.record_crash(2.0) is True  # the tripping crash
+        assert b.state == OPEN
+        assert b.trips == 1
+        assert b.next_probe_at == pytest.approx(3.0)  # now + initial
+
+    def test_window_slides_old_crashes_out(self):
+        b = _breaker(threshold=3, window_s=10.0)
+        b.record_crash(0.0)
+        b.record_crash(1.0)
+        # 11.0 evicts both earlier crashes (outside the 10 s window): the
+        # count restarts, no trip.
+        assert b.record_crash(11.5) is False
+        assert b.state == CLOSED
+        assert b.record_crash(12.0) is False
+        assert b.record_crash(12.5) is True
+
+    def test_crashes_while_open_do_not_retrip(self):
+        b = _breaker(threshold=2)
+        b.record_crash(0.0)
+        assert b.record_crash(0.5) is True
+        # Degraded-path crashes are a different failure class: counted by
+        # the caller's engine_crashes counter, but never re-trip.
+        assert b.record_crash(0.6) is False
+        assert b.trips == 1
+
+    def test_probe_schedule_backoff_and_cap(self):
+        b = _breaker(threshold=1, initial=1.0, backoff=2.0, max_s=3.0)
+        b.record_crash(0.0)
+        assert not b.probe_due(0.5)
+        assert b.probe_due(1.0)
+        b.begin_probe(1.0)
+        assert b.state == HALF_OPEN
+        b.probe_failed(1.1)
+        assert b.state == OPEN
+        assert b.probe_delay_s == pytest.approx(2.0)  # doubled
+        assert b.next_probe_at == pytest.approx(3.1)
+        b.begin_probe(3.1)
+        b.probe_failed(3.2)
+        assert b.probe_delay_s == pytest.approx(3.0)  # capped at max_s
+        assert b.probe_failures == 2
+
+    def test_probe_success_closes_and_resets(self):
+        b = _breaker(threshold=1, initial=1.0, backoff=2.0)
+        b.record_crash(0.0)
+        b.begin_probe(1.0)
+        b.probe_failed(1.0)
+        b.begin_probe(3.0)
+        b.probe_succeeded(3.5)
+        assert b.state == CLOSED
+        assert b.probe_delay_s == pytest.approx(1.0)  # reset to initial
+        assert b.time_degraded_s == pytest.approx(3.5)  # opened at 0.0
+        # A fresh storm trips again from a clean slate.
+        assert b.record_crash(10.0) is True
+        assert b.trips == 2
+
+    def test_snapshot_includes_live_degraded_time(self):
+        b = _breaker(threshold=1)
+        b.record_crash(100.0)
+        snap = b.snapshot(104.0)
+        assert snap["state"] == OPEN
+        assert snap["time_degraded_s"] == pytest.approx(4.0)
+        assert snap["trips"] == 1
+
+
+class TestChaosPrimitives:
+    def test_hash01_deterministic_and_uniformish(self):
+        a = [hash01(7, "drop", "mm.q", i, 0) for i in range(2000)]
+        b = [hash01(7, "drop", "mm.q", i, 0) for i in range(2000)]
+        assert a == b  # bit-identical replay
+        assert all(0.0 <= x < 1.0 for x in a)
+        frac = sum(1 for x in a if x < 0.1) / len(a)
+        assert 0.05 < frac < 0.15  # ~10% under the 0.1 threshold
+        # Different seed → different stream.
+        assert [hash01(8, "drop", "mm.q", i, 0) for i in range(2000)] != a
+
+    def test_engine_hook_scripted_steps_and_ranges(self):
+        hook = EngineChaosHook(ChaosConfig(fail_steps=(1,),
+                                           fail_step_ranges=((3, 5),)))
+        hook.on_step()  # 0 ok
+        with pytest.raises(ChaosInjectedError):
+            hook.on_step()  # 1 scripted
+        hook.on_step()  # 2 ok
+        for _ in range(2):  # 3, 4 in range
+            with pytest.raises(ChaosInjectedError):
+                hook.on_step()
+        hook.on_step()  # 5 ok — counters advanced THROUGH the failures
+        assert hook.steps == 6
+
+    def test_engine_hook_probe_stream_is_separate(self):
+        hook = EngineChaosHook(ChaosConfig(fail_probes=2, fail_steps=(0,)))
+        with pytest.raises(ChaosInjectedError):
+            hook.on_probe()
+        with pytest.raises(ChaosInjectedError):
+            hook.on_probe()
+        hook.on_probe()  # third probe succeeds
+        # Step stream unaffected by probe count.
+        with pytest.raises(ChaosInjectedError):
+            hook.on_step()
+
+    def test_state_scripted_drop_first_attempt_only(self):
+        st = ChaosState(ChaosConfig(drop_seqs=(4,), queues=("mm.q",)))
+        assert st.should_drop("mm.q", 4, 0) is True
+        assert st.should_drop("mm.q", 4, 1) is False  # redelivery progresses
+        assert st.should_drop("mm.q", 3, 0) is False
+        assert st.should_drop("other.q", 4, 0) is False  # queue-scoped
+        assert st.should_drop("mm.q", -1, 0) is False  # unsequenced
+
+    def test_state_dup_and_partition_scripts(self):
+        st = ChaosState(ChaosConfig(dup_seqs=((2, 3),),
+                                    partitions=((5, 9),)))
+        assert st.dup_copies("mm.q", 2) == 3
+        assert st.dup_copies("mm.q", 1) == 0
+        assert st.partition_action("mm.q", 5) == "pause"
+        assert st.partition_action("mm.q", 9) == "resume"
+        assert st.partition_action("mm.q", 7) is None
+
+    def test_engine_hook_survives_across_lookups(self):
+        st = ChaosState(ChaosConfig(fail_steps=(0,)))
+        hook = st.engine_hook("mm.q")
+        with pytest.raises(ChaosInjectedError):
+            hook.on_step()
+        # Same hook handed back after a revive: the counter persisted, so
+        # step 0 is not re-failed forever.
+        again = st.engine_hook("mm.q")
+        assert again is hook
+        again.on_step()  # step 1 ok
+
+    def test_config_enabled_flags(self):
+        off = ChaosConfig()
+        assert not off.enabled()
+        assert ChaosConfig(drop_prob=0.1).consume_faults()
+        assert not ChaosConfig(drop_prob=0.1).publish_faults()
+        assert ChaosConfig(dup_seqs=((1, 2),)).publish_faults()
+        assert ChaosConfig(partitions=((0, 3),)).enabled()
+        assert ChaosConfig(fail_probes=1).enabled()
+
+    def test_config_from_dict_nested_tuples(self):
+        cfg = Config.from_dict({
+            "chaos": {"seed": 9, "drop_seqs": [1, 2],
+                      "dup_seqs": [[3, 2]], "partitions": [[4, 8]],
+                      "fail_step_ranges": [[0, 3]]},
+        })
+        assert cfg.chaos.seed == 9
+        assert cfg.chaos.drop_seqs == (1, 2)
+        assert cfg.chaos.dup_seqs == ((3, 2),)
+        assert cfg.chaos.partitions == ((4, 8),)
+        assert cfg.chaos.fail_step_ranges == ((0, 3),)
+        assert cfg.chaos.enabled()
