@@ -591,11 +591,19 @@ def bench_e2e(args) -> dict:
                 if row["e2e_matched_per_s"] >= 0.9 * r:
                     knee = max(knee or 0.0, r)
 
+        # Snapshot the final /metrics-style report into the BENCH json:
+        # future rounds get stage-level trajectories (per-stage latency
+        # histograms, engine counters, broker stats), not just the headline
+        # matches/s + p99 rows.
+        from matchmaking_tpu.service.observability import build_report
+
+        metrics_report = build_report(app)
         await app.stop()
         out = dict(headline)
         if sweep_rows:
             out["e2e_sweep"] = sweep_rows
             out["e2e_knee_req_s"] = knee
+        out["metrics_report"] = metrics_report
         return out
 
     return asyncio.run(run())

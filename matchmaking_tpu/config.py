@@ -291,6 +291,37 @@ class ChaosConfig:
 
 
 @dataclass(frozen=True)
+class ObservabilityConfig:
+    """Request-lifecycle flight recorder + debug surfaces (utils/trace.py,
+    service/observability.py). The BASELINE north star asserts a p99;
+    these knobs size the machinery that explains one: trace contexts are
+    stamped at broker publish, carried through every stage, and settled
+    into per-queue rings + true per-stage histograms."""
+
+    #: Trace-context stamping + flight recording. On by default: the cost
+    #: is one small object per publish and O(marks) appends per delivery —
+    #: measured noise next to decode/publish work.
+    trace: bool = True
+    #: Completed traces kept per queue (newest wins; bounded memory).
+    trace_ring: int = 256
+    #: Slow-trace exemplars kept per queue.
+    slow_trace_ring: int = 64
+    #: A settled trace whose enqueue→publish span exceeds this keeps its
+    #: full stage breakdown in the slow ring (/debug/traces "slow").
+    slow_trace_ms: float = 250.0
+    #: Lifecycle event-log ring size (/debug/events): breaker trips,
+    #: probes, delegations, re-promotions, revives, chaos faults.
+    event_ring: int = 512
+    #: Per-stage histogram bucket upper bounds in SECONDS; () → the
+    #: default log-spaced scheme (utils/metrics.DEFAULT_STAGE_BUCKETS:
+    #: 100 µs · 2^k, 24 buckets + overflow, topping out ~14 min).
+    stage_buckets: tuple[float, ...] = ()
+    #: Where /debug/profile?secs=N writes its jax.profiler capture;
+    #: "" → a fresh temp directory per capture.
+    profile_dir: str = ""
+
+
+@dataclass(frozen=True)
 class BatcherConfig:
     """Request windowing: collect a batch per queue, dispatch one kernel."""
 
@@ -321,6 +352,9 @@ class Config:
     #: Deterministic fault-injection schedule (off by default — every field
     #: zero/empty means no chaos plumbing is touched on the hot path).
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
+    #: Flight recorder / debug endpoints (tracing on by default).
+    observability: ObservabilityConfig = field(
+        default_factory=ObservabilityConfig)
     #: Number of concurrent search workers draining batches (the reference's
     #: GenServer pool size analog — SURVEY.md §2 C7).
     workers: int = 2
@@ -351,6 +385,7 @@ class Config:
             ("batcher", BatcherConfig),
             ("auth", AuthConfig),
             ("chaos", ChaosConfig),
+            ("observability", ObservabilityConfig),
         ):
             if name in d:
                 sub = dict(d[name])

@@ -112,6 +112,11 @@ def empty_columnar_outcome() -> ColumnarOutcome:
 class Engine(abc.ABC):
     """Pluggable matching engine for a single queue."""
 
+    #: Lifecycle event log (utils/trace.EventLog) — attached by the queue
+    #: runtime at bind time so engine-internal transitions (delegation,
+    #: re-promotion) land on the /debug/events timeline. None = unobserved.
+    events = None
+
     def __init__(self, cfg: Config, queue: QueueConfig):
         self.cfg = cfg
         self.queue = queue

@@ -87,6 +87,9 @@ class _ReadGroup:
     created: float
     stacked: Any = None
     host: np.ndarray | None = None
+    #: Wall-clock (time.time) at seal — the "readback_seal" stage mark for
+    #: every window whose results ride this group's transfer.
+    sealed_at: float = 0.0
     #: Partial group sealed loose (stale/flush): handles transfer
     #: individually, NO device stack — the jitted stack would compile per
     #: (count, shape) and stale seals run on the service EVENT LOOP, where
@@ -128,6 +131,13 @@ class _Pending:
     raw: list[tuple[np.ndarray, np.ndarray, np.ndarray]] | None = None
     #: collector-thread failure, re-raised on the caller thread at finalize
     error: BaseException | None = None
+    #: Window-level flight-recorder stage marks, wall-clock (time.time),
+    #: appended in time order: dispatch → (h2d, device_step)×chunks →
+    #: readback_seal → collect. Handed to the service via
+    #: ``TpuEngine.window_marks[token]`` at finalize and merged into every
+    #: member request's trace — the per-window half of the per-stage
+    #: histograms (SURVEY.md §5 tracing).
+    marks: list[tuple[str, float]] = field(default_factory=list)
 
 
 class TpuEngine(Engine):
@@ -307,6 +317,15 @@ class TpuEngine(Engine):
         #: no chaos. Covers SEARCH steps + probes only; admit/evict/restore
         #: are exempt so crash recovery itself cannot be failed.
         self.chaos_hook = None
+        #: Finalized windows' stage marks, keyed by token — the service
+        #: pops each token it settles and merges the marks into member
+        #: traces. Bounded: entries nobody consumes (sync search(), rescan
+        #: ticks on old builds) are evicted oldest-first at a fixed cap.
+        self.window_marks: dict[int, list[tuple[str, float]]] = {}
+        #: Lifecycle event log (utils/trace.EventLog), attached by the
+        #: queue runtime like chaos_hook — delegations/re-promotions are
+        #: engine-internal transitions the app can't observe directly.
+        self.events = None
         #: Stage spans (SURVEY.md §5 tracing): cumulative seconds + counts;
         #: read via span_report(). Written only on the caller thread.
         self.spans = {
@@ -338,7 +357,8 @@ class TpuEngine(Engine):
         out = SearchOutcome()
         # flush() returns the full outcome (dispatch-time rejections
         # included), so the search_async return value is dropped.
-        for _, o in self.flush():
+        for tok, o in self.flush():
+            self.window_marks.pop(tok, None)  # sync caller: nobody merges
             _merge_outcomes(out, o)
         if self.device_error is not None:
             err, self.device_error = self.device_error, None
@@ -364,6 +384,11 @@ class TpuEngine(Engine):
             for chunk in pending.chunks:
                 for h in chunk[1]:
                     _copy_async(h)
+            if pending.chunks:
+                # Ungrouped windows seal (queue their D2H) right here;
+                # grouped windows get their seal mark from the group at
+                # finalize time.
+                pending.marks.append(("readback_seal", time.time()))
         self._open += 1
         self._pending.append(pending)
 
@@ -387,6 +412,7 @@ class TpuEngine(Engine):
         groups (sealed during dispatch, off the event loop), bare per-handle
         transfers otherwise (see _ReadGroup.loose)."""
         self._rb_open.pop(key, None)
+        g.sealed_at = time.time()
         handles = g.handles
         assert handles is not None
         if full and len(handles) > 1:
@@ -461,10 +487,15 @@ class TpuEngine(Engine):
         if self._team_delegate is not None:
             self._note_wildcards(requests, now)
             if not self._maybe_repromote_team(now):
+                t_disp = time.time()
                 out = self._team_delegate.search(requests, now)
                 token = self._next_token
                 self._next_token += 1
                 pending = _Pending(token=token, outcome=out)
+                # Delegated-oracle window: the whole step ran inline on the
+                # host — two marks bound it for the flight recorder.
+                pending.marks = [("dispatch", t_disp),
+                                 ("oracle_step", time.time())]
                 pending.raw = []
                 self._submit(pending)
                 return token, SearchOutcome()
@@ -473,6 +504,7 @@ class TpuEngine(Engine):
             return self.search_async(requests, now)  # re-enter via delegate
 
         pending = _Pending(token=self._next_token)
+        pending.marks.append(("dispatch", time.time()))
         self._next_token += 1
         fresh: list[SearchRequest] = []
         seen_ids: set[str] = set()
@@ -507,6 +539,7 @@ class TpuEngine(Engine):
         )
         t_start = time.perf_counter()
         pending = _Pending(token=self._next_token, created=t_start)
+        pending.marks.append(("dispatch", time.time()))
         pending.columnar = empty_columnar_outcome()
         self._next_token += 1
 
@@ -713,11 +746,13 @@ class TpuEngine(Engine):
         _t = time.perf_counter()
         packed_dev = jnp.asarray(packed)
         self.spans["h2d_s"] += time.perf_counter() - _t
+        pending.marks.append(("h2d", time.time()))
         _t = time.perf_counter()
         self._dev_pool, out = self._step_fn(batch)(
             self._dev_pool, packed_dev
         )
         self.spans["jit_s"] += time.perf_counter() - _t
+        pending.marks.append(("device_step", time.time()))
         pending.chunks.append(((cols, slots), (out,), now))
 
     def span_report(self) -> dict[str, float]:
@@ -899,6 +934,9 @@ class TpuEngine(Engine):
         self._delegate_last_wc = now
         self.counters["team_delegated"] = (
             self.counters.get("team_delegated", 0) + 1)
+        if self.events is not None:
+            self.events.append("team_delegated", self.queue.name,
+                               f"{len(waiting)} waiting transferred")
         # Device state is now dead weight; drop the HBM arrays and reset
         # the (no-longer-consulted) mirror.
         self._dev_pool = None
@@ -1001,6 +1039,9 @@ class TpuEngine(Engine):
             self.restore(waiting, now)
         self.counters["team_repromoted"] = (
             self.counters.get("team_repromoted", 0) + 1)
+        if self.events is not None:
+            self.events.append("team_repromoted", self.queue.name,
+                               f"{len(waiting)} waiting transferred")
         logger.info(
             "team queue %r: wildcard pool drained — promoted back to the "
             "device path (%d waiting players transferred)",
@@ -1124,9 +1165,12 @@ class TpuEngine(Engine):
         bucket = self._bucket_for(len(window))
         t0 = self._rel_base(now)
         batch = self.pool.batch_arrays(window, slots, bucket, t0)
+        packed_dev = jnp.asarray(self._pack(batch, now - t0, window))
+        pending.marks.append(("h2d", time.time()))
         self._dev_pool, out = self._step_fn(batch)(
-            self._dev_pool, jnp.asarray(self._pack(batch, now - t0, window))
+            self._dev_pool, packed_dev
         )
+        pending.marks.append(("device_step", time.time()))
         pending.chunks.append((list(window), (out,), now))
 
     def _finalize(self, pending: _Pending) -> None:
@@ -1145,6 +1189,21 @@ class TpuEngine(Engine):
         if pending.created:
             self.spans["windows"] += 1
             self.spans["turnaround_s"] += time.perf_counter() - pending.created
+        if self._rb_k > 1 and pending.chunks:
+            # Grouped readback: the seal (one stacked D2H for k windows)
+            # happened whenever the group filled or went stale — pull the
+            # latest member group's seal time in as this window's mark.
+            seal = max((h.group.sealed_at
+                        for c in pending.chunks for h in c[1]
+                        if isinstance(h, _GroupSlot)), default=0.0)
+            if seal:
+                pending.marks.append(("readback_seal", seal))
+        pending.marks.append(("collect", time.time()))
+        self.window_marks[pending.token] = pending.marks
+        while len(self.window_marks) > 512:
+            # Unconsumed entries (sync callers, crashed windows) must not
+            # accumulate forever; oldest-first eviction, insertion-ordered.
+            self.window_marks.pop(next(iter(self.window_marks)))
         if pending.error is not None:
             self.device_error = pending.error
             self.failed_tokens.add(pending.token)

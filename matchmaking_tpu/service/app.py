@@ -42,6 +42,7 @@ from matchmaking_tpu.service.middleware import (
 )
 from matchmaking_tpu.utils.chaos import ChaosState
 from matchmaking_tpu.utils.metrics import Metrics
+from matchmaking_tpu.utils.trace import EventLog, FlightRecorder, TraceContext
 
 log = logging.getLogger(__name__)
 
@@ -66,7 +67,8 @@ class _QueueRuntime:
             CircuitBreaker(app.cfg.engine)
             if app.cfg.engine.backend == "tpu" else None)
         self._publish_breaker_gauges()
-        self.batcher: Batcher = Batcher(app.cfg.batcher, self._flush)
+        self.batcher: Batcher = Batcher(app.cfg.batcher, self._flush,
+                                        observe_window=self._observe_window)
         # Serializes ALL engine access (window flushes vs the timeout
         # sweeper): engines are single-writer objects with no internal locks.
         self._engine_lock = asyncio.Lock()
@@ -141,6 +143,8 @@ class _QueueRuntime:
             from matchmaking_tpu.engine.cpu import CpuEngine
 
             self.app.metrics.counters.inc("breaker_degraded_revives")
+            self.app.events.append("degraded_revive", self.queue_cfg.name,
+                                   f"breaker {self.breaker.state}")
             log.warning(
                 "queue %r: breaker %s — running DEGRADED on the host oracle",
                 self.queue_cfg.name, self.breaker.state)
@@ -158,6 +162,9 @@ class _QueueRuntime:
         shape (columnar vs object decode) and dispatch discipline
         (pipelined vs synchronous)."""
         self.engine = engine
+        # Lifecycle event timeline: engine-internal transitions (wildcard
+        # delegation, re-promotion) report through the shared log.
+        engine.events = self.app.events
         # Columnar ingress (1v1 queues on a columnar-capable engine): decode
         # is deferred to the batched native codec at flush time. A degraded
         # (host-oracle) engine has no columnar API — deliveries decode per
@@ -196,8 +203,13 @@ class _QueueRuntime:
         every crash path ends in one) demotes the queue to the host oracle;
         half-open probes on the health timer re-promote it later."""
         self.app.metrics.counters.inc("engine_crashes")
+        self.app.events.append("engine_crash", self.queue_cfg.name)
         if self.breaker is not None and self.breaker.record_crash(now):
             self.app.metrics.counters.inc("breaker_trips")
+            self.app.events.append(
+                "breaker_trip", self.queue_cfg.name,
+                f"{self.breaker.threshold} crashes in "
+                f"{self.breaker.window_s:.1f}s")
             self._publish_breaker_gauges()
             log.error(
                 "queue %r: circuit breaker TRIPPED (%d engine crashes "
@@ -220,23 +232,103 @@ class _QueueRuntime:
         m.set_gauge(f"breaker_probe_delay_s[{q}]", snap["probe_delay_s"])
         m.set_gauge(f"breaker_time_degraded_s[{q}]", snap["time_degraded_s"])
 
+    # ---- flight recorder (utils/trace.py) ---------------------------------
+
+    def _observe_window(self, size: int, age_s: float) -> None:
+        """Batcher window-cut hook: batch fill + batcher wait, per queue."""
+        m = self.app.metrics
+        q = self.queue_cfg.name
+        m.observe_stage(q, "batch_window", age_s)
+        m.set_gauge(f"batch_fill[{q}]",
+                    size / max(1, self.app.cfg.batcher.max_batch))
+
+    def _trace(self, delivery: Delivery) -> "TraceContext | None":
+        """The delivery's trace, created lazily for transports that don't
+        stamp at publish (AMQP). None when tracing is off."""
+        tr = delivery.trace
+        if tr is None and self.app.trace_enabled:
+            tr = delivery.trace = TraceContext(
+                self.queue_cfg.name, delivery.properties.correlation_id,
+                redelivered=delivery.redelivered)
+        return tr
+
+    def _settle_trace(self, delivery: Delivery, status: str,
+                      t: float | None = None) -> None:
+        """Final trace mark ("publish") + hand-off to the flight recorder.
+        Called wherever a delivery reaches a terminal settle (response
+        published + acked); nacked deliveries keep their trace open — the
+        redelivery appends to the same mark list."""
+        tr = delivery.trace
+        if tr is None:
+            return
+        tr.status = status
+        tr.mark("publish", t)
+        self.app.recorder.complete(tr)
+
+    def _settle_outcome_traces(self, out: SearchOutcome,
+                               deliveries: list[Delivery],
+                               t: float | None = None) -> None:
+        """Settle every delivery's trace with the status its player reached
+        in this OBJECT outcome (trace.player_id was stamped at ingress/
+        flush, so duplicate deliveries of one player settle too)."""
+        if all(d.trace is None for d in deliveries):
+            return  # tracing off: skip the id-set builds entirely
+        matched = {r.id for m in out.matches for r in m.requests()}
+        rejected = {r.id for r, _ in out.rejected}
+        timed = {r.id for r in out.timed_out}
+        for d in deliveries:
+            tr = d.trace
+            if tr is None:
+                continue
+            pid = tr.player_id
+            status = ("matched" if pid in matched else
+                      "rejected" if pid in rejected else
+                      "timeout" if pid in timed else "queued")
+            self._settle_trace(d, status, t)
+
+    def _merge_window_marks(self, tok: int,
+                            deliveries: list[Delivery]) -> None:
+        """Fold one finalized window's engine-side stage marks (dispatch /
+        h2d / device_step / readback_seal / collect) into every member
+        delivery's trace. Pops the engine's entry either way so the
+        hand-off dict cannot grow unbounded."""
+        wm = getattr(self.engine, "window_marks", None)
+        if wm is None:
+            return
+        marks = wm.pop(tok, None)
+        if not marks:
+            return
+        for d in deliveries:
+            if d.trace is not None:
+                d.trace.extend(marks)
+
     # ---- ingress ----------------------------------------------------------
 
     async def _on_delivery(self, delivery: Delivery) -> None:
         ctx = MessageContext(delivery=delivery, queue=self.queue_cfg.name)
+        tr = self._trace(delivery)
+        if tr is not None:
+            tr.mark("consume", ctx.received_at)
         try:
             await self.pipeline.run(ctx)
         except MiddlewareReject as e:
             self.app.metrics.counters.inc("rejected_by_middleware")
             self._respond_error(delivery, e.code, e.reason)
             self.app.broker.ack(self.consumer_tag, delivery.delivery_tag)
+            if tr is not None:
+                tr.mark("reject")
+                self._settle_trace(delivery, "rejected")
             return
+        if tr is not None:
+            tr.mark("batch")
         if ctx.request is None:
             # Columnar ingress: the pipeline left decoding to the batched
             # native codec (1v1 queues) — middleware only ran auth/validity
             # checks that need headers.
             self.batcher.submit((None, delivery))
             return
+        if tr is not None:
+            tr.player_id = ctx.request.id
         self.batcher.submit((ctx.request, delivery))
 
     # ---- the window flush: THE seam into Engine.search --------------------
@@ -278,6 +370,10 @@ class _QueueRuntime:
         self._prune_recent(now)
         fresh: list[tuple[SearchRequest, Delivery]] = []
         for req, delivery in window:
+            tr = delivery.trace
+            if tr is not None:
+                tr.player_id = req.id
+                tr.mark("flush", now)
             cached = self._recent.get(req.id)
             if cached is not None and cached[1] <= now:
                 del self._recent[req.id]  # expired: a genuine re-queue
@@ -286,6 +382,9 @@ class _QueueRuntime:
                 self.app.metrics.counters.inc("deduped_replays")
                 self._publish_body(req.reply_to, req.correlation_id, cached[0])
                 self.app.broker.ack(self.consumer_tag, delivery.delivery_tag)
+                if tr is not None:
+                    tr.mark("dedup_replay")
+                    self._settle_trace(delivery, "deduped")
             else:
                 fresh.append((req, delivery))
         window = fresh
@@ -308,6 +407,10 @@ class _QueueRuntime:
                 dispatch, [(r.id, d) for r, d in window], now)
             return
 
+        t_disp = time.time()
+        for delivery in deliveries_in:
+            if delivery.trace is not None:
+                delivery.trace.mark("dispatch", t_disp)
         try:
             # Engine.search blocks (host work + device step); keep the event
             # loop responsive for other queues. The lock serializes against
@@ -322,9 +425,14 @@ class _QueueRuntime:
                 self.app.broker.nack(self.consumer_tag, delivery.delivery_tag,
                                      requeue=True)
             return
+        t_col = time.time()
+        for delivery in deliveries_in:
+            if delivery.trace is not None:
+                delivery.trace.mark("collect", t_col)
         self._publish_outcome(outcome, now)
         for delivery in deliveries_in:
             self.app.broker.ack(self.consumer_tag, delivery.delivery_tag)
+        self._settle_outcome_traces(outcome, deliveries_in)
         self.app.metrics.counters.inc("windows")
         self.app.metrics.counters.inc("requests_batched", len(window))
 
@@ -359,6 +467,9 @@ class _QueueRuntime:
             self.app.metrics.counters.inc("rejected_by_middleware")
             self._respond_error(delivery, e.code, e.reason)
             self.app.broker.ack(self.consumer_tag, delivery.delivery_tag)
+            if delivery.trace is not None:
+                delivery.trace.mark("reject")
+                self._settle_trace(delivery, "rejected")
             return None
 
     def _decode_deferred(
@@ -397,6 +508,8 @@ class _QueueRuntime:
 
         lanes: list[tuple[str, float, float, float, str, str, float, Delivery]] = []
         for i, delivery in enumerate(deliveries):
+            if delivery.trace is not None:
+                delivery.trace.mark("flush", now)
             if native is not None and native[6][i] == codec.OK:
                 ids, rating, rd, thr, regions, modes, _status = (
                     native[0], native[1], native[2], native[3], native[4],
@@ -410,6 +523,9 @@ class _QueueRuntime:
                 self._respond_error(delivery, codec.error_code(native[6][i]),
                                     "malformed payload")
                 self.app.broker.ack(self.consumer_tag, delivery.delivery_tag)
+                if delivery.trace is not None:
+                    delivery.trace.mark("reject")
+                    self._settle_trace(delivery, "rejected")
                 continue
             else:
                 # Python fallback (codec unavailable or NEEDS_PYTHON row).
@@ -422,6 +538,9 @@ class _QueueRuntime:
                     self._respond_error(delivery, "party_not_supported",
                                         "engine rejected request: party_not_supported")
                     self.app.broker.ack(self.consumer_tag, delivery.delivery_tag)
+                    if delivery.trace is not None:
+                        delivery.trace.mark("reject")
+                        self._settle_trace(delivery, "rejected")
                     continue
                 row = (req.id, req.rating, req.rating_deviation,
                        (np.nan if req.rating_threshold is None
@@ -429,6 +548,8 @@ class _QueueRuntime:
                        "" if req.region == "*" else req.region,
                        "" if req.game_mode == "*" else req.game_mode,
                        req.enqueued_at, delivery)
+            if delivery.trace is not None:
+                delivery.trace.player_id = row[0]
             # At-least-once dedup: replay terminal responses.
             cached = self._recent.get(row[0])
             if cached is not None and cached[1] <= now:
@@ -440,6 +561,9 @@ class _QueueRuntime:
                                    delivery.properties.correlation_id,
                                    cached[0])
                 self.app.broker.ack(self.consumer_tag, delivery.delivery_tag)
+                if delivery.trace is not None:
+                    delivery.trace.mark("dedup_replay")
+                    self._settle_trace(delivery, "deduped")
                 continue
             lanes.append(row)
 
@@ -496,6 +620,7 @@ class _QueueRuntime:
                 return
             for tok, out in outs:
                 self.engine.failed_tokens.discard(tok)
+                self._merge_window_marks(tok, deliveries_in)
                 self._handle_columnar_out(out, by_id, deliveries_in, now)
             return
 
@@ -538,6 +663,9 @@ class _QueueRuntime:
             self._publish_body(delivery.properties.reply_to,
                                delivery.properties.correlation_id, cached[0])
             self.app.broker.ack(self.consumer_tag, delivery.delivery_tag)
+            if delivery.trace is not None:
+                delivery.trace.mark("dedup_replay")
+                self._settle_trace(delivery, "deduped")
         return stale
 
     async def _dispatch_pipelined(self, dispatch,
@@ -609,8 +737,11 @@ class _QueueRuntime:
     def _finish_token(self, tok: int, out, now: float) -> None:
         meta = self._inflight_meta.pop(tok, None)
         if meta is None:
-            # Not a delivery-backed window: rescan ticks flow through the
-            # shared collector now that they overlap the pipeline.
+            # Not a delivery-backed window (rescan tick / already-settled):
+            # still pop its window marks so the hand-off dict stays small.
+            self._merge_window_marks(tok, [])
+            # Rescan ticks flow through the shared collector now that they
+            # overlap the pipeline.
             if tok in getattr(self.engine, "rescan_tokens", ()):
                 self.engine.rescan_tokens.discard(tok)
                 if tok in self.engine.failed_tokens:
@@ -627,10 +758,13 @@ class _QueueRuntime:
                 self._publish_rescan_outcome(out, now)
             return
         by_id, deliveries = meta
+        self._merge_window_marks(tok, deliveries)
         if tok in self.engine.failed_tokens:
             self.engine.failed_tokens.discard(tok)
             log.error("window %d failed on device; nack + revive scheduled", tok)
             self._record_engine_crash(now)
+            self.app.events.append("window_failed", self.queue_cfg.name,
+                                   f"token {tok}, {len(deliveries)} nacked")
             for d in deliveries:
                 self.app.broker.nack(self.consumer_tag, d.delivery_tag,
                                      requeue=True)
@@ -674,6 +808,18 @@ class _QueueRuntime:
                                     f"engine rejected request: {code}")
         for d in deliveries:
             self.app.broker.ack(self.consumer_tag, d.delivery_tag)
+        if any(d.trace is not None for d in deliveries):
+            matched_ids = set(out.m_id_a.tolist()) | set(out.m_id_b.tolist())
+            rejected_ids = {pid for pid, _ in out.rejected}
+            t_settle = time.time()
+            for d in deliveries:
+                tr = d.trace
+                if tr is None:
+                    continue
+                status = ("matched" if tr.player_id in matched_ids else
+                          "rejected" if tr.player_id in rejected_ids else
+                          "queued")
+                self._settle_trace(d, status, t_settle)
         m.counters.inc("windows")
         m.counters.inc("requests_batched", len(deliveries))
 
@@ -685,6 +831,7 @@ class _QueueRuntime:
         self._publish_outcome(out, now)
         for d in deliveries:
             self.app.broker.ack(self.consumer_tag, d.delivery_tag)
+        self._settle_outcome_traces(out, deliveries)
         self.app.metrics.counters.inc("windows")
         self.app.metrics.counters.inc("requests_batched", len(deliveries))
 
@@ -783,9 +930,13 @@ class _QueueRuntime:
             m = self.app.metrics
             m.counters.inc("players_matched", 2 * n)
             rec = m.latency["match_wait"]
+            q = self.queue_cfg.name
             for enq in (out.m_enq_a, out.m_enq_b):
                 for w in (now - enq[enq != 0.0]).tolist():
                     rec.record(w)
+                    # The same sample feeds the bucketed histogram, so its
+                    # p99-from-buckets is checkable against the recorder.
+                    m.observe_stage(q, "e2e", w)
             ids_a, ids_b = out.m_id_a.tolist(), out.m_id_b.tolist()
             reply_a, reply_b = out.m_reply_a.tolist(), out.m_reply_b.tolist()
             corr_a, corr_b = out.m_corr_a.tolist(), out.m_corr_b.tolist()
@@ -817,6 +968,7 @@ class _QueueRuntime:
         m.counters.inc("players_matched")
         if enqueued_at:
             m.record_latency("match_wait", now - enqueued_at)
+            m.observe_stage(self.queue_cfg.name, "e2e", now - enqueued_at)
         body = encode_response(SearchResponse(
             status="matched", player_id=pid, match=result,
             latency_ms=(now - enqueued_at) * 1e3 if enqueued_at else 0.0))
@@ -862,6 +1014,8 @@ class _QueueRuntime:
             log.exception("old engine close failed")
         self._bind_engine(self._make_engine())
         self.engine.restore(snapshot, now)
+        self.app.events.append("engine_revive", self.queue_cfg.name,
+                               f"{len(snapshot)} players restored from mirror")
 
     # ---- egress -----------------------------------------------------------
 
@@ -1010,6 +1164,9 @@ class _QueueRuntime:
                         "collection deadline; next tick will skip while "
                         "it is outstanding", self.queue_cfg.name, tok)
                     self.app.metrics.counters.inc("rescan_deadline_overruns")
+                    self.app.events.append("rescan_overrun",
+                                           self.queue_cfg.name,
+                                           f"token {tok}")
             except Exception:
                 log.exception("rescan failed; reviving engine from mirror")
                 self._record_engine_crash(now)
@@ -1105,6 +1262,7 @@ class _QueueRuntime:
         assert self.breaker is not None
         self.breaker.begin_probe(now)
         self.app.metrics.counters.inc("breaker_probes")
+        self.app.events.append("breaker_probe", self.queue_cfg.name)
         self._publish_breaker_gauges()
         try:
             candidate = await asyncio.to_thread(self._probe_build)
@@ -1113,6 +1271,8 @@ class _QueueRuntime:
         except Exception as e:
             self.breaker.probe_failed(time.time())
             self.app.metrics.counters.inc("breaker_probe_failures")
+            self.app.events.append("probe_failed", self.queue_cfg.name,
+                                   str(e))
             self._publish_breaker_gauges()
             log.warning(
                 "queue %r: half-open device probe failed (%s); next probe "
@@ -1147,6 +1307,8 @@ class _QueueRuntime:
                 # probe_due() never fires again.
                 self.breaker.probe_failed(time.time())
                 self.app.metrics.counters.inc("breaker_probe_failures")
+                self.app.events.append("probe_failed", self.queue_cfg.name,
+                                       f"pool transfer: {e}")
                 self._publish_breaker_gauges()
                 try:
                     candidate.close()
@@ -1160,6 +1322,8 @@ class _QueueRuntime:
             self._bind_engine(candidate)
             self.breaker.probe_succeeded(time.time())
         self.app.metrics.counters.inc("breaker_closes")
+        self.app.events.append("breaker_closed", self.queue_cfg.name,
+                               f"{transferred} waiting players transferred")
         self._publish_breaker_gauges()
         log.info(
             "queue %r: half-open probe succeeded — breaker CLOSED, device "
@@ -1227,14 +1391,36 @@ class MatchmakingApp:
 
     def __init__(self, cfg: Config | None = None, broker: InProcBroker | None = None):
         self.cfg = cfg or Config()
+        obs = self.cfg.observability
+        #: Lifecycle event timeline (/debug/events): breaker trips, probes,
+        #: delegations, revives, chaos faults — one bounded ring, appended
+        #: to by the app, the broker, the engines, and the chaos hooks.
+        self.events = EventLog(obs.event_ring)
+        #: Trace stamping master switch (flight recorder).
+        self.trace_enabled = obs.trace
+        self.metrics = Metrics(stage_buckets=obs.stage_buckets or None)
+        #: Request-lifecycle flight recorder (/debug/traces): per-queue
+        #: rings of settled traces + slow exemplars; feeds the per-stage
+        #: histograms on every completion.
+        self.recorder = FlightRecorder(
+            self.metrics, ring=obs.trace_ring, slow_ring=obs.slow_trace_ring,
+            slow_threshold_s=obs.slow_trace_ms / 1e3)
         #: Deterministic chaos runtime (None when no schedule configured):
         #: one shared state so broker faults and per-queue engine fault
         #: hooks replay from a single script (utils/chaos.py).
         self.chaos: ChaosState | None = (
             ChaosState(self.cfg.chaos) if self.cfg.chaos.enabled() else None)
+        if self.chaos is not None:
+            # Before any engine hook exists: hooks copy the ref at creation.
+            self.chaos.events = self.events
         self.broker = broker or InProcBroker(self.cfg.broker, self.cfg.seed,
                                              chaos=self.chaos)
-        self.metrics = Metrics()
+        # Wire the broker into the shared observability plane (the in-proc
+        # broker has both attrs; foreign transports may have neither).
+        if hasattr(self.broker, "events"):
+            self.broker.events = self.events
+        if hasattr(self.broker, "trace_enabled"):
+            self.broker.trace_enabled = self.trace_enabled
         self._runtimes: dict[str, _QueueRuntime] = {}
         self._started = False
         self._observability = None

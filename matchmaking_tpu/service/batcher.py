@@ -13,6 +13,7 @@ them; SURVEY.md §7 "Hard parts").
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import Awaitable, Callable, Generic, TypeVar
 
 from matchmaking_tpu.config import BatcherConfig
@@ -22,10 +23,23 @@ T = TypeVar("T")
 
 class Batcher(Generic[T]):
     def __init__(self, cfg: BatcherConfig,
-                 flush: Callable[[list[T]], Awaitable[None]]):
+                 flush: Callable[[list[T]], Awaitable[None]],
+                 observe_window: Callable[[int, float], None] | None = None):
         self.cfg = cfg
         self._flush = flush
+        #: Observability hook, called once per cut window with
+        #: ``(window_size, open_age_seconds)`` — batch fill and batcher
+        #: wait are BASELINE headline metrics (utils/metrics docstring) and
+        #: the first suspect in any p99 investigation, so the batcher
+        #: reports them itself instead of making callers reverse-engineer
+        #: the window boundaries from item timestamps.
+        self._observe = observe_window
         self._pending: list[T] = []
+        #: Per-item submit times, parallel to _pending — the cut reports
+        #: the OLDEST remaining item's true wait, so carried-over backlog
+        #: (items sliced into a later window under saturation) is not
+        #: under-reported exactly when queueing is the p99 cause.
+        self._submitted: list[float] = []
         self._first = asyncio.Event()   # first item of a window arrived
         self._full = asyncio.Event()    # size trigger
         self._closed = False
@@ -35,9 +49,21 @@ class Batcher(Generic[T]):
         if self._closed:
             raise RuntimeError("batcher closed")
         self._pending.append(item)
+        if self._observe is not None:
+            self._submitted.append(time.monotonic())
         self._first.set()
         if len(self._pending) >= self.cfg.max_batch:
             self._full.set()
+
+    def _cut(self) -> list[T]:
+        """Slice the next window off the pending list and report it."""
+        window = self._pending[: self.cfg.max_batch]
+        self._pending = self._pending[self.cfg.max_batch:]
+        if self._observe is not None and window:
+            age = time.monotonic() - self._submitted[0]
+            self._submitted = self._submitted[len(window):]
+            self._observe(len(window), max(0.0, age))
+        return window
 
     async def _run(self) -> None:
         max_wait = self.cfg.max_wait_ms / 1000.0
@@ -61,8 +87,7 @@ class Batcher(Generic[T]):
                     pass
             if not self._pending:
                 continue
-            window = self._pending[: self.cfg.max_batch]
-            self._pending = self._pending[self.cfg.max_batch:]
+            window = self._cut()
             try:
                 await self._flush(window)
             except Exception:
@@ -95,6 +120,5 @@ class Batcher(Generic[T]):
                 await self._task
             except asyncio.CancelledError:
                 pass
-        if self._pending:
-            window, self._pending = self._pending, []
-            await self._flush(window)
+        while self._pending:
+            await self._flush(self._cut())

@@ -30,6 +30,7 @@ from dataclasses import dataclass, field
 from typing import Any, Awaitable, Callable
 
 from matchmaking_tpu.config import BrokerConfig
+from matchmaking_tpu.utils.trace import TraceContext
 
 
 @dataclass(frozen=True, slots=True)
@@ -53,6 +54,11 @@ class Delivery:
     #: are pure functions of it, so runs replay deterministically). -1 when
     #: no chaos schedule covers the queue — nothing is counted.
     seq: int = -1
+    #: Request-lifecycle trace context (utils/trace.TraceContext), stamped
+    #: at publish by the in-proc broker; None from other transports until
+    #: the app's ingress lazily creates one. Requeues reuse the SAME
+    #: Delivery object, so stage marks survive redelivery by construction.
+    trace: Any = None
 
 
 class _Queue:
@@ -209,6 +215,15 @@ class _Consumer:
                 # Fault injection: consumer "crashed" before processing —
                 # the delivery is requeued as AMQP would on channel close.
                 broker.stats["dropped"] += 1
+                if delivery.trace is not None:
+                    # The drop is part of the request's biography: the trace
+                    # shows the crash point and the redelivery gap behind it.
+                    delivery.trace.mark("chaos_drop")
+                if broker.events is not None:
+                    broker.events.append(
+                        "chaos_drop", delivery.queue,
+                        f"seq {delivery.seq} attempt "
+                        f"{delivery.redelivery_count}")
                 self._release()
                 broker._requeue(self.queue, delivery)
                 return
@@ -275,6 +290,14 @@ class InProcBroker:
             self.cfg.dup_prob > 0
             or (chaos is not None and chaos.publish_faults())
         )
+        #: Lifecycle event log (utils/trace.EventLog), attached by the app —
+        #: chaos drops/dups, partitions and dead-letters land here so
+        #: /debug/events shows broker faults on the same timeline as
+        #: breaker trips and engine revives. None = not recorded.
+        self.events: Any = None
+        #: Trace-context stamping at publish (the flight recorder's
+        #: "enqueue" mark). The app may disable it via config.
+        self.trace_enabled = True
         self._queues: dict[str, _Queue] = {}
         self._tags = itertools.count(1)
         self._consumers: dict[str, _Consumer] = {}
@@ -327,9 +350,19 @@ class InProcBroker:
         if chaos is not None and chaos.applies(queue):
             seq = self._pub_seq.get(queue, 0)
             self._pub_seq[queue] = seq + 1
+        props = properties or Properties()
+        # Stamp a trace only on publishes that expect a response (reply_to
+        # set — i.e. requests): response publishes to reply queues are
+        # consumed by clients, never settled by a runtime, and at north-star
+        # match rates they would allocate as many dead contexts as live
+        # ones. Requests published without reply_to still get a trace
+        # lazily at ingress (the enqueue stage then reads 0).
+        stamp = self.trace_enabled and bool(props.reply_to)
         delivery = Delivery(
-            body=bytes(body), properties=properties or Properties(),
+            body=bytes(body), properties=props,
             queue=queue, delivery_tag=next(self._tags), seq=seq,
+            trace=(TraceContext(queue, props.correlation_id)
+                   if stamp else None),
         )
         self.stats["published"] += 1
         q.messages.put_nowait(delivery)
@@ -340,21 +373,33 @@ class InProcBroker:
             self.stats["duplicated"] += 1
             dup = Delivery(body=bytes(body), properties=delivery.properties,
                            queue=queue, delivery_tag=next(self._tags),
-                           redelivered=True)
+                           redelivered=True,
+                           trace=(TraceContext(queue, props.correlation_id,
+                                               redelivered=True)
+                                  if stamp else None))
             q.messages.put_nowait(dup)
         if chaos is None or seq < 0:
             return
         # Chaos storms: extra copies get their OWN publish seqs (they are
         # distinct deliveries for drop accounting) but are never themselves
-        # re-evaluated for duplication — a storm cannot cascade.
-        for _ in range(chaos.dup_copies(queue, seq)):
+        # re-evaluated for duplication — a storm cannot cascade. Each copy
+        # also gets its own trace context (stamped at this same publish), so
+        # a duplicated redelivery's lifecycle is separately attributable.
+        n_copies = chaos.dup_copies(queue, seq)
+        if n_copies and self.events is not None:
+            self.events.append("chaos_dup", queue,
+                               f"seq {seq} +{n_copies} copies")
+        for _ in range(n_copies):
             cseq = self._pub_seq[queue]
             self._pub_seq[queue] = cseq + 1
             self.stats["duplicated"] += 1
             q.messages.put_nowait(Delivery(
                 body=bytes(body), properties=delivery.properties,
                 queue=queue, delivery_tag=next(self._tags),
-                redelivered=True, seq=cseq))
+                redelivered=True, seq=cseq,
+                trace=(TraceContext(queue, props.correlation_id,
+                                    redelivered=True)
+                       if stamp else None)))
         action = chaos.partition_action(queue, seq)
         if action == "pause":
             self._pause(q)
@@ -440,6 +485,8 @@ class InProcBroker:
             return
         q.gate.clear()
         self.stats["partitions"] += 1
+        if self.events is not None:
+            self.events.append("partition_pause", q.name)
         max_s = self.chaos.cfg.partition_max_s if self.chaos else 0.0
         if max_s > 0:
             try:
@@ -454,10 +501,15 @@ class InProcBroker:
             q.gate_timer = None
         if not q.gate.is_set():
             q.gate.set()
+            if self.events is not None:
+                self.events.append("partition_resume", q.name)
 
     def _requeue(self, queue: _Queue, delivery: Delivery) -> None:
         if delivery.redelivery_count >= self.cfg.max_redelivery:
             self.stats["dead_lettered"] += 1
+            if self.events is not None:
+                self.events.append("dead_letter", queue.name,
+                                   f"tag {delivery.delivery_tag}")
             return
         delivery.redelivered = True
         delivery.redelivery_count += 1

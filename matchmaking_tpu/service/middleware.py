@@ -57,6 +57,11 @@ class Pipeline:
             await self._middlewares[i].call(ctx, lambda: invoke(i + 1))
 
         await invoke(0)
+        # Flight-recorder stage mark: the delivery cleared the whole chain
+        # (auth RPC round trips included). A reject raises past this — the
+        # app stamps the reject path itself.
+        if ctx.delivery.trace is not None:
+            ctx.delivery.trace.mark("middleware")
 
 
 class DecodeMiddleware(Middleware):

@@ -59,10 +59,16 @@ class EngineChaosHook:
     docstring). Attached to engines by the queue runtime; ``None`` hook on
     an engine means no chaos."""
 
-    __slots__ = ("cfg", "steps", "probes", "_fail", "_ranges")
+    __slots__ = ("cfg", "queue", "events", "steps", "probes", "_fail",
+                 "_ranges")
 
-    def __init__(self, cfg: ChaosConfig):
+    def __init__(self, cfg: ChaosConfig, queue: str = "", events=None):
         self.cfg = cfg
+        self.queue = queue
+        #: Lifecycle event log (utils/trace.EventLog) or None: every
+        #: injected fault lands on the /debug/events timeline next to the
+        #: breaker trips it causes — a chaos soak reads as a narrative.
+        self.events = events
         self.steps = 0
         self.probes = 0
         self._fail = frozenset(cfg.fail_steps)
@@ -75,6 +81,9 @@ class EngineChaosHook:
         idx = self.steps
         self.steps += 1
         if idx in self._fail or any(a <= idx < b for a, b in self._ranges):
+            if self.events is not None:
+                self.events.append("chaos_step_fault", self.queue,
+                                   f"step {idx}")
             raise ChaosInjectedError(
                 f"chaos: scripted device-step failure at step index {idx}")
 
@@ -85,6 +94,9 @@ class EngineChaosHook:
         idx = self.probes
         self.probes += 1
         if idx < self.cfg.fail_probes:
+            if self.events is not None:
+                self.events.append("chaos_probe_fault", self.queue,
+                                   f"probe {idx}")
             raise ChaosInjectedError(
                 f"chaos: scripted probe failure (probe index {idx})")
 
@@ -97,6 +109,9 @@ class ChaosState:
 
     def __init__(self, cfg: ChaosConfig):
         self.cfg = cfg
+        #: Lifecycle event log (set by the app); propagated to every engine
+        #: hook created AFTER assignment — assign before runtimes boot.
+        self.events = None
         self._queues = frozenset(cfg.queues)
         self._drop_seqs = frozenset(cfg.drop_seqs)
         self._dup_seqs = {int(s): int(n) for s, n in cfg.dup_seqs}
@@ -151,6 +166,6 @@ class ChaosState:
     def engine_hook(self, queue: str) -> EngineChaosHook:
         hook = self._hooks.get(queue)
         if hook is None:
-            hook = EngineChaosHook(self.cfg)
+            hook = EngineChaosHook(self.cfg, queue, self.events)
             self._hooks[queue] = hook
         return hook

@@ -1,0 +1,186 @@
+"""Request-lifecycle flight recorder (SURVEY.md §5 per-stage tracing).
+
+The BASELINE north star asserts a p99; this module is what *explains* one.
+Three pieces, all bounded-memory and stdlib-only:
+
+- ``TraceContext`` — a lightweight per-delivery trace: an id plus an
+  append-only list of ``(stage, wall_clock)`` marks. Stamped at broker
+  publish (the "enqueue" mark) and carried on the ``Delivery`` through
+  middleware → dedup → batcher → engine window dispatch → settle/publish.
+  Marks survive redelivery (the same Delivery object is requeued), and a
+  chaos duplicate gets its OWN context stamped at the same publish — a
+  trace is the biography of one delivery attempt stream, monotone by
+  construction (append order is time order).
+- ``FlightRecorder`` — per-queue bounded ring of completed traces plus a
+  separate ring of *slow exemplars*: any trace whose enqueue→publish span
+  exceeds the configured threshold keeps its full stage breakdown. On
+  completion, every adjacent mark pair feeds the shared per-stage latency
+  histograms (utils/metrics.py) — the true-histogram replacement for the
+  averages-only ``span_report``.
+- ``EventLog`` — one bounded ring of lifecycle events (breaker trips,
+  probes, delegations, re-promotions, revives, chaos faults, partitions,
+  dead-letters) that were previously only visible as scattered counters.
+  The ``/debug/events`` surface.
+
+Stage vocabulary (each stage's duration = its mark minus the previous
+mark): enqueue → consume → middleware → batch → flush → dispatch → h2d →
+device_step → readback_seal → collect → publish, with off-nominal marks
+interleaved where they happen (chaos_drop, dedup_replay, oracle_step,
+reject). Window-level marks (dispatch..collect) are recorded once per
+engine window and merged into every member trace at settle time, so
+histogram counts for those stages are per-request attributions.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from collections import deque
+from typing import Any, Iterable
+
+#: Marks recorded once per engine window and merged into member traces.
+WINDOW_STAGES = ("dispatch", "h2d", "device_step", "readback_seal",
+                 "collect", "oracle_step")
+
+_trace_seq = itertools.count(1)
+
+
+class TraceContext:
+    """One delivery's lifecycle marks. Cheap by design (``__slots__``, one
+    list) — it is allocated on EVERY broker publish."""
+
+    __slots__ = ("trace_id", "queue", "correlation_id", "player_id",
+                 "redelivered", "status", "marks")
+
+    def __init__(self, queue: str, correlation_id: str = "",
+                 redelivered: bool = False, t: float | None = None):
+        self.trace_id = f"{queue}#{next(_trace_seq)}"
+        self.queue = queue
+        self.correlation_id = correlation_id
+        self.player_id = ""
+        self.redelivered = redelivered
+        self.status = ""  # set at settle: matched/queued/rejected/...
+        self.marks: list[tuple[str, float]] = [
+            ("enqueue", time.time() if t is None else t)]
+
+    def mark(self, stage: str, t: float | None = None) -> None:
+        self.marks.append((stage, time.time() if t is None else t))
+
+    def extend(self, marks: Iterable[tuple[str, float]]) -> None:
+        self.marks.extend(marks)
+
+    @property
+    def total_s(self) -> float:
+        return self.marks[-1][1] - self.marks[0][1]
+
+    def to_dict(self) -> dict[str, Any]:
+        t0 = self.marks[0][1]
+        return {
+            "trace_id": self.trace_id,
+            "queue": self.queue,
+            "player_id": self.player_id,
+            "correlation_id": self.correlation_id,
+            "redelivered": self.redelivered,
+            "status": self.status,
+            "enqueue_t": t0,
+            "total_ms": round(self.total_s * 1e3, 3),
+            #: absolute wall-clock marks (monotone non-decreasing)
+            "marks": [(name, t) for name, t in self.marks],
+            #: per-stage breakdown: duration attributed to the LATER mark
+            "stages_ms": {
+                f"{i}:{name}": round((t - self.marks[i - 1][1]) * 1e3, 3)
+                for i, (name, t) in enumerate(self.marks) if i
+            },
+        }
+
+
+class EventLog:
+    """Bounded ring of lifecycle events — the single place trips, probes,
+    delegations, re-promotions, revives and chaos faults become a readable
+    timeline instead of counter deltas. Appended from the event loop AND
+    engine worker threads (delegation events fire inside to_thread), so
+    the seq source must be atomic — itertools.count is."""
+
+    def __init__(self, maxlen: int = 512):
+        self._events: deque[tuple[int, float, str, str, str]] = deque(
+            maxlen=max(1, maxlen))
+        self._seq = itertools.count(1)
+
+    def append(self, kind: str, queue: str = "", detail: str = "") -> None:
+        self._events.append(
+            (next(self._seq), time.time(), kind, queue, detail))
+
+    def __len__(self) -> int:
+        return len(self._events)
+
+    def snapshot(self, queue: str | None = None,
+                 limit: int = 0) -> list[dict[str, Any]]:
+        # tuple() first: worker threads append concurrently, and iterating
+        # a live deque across their mutations raises RuntimeError.
+        rows = [
+            {"seq": s, "t": t, "kind": k, "queue": q, "detail": d}
+            for s, t, k, q, d in tuple(self._events)
+            if queue is None or q == queue
+        ]
+        return rows[-limit:] if limit else rows
+
+
+class FlightRecorder:
+    """Per-queue rings of completed traces + slow exemplars; feeds the
+    per-stage histograms on every completion."""
+
+    def __init__(self, metrics, ring: int = 256, slow_ring: int = 64,
+                 slow_threshold_s: float = 0.25):
+        self._metrics = metrics
+        self._ring = max(1, ring)
+        self._slow_ring = max(1, slow_ring)
+        self.slow_threshold_s = slow_threshold_s
+        self._recent: dict[str, deque[TraceContext]] = {}
+        self._slow: dict[str, deque[TraceContext]] = {}
+
+    def complete(self, trace: TraceContext) -> None:
+        """Settle one trace: derive per-stage durations from adjacent mark
+        pairs into the shared histograms, record it in the recent ring, and
+        keep it as a slow exemplar when the enqueue→publish span exceeds
+        the threshold."""
+        q = trace.queue
+        marks = trace.marks
+        if self._metrics is not None:
+            observe = self._metrics.observe_stage
+            prev_t = marks[0][1]
+            for name, t in marks[1:]:
+                observe(q, name, max(0.0, t - prev_t))
+                prev_t = t
+            observe(q, "total", max(0.0, marks[-1][1] - marks[0][1]))
+        ring = self._recent.get(q)
+        if ring is None:
+            ring = self._recent[q] = deque(maxlen=self._ring)
+        ring.append(trace)
+        if trace.total_s >= self.slow_threshold_s:
+            slow = self._slow.get(q)
+            if slow is None:
+                slow = self._slow[q] = deque(maxlen=self._slow_ring)
+            slow.append(trace)
+
+    def get(self, trace_id: str) -> TraceContext | None:
+        for rings in (self._slow, self._recent):
+            for ring in rings.values():
+                for tr in ring:
+                    if tr.trace_id == trace_id:
+                        return tr
+        return None
+
+    def snapshot(self, queue: str | None = None,
+                 limit: int = 32) -> dict[str, Any]:
+        queues = ([queue] if queue is not None
+                  else sorted(set(self._recent) | set(self._slow)))
+        out: dict[str, Any] = {}
+        for q in queues:
+            recent = list(self._recent.get(q, ()))[-limit:]
+            slow = list(self._slow.get(q, ()))[-limit:]
+            out[q] = {
+                "recent": [t.to_dict() for t in recent],
+                "slow": [t.to_dict() for t in slow],
+            }
+        return {"slow_threshold_ms": self.slow_threshold_s * 1e3,
+                "queues": out}

@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Fetch + pretty-print flight-recorder traces from a running service.
+
+The /debug/traces endpoint (service/observability.py) returns raw JSON; this
+helper renders a trace as a readable stage waterfall — the "why was THIS
+request slow" workflow:
+
+    # list the slow exemplars for one queue
+    python scripts/trace_dump.py --queue matchmaking.search --slow
+
+    # dump one trace by id (ids appear in the listing)
+    python scripts/trace_dump.py --id 'matchmaking.search#1234'
+
+    # recent lifecycle events (breaker trips, probes, chaos faults)
+    python scripts/trace_dump.py --events
+
+Pure stdlib (urllib) — usable inside the service container.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.parse
+import urllib.request
+
+
+def _get(base: str, path: str, params: dict) -> dict:
+    qs = urllib.parse.urlencode({k: v for k, v in params.items() if v})
+    url = f"{base}{path}" + (f"?{qs}" if qs else "")
+    try:
+        with urllib.request.urlopen(url, timeout=10.0) as resp:
+            return json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        try:
+            detail = json.loads(e.read()).get("error", "")
+        except Exception:
+            detail = ""
+        sys.exit(f"HTTP {e.code} from {url}: {detail}")
+    except OSError as e:
+        sys.exit(f"cannot reach {url}: {e} (is the service running with "
+                 "metrics_port set?)")
+
+
+def render_trace(tr: dict, out=sys.stdout) -> None:
+    """One trace as a stage waterfall: absolute offset + per-stage delta."""
+    marks = tr.get("marks", [])
+    head = (f"{tr.get('trace_id', '?')}  queue={tr.get('queue', '?')} "
+            f"player={tr.get('player_id') or '-'} "
+            f"status={tr.get('status') or '-'} "
+            f"total={tr.get('total_ms', 0):.3f}ms"
+            + ("  [redelivered]" if tr.get("redelivered") else ""))
+    print(head, file=out)
+    if not marks:
+        return
+    t0 = marks[0][1]
+    prev = t0
+    for name, t in marks:
+        off = (t - t0) * 1e3
+        delta = (t - prev) * 1e3
+        bar = "#" * min(40, max(0, int(delta)))
+        print(f"  {off:10.3f}ms  +{delta:9.3f}ms  {name:<14} {bar}",
+              file=out)
+        prev = t
+    print("", file=out)
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=9100)
+    ap.add_argument("--queue", default="", help="filter by queue name")
+    ap.add_argument("--id", default="", help="dump one trace by id")
+    ap.add_argument("--slow", action="store_true",
+                    help="show slow exemplars only (default: recent)")
+    ap.add_argument("--n", type=int, default=16, help="traces per ring")
+    ap.add_argument("--events", action="store_true",
+                    help="show the lifecycle event log instead of traces")
+    ap.add_argument("--json", action="store_true",
+                    help="raw JSON instead of the waterfall rendering")
+    args = ap.parse_args(argv)
+    base = f"http://{args.host}:{args.port}"
+
+    if args.events:
+        body = _get(base, "/debug/events",
+                    {"queue": args.queue, "n": args.n})
+        if args.json:
+            print(json.dumps(body, indent=2))
+            return
+        for ev in body.get("events", []):
+            print(f"{ev['t']:.3f}  [{ev['kind']}] {ev['queue']}"
+                  + (f" — {ev['detail']}" if ev.get("detail") else ""))
+        return
+
+    if args.id:
+        tr = _get(base, "/debug/traces", {"id": args.id})
+        if args.json:
+            print(json.dumps(tr, indent=2))
+        else:
+            render_trace(tr)
+        return
+
+    body = _get(base, "/debug/traces", {"queue": args.queue, "n": args.n})
+    if args.json:
+        print(json.dumps(body, indent=2))
+        return
+    ring = "slow" if args.slow else "recent"
+    print(f"slow threshold: {body.get('slow_threshold_ms', 0):.1f} ms")
+    for queue, rings in sorted(body.get("queues", {}).items()):
+        traces = rings.get(ring, [])
+        print(f"== {queue}: {len(traces)} {ring} trace(s)")
+        for tr in traces:
+            render_trace(tr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,456 @@
+"""The observability PR's acceptance surface (ISSUE 3):
+
+- Prometheus exposition validity: one ``# TYPE`` per family, escaped label
+  values, per-stage histogram families — validated by a small prom-text
+  parser, over real HTTP.
+- /healthz over HTTP: ``ok`` on a healthy boot, ``degraded`` under a
+  tripped circuit breaker.
+- Flight-recorder traces: slow exemplars with monotone non-decreasing stage
+  timestamps covering enqueue → publish for (a) a normal device-path match,
+  (b) a breaker-demoted oracle match, (c) a chaos-duplicated redelivery.
+- Per-stage histogram fidelity: p99-from-buckets agrees with the
+  LatencyRecorder p99 within one bucket width on a seeded soak.
+"""
+
+import asyncio
+import json
+import re
+import time
+
+import pytest
+
+from matchmaking_tpu.config import (
+    BatcherConfig,
+    ChaosConfig,
+    Config,
+    EngineConfig,
+    ObservabilityConfig,
+    QueueConfig,
+)
+from matchmaking_tpu.service.app import MatchmakingApp
+from matchmaking_tpu.service.broker import Properties
+from matchmaking_tpu.service.observability import _flatten_prom, build_report
+
+# ---------------------------------------------------------------------------
+# A small Prometheus exposition-text parser (satellite: validate
+# /metrics?format=prom instead of substring-matching it).
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_prom(text: str):
+    """Parse + validate exposition text. Returns (types, samples) where
+    samples is a list of (metric_name, sorted-label-tuple, value). Raises
+    AssertionError on spec violations: duplicate/missing/late TYPE lines,
+    malformed samples, duplicate series."""
+    types: dict[str, str] = {}
+    samples = []
+    families_with_samples: set[str] = set()
+    assert text.endswith("\n"), "exposition text must end with a newline"
+    for line in text.rstrip("\n").split("\n"):
+        if line.startswith("# TYPE "):
+            parts = line.split(" ")
+            assert len(parts) == 4, f"malformed TYPE line: {line!r}"
+            _, _, name, mtype = parts
+            assert name not in types, f"duplicate TYPE for family {name}"
+            assert name not in families_with_samples, (
+                f"TYPE for {name} appears after its samples")
+            assert mtype in ("counter", "gauge", "histogram", "summary",
+                             "untyped")
+            types[name] = mtype
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        name, label_blob, value = m.group(1), m.group(2) or "", m.group(3)
+        labels = _LABEL_RE.findall(label_blob)
+        # the label blob must be exactly a comma-joined list of pairs
+        rebuilt = ",".join(f'{k}="{v}"' for k, v in labels)
+        assert rebuilt == label_blob, f"bad label syntax: {line!r}"
+        family = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            base = name[: -len(suffix)] if name.endswith(suffix) else None
+            if base and types.get(base) == "histogram":
+                family = base
+        assert family in types, f"sample {name} has no # TYPE line"
+        families_with_samples.add(family)
+        float(value)  # value must parse (nan/inf included)
+        samples.append((name, tuple(sorted(labels)), value))
+    keys = [(n, l) for n, l, _ in samples]
+    assert len(keys) == len(set(keys)), "duplicate sample series"
+    return types, samples
+
+
+def _assert_monotone_enqueue_to_publish(trace: dict) -> None:
+    marks = trace["marks"]
+    names = [n for n, _ in marks]
+    ts = [t for _, t in marks]
+    assert names[0] == "enqueue" and names[-1] == "publish", names
+    assert all(b >= a for a, b in zip(ts, ts[1:])), (
+        f"non-monotone stage timestamps: {marks}")
+
+
+async def _wait_for(cond, tries: int = 400, dt: float = 0.05):
+    for _ in range(tries):
+        if cond():
+            return
+        await asyncio.sleep(dt)
+    assert cond(), "condition not reached in time"
+
+
+async def _http_json(url: str):
+    import aiohttp
+
+    async with aiohttp.ClientSession() as s:
+        async with s.get(url) as r:
+            return r.status, json.loads(await r.text())
+
+
+# ---------------------------------------------------------------------------
+
+
+async def test_prom_exposition_valid_over_http():
+    """Healthy CPU-backend app with traffic: the prom rendering must be
+    spec-valid (one TYPE per family, families for pool/dedup/latency/stage
+    histograms present), fetched over real HTTP."""
+    import aiohttp
+
+    port = 19261
+    cfg = Config(
+        queues=(QueueConfig(rating_threshold=100.0),),
+        batcher=BatcherConfig(max_batch=16, max_wait_ms=2.0),
+        observability=ObservabilityConfig(slow_trace_ms=0.0),
+        metrics_port=port,
+    )
+    app = MatchmakingApp(cfg)
+    reply = "prom.replies"
+    app.broker.declare_queue(reply)
+    await app.start()
+    try:
+        for i in range(4):
+            app.broker.publish(
+                "matchmaking.search",
+                f'{{"id":"pp{i}","rating":1500}}'.encode(),
+                Properties(reply_to=reply, correlation_id=f"c{i}"))
+        await _wait_for(
+            lambda: app.metrics.counters.get("players_matched") >= 4)
+        # Label-value escaping: a gauge whose queue label carries a quote,
+        # a backslash and a newline must round-trip the parser.
+        app.metrics.set_gauge('escape_check[we"ird\\q\nueue]', 1.0)
+        async with aiohttp.ClientSession() as s:
+            async with s.get(
+                    f"http://127.0.0.1:{port}/metrics?format=prom") as r:
+                assert r.status == 200
+                text = await r.text()
+        types, samples = parse_prom(text)
+        for family in ("matchmaking_pool_size",
+                       "matchmaking_dedup_cache_size",
+                       "matchmaking_players_matched",
+                       "matchmaking_escape_check",
+                       "matchmaking_stage_seconds"):
+            assert family in types, f"missing TYPE for {family}"
+        assert types["matchmaking_stage_seconds"] == "histogram"
+        # The per-stage histogram family appears with queue+stage labels
+        # and a +Inf bucket per series.
+        stage_buckets = [
+            dict(l) for n, l, _ in samples
+            if n == "matchmaking_stage_seconds_bucket"]
+        assert any(b.get("stage") == "e2e"
+                   and b.get("queue") == "matchmaking.search"
+                   and b.get("le") == "+Inf" for b in stage_buckets)
+        # xla compile duration satellite is reported as a counter.
+        assert "matchmaking_xla_compile_seconds" in types
+    finally:
+        await app.stop()
+
+
+async def test_healthz_degraded_traces_and_events_under_breaker():
+    """One chaos crash-storm boot covers three acceptance points: /healthz
+    flips to degraded over HTTP, a breaker-demoted ORACLE match leaves a
+    slow-trace exemplar (monotone enqueue→publish), and the lifecycle
+    event log tells the storm's story."""
+    import aiohttp
+
+    port = 19262
+    q = QueueConfig(name="mm.obs", rating_threshold=100.0,
+                    send_queued_ack=False)
+    cfg = Config(
+        queues=(q,),
+        engine=EngineConfig(backend="tpu", pool_capacity=64, pool_block=32,
+                            batch_buckets=(16,), pipeline_depth=2,
+                            breaker_threshold=2, breaker_window_s=60.0,
+                            breaker_probe_initial_s=30.0,
+                            health_interval_s=0.05),
+        batcher=BatcherConfig(max_batch=16, max_wait_ms=2.0),
+        chaos=ChaosConfig(seed=7, queues=(q.name,),
+                          fail_step_ranges=((0, 2),)),
+        observability=ObservabilityConfig(slow_trace_ms=0.0),
+        debug_invariants=True,
+        metrics_port=port,
+    )
+    app = MatchmakingApp(cfg)
+    reply = "obs.replies"
+    app.broker.declare_queue(q.name)
+    app.broker.declare_queue(reply)
+    for i in range(4):
+        app.broker.publish(q.name, f'{{"id":"d{i}","rating":1500}}'.encode(),
+                           Properties(reply_to=reply, correlation_id=f"c{i}"))
+    await app.start()
+    rt = app.runtime(q.name)
+    try:
+        await _wait_for(
+            lambda: app.metrics.counters.get("players_matched") >= 4)
+        assert type(rt.engine).__name__ == "CpuEngine"  # demoted
+
+        status, health = await _http_json(
+            f"http://127.0.0.1:{port}/healthz")
+        assert status == 200
+        assert health["status"] == "degraded"
+        assert health["degraded_queues"] == [q.name]
+        assert health["queues"][q.name]["engine"] == "CpuEngine"
+
+        # Prom rendering includes breaker/engine-crash families, validly.
+        async with aiohttp.ClientSession() as s:
+            async with s.get(
+                    f"http://127.0.0.1:{port}/metrics?format=prom") as r:
+                types, _ = parse_prom(await r.text())
+        assert "matchmaking_breaker_trips" in types
+        assert "matchmaking_breaker_state" in types
+
+        # (b) breaker-demoted oracle match exemplar: settled on the host
+        # oracle, marks monotone enqueue→publish with the service-side
+        # dispatch/collect bracketing the oracle step.
+        snap = rt.app.recorder.snapshot(queue=q.name)
+        slow = snap["queues"][q.name]["slow"]
+        matched = [t for t in slow if t["status"] == "matched"]
+        assert matched, f"no matched exemplar in {slow}"
+        exemplar = matched[-1]
+        _assert_monotone_enqueue_to_publish(exemplar)
+        names = [n for n, _ in exemplar["marks"]]
+        assert "dispatch" in names and "collect" in names
+        # The storm nacked the first windows: redelivered traces carry the
+        # earlier consume marks too (stage marks survive redelivery).
+        assert names.count("consume") >= 1
+
+        # Event timeline: injected faults → crashes → trip → degraded boot.
+        status, events = await _http_json(
+            f"http://127.0.0.1:{port}/debug/events?queue={q.name}")
+        kinds = [e["kind"] for e in events["events"]]
+        # (dispatch-time chaos faults route through the revive path, not
+        # the collect-time window_failed branch)
+        for expected in ("chaos_step_fault", "engine_crash", "breaker_trip",
+                         "degraded_revive", "engine_revive"):
+            assert expected in kinds, (expected, kinds)
+    finally:
+        await app.stop()
+
+
+async def test_trace_device_path_exemplar_and_profile():
+    """(a) A normal device-path match leaves a slow-trace exemplar whose
+    marks are monotone and cover enqueue → consume → middleware → batch →
+    flush → dispatch → h2d → device_step → readback_seal → collect →
+    publish; /debug/traces serves it over HTTP (listing + by-id), and
+    /debug/profile captures a jax.profiler trace of the live process."""
+    import os
+
+    port = 19263
+    q = QueueConfig(name="mm.dev", rating_threshold=100.0,
+                    send_queued_ack=False)
+    cfg = Config(
+        queues=(q,),
+        engine=EngineConfig(backend="tpu", pool_capacity=64, pool_block=32,
+                            batch_buckets=(16,), pipeline_depth=2),
+        batcher=BatcherConfig(max_batch=16, max_wait_ms=2.0),
+        observability=ObservabilityConfig(slow_trace_ms=0.0),
+        debug_invariants=True,
+        metrics_port=port,
+    )
+    app = MatchmakingApp(cfg)
+    reply = "dev.replies"
+    app.broker.declare_queue(q.name)
+    app.broker.declare_queue(reply)
+    for i in range(2):
+        app.broker.publish(q.name, f'{{"id":"v{i}","rating":1500}}'.encode(),
+                           Properties(reply_to=reply, correlation_id=f"c{i}"))
+    await app.start()
+    try:
+        await _wait_for(
+            lambda: app.metrics.counters.get("players_matched") >= 2)
+        status, body = await _http_json(
+            f"http://127.0.0.1:{port}/debug/traces?queue={q.name}")
+        assert status == 200
+        slow = body["queues"][q.name]["slow"]
+        matched = [t for t in slow if t["status"] == "matched"]
+        assert matched, f"no matched exemplar in {slow}"
+        exemplar = matched[-1]
+        _assert_monotone_enqueue_to_publish(exemplar)
+        names = [n for n, _ in exemplar["marks"]]
+        for stage in ("consume", "middleware", "batch", "flush", "dispatch",
+                      "h2d", "device_step", "readback_seal", "collect"):
+            assert stage in names, (stage, names)
+
+        # by-id lookup round trips
+        status, one = await _http_json(
+            f"http://127.0.0.1:{port}/debug/traces"
+            f"?id={exemplar['trace_id'].replace('#', '%23')}")
+        assert status == 200 and one["trace_id"] == exemplar["trace_id"]
+
+        # jax.profiler capture hook
+        status, prof = await _http_json(
+            f"http://127.0.0.1:{port}/debug/profile?secs=0.1")
+        assert status == 200, prof
+        assert os.path.isdir(prof["trace_dir"])
+        assert any(os.scandir(prof["trace_dir"])), "empty profile capture"
+    finally:
+        await app.stop()
+
+
+async def test_trace_chaos_dup_and_drop_redelivery():
+    """(c) Chaos-duplicated and chaos-dropped deliveries: the duplicate
+    copy gets its own trace (redelivered=True) that still settles with
+    monotone enqueue→publish marks, and a dropped delivery's trace carries
+    the chaos_drop mark followed by the redelivery's consume — stage marks
+    survive redelivery."""
+    q = QueueConfig(name="mm.dup", rating_threshold=100.0,
+                    send_queued_ack=False)
+    cfg = Config(
+        queues=(q,),
+        engine=EngineConfig(backend="cpu"),
+        batcher=BatcherConfig(max_batch=8, max_wait_ms=1.0),
+        # publish seq 0 (player x0): first delivery attempt dropped;
+        # publish seq 1 (player x1): delivered 1 + 2 times.
+        chaos=ChaosConfig(seed=11, queues=(q.name,), drop_seqs=(0,),
+                          dup_seqs=((1, 2),)),
+        observability=ObservabilityConfig(slow_trace_ms=0.0),
+        debug_invariants=True,
+    )
+    app = MatchmakingApp(cfg)
+    reply = "dup.replies"
+    app.broker.declare_queue(reply)
+    await app.start()
+    try:
+        for i in range(2):
+            app.broker.publish(
+                q.name, f'{{"id":"x{i}","rating":1500}}'.encode(),
+                Properties(reply_to=reply, correlation_id=f"c{i}"))
+        await _wait_for(
+            lambda: app.metrics.counters.get("players_matched") >= 2
+            and app.broker.stats["acked"] >= 4)  # 2 originals + 2 dups
+        snap = app.recorder.snapshot(queue=q.name, limit=32)
+        traces = (snap["queues"][q.name]["recent"]
+                  + snap["queues"][q.name]["slow"])
+        assert app.broker.stats["duplicated"] == 2
+        assert app.broker.stats["dropped"] == 1
+
+        dup_traces = [t for t in traces
+                      if t["redelivered"] and t["player_id"] == "x1"]
+        assert dup_traces, f"no settled duplicate trace: {traces}"
+        for t in dup_traces:
+            _assert_monotone_enqueue_to_publish(t)
+
+        dropped = [t for t in traces
+                   if "chaos_drop" in [n for n, _ in t["marks"]]]
+        assert dropped, "dropped delivery's trace not settled"
+        for t in dropped:
+            _assert_monotone_enqueue_to_publish(t)
+            names = [n for n, _ in t["marks"]]
+            # the redelivery appended to the SAME mark list after the drop
+            assert names.index("chaos_drop") < len(names) - 1
+            assert "consume" in names[names.index("chaos_drop"):]
+    finally:
+        await app.stop()
+
+
+async def test_stage_histogram_p99_agrees_with_recorder():
+    """Seeded soak: the e2e stage histogram's p99-from-buckets must agree
+    with LatencyRecorder's exact p99 within one bucket width (factor-2
+    log-spaced buckets → the exact p99 lies in (upper/2, upper])."""
+    import numpy as np
+
+    q = QueueConfig(name="mm.hist", rating_threshold=100.0,
+                    send_queued_ack=False)
+    cfg = Config(
+        queues=(q,),
+        engine=EngineConfig(backend="cpu"),
+        batcher=BatcherConfig(max_batch=1024, max_wait_ms=2.0),
+        observability=ObservabilityConfig(slow_trace_ms=1e9),
+        debug_invariants=True,
+    )
+    app = MatchmakingApp(cfg)
+    reply = "hist.replies"
+    app.broker.declare_queue(reply)
+    await app.start()
+    try:
+        # Seeded wait-time distribution, injected via x-first-received (the
+        # wait clock the service honors): log-uniform from 5 ms to 20 s.
+        rng = np.random.default_rng(42)
+        waits = np.exp(rng.uniform(np.log(5e-3), np.log(20.0), size=400))
+        now = time.time()
+        for i, w in enumerate(waits.tolist()):
+            app.broker.publish(
+                q.name,
+                f'{{"id":"h{i}","rating":{1500 + (i % 2)}}}'.encode(),
+                Properties(reply_to=reply, correlation_id=f"c{i}",
+                           headers={"x-first-received": f"{now - w:.6f}"}))
+        await _wait_for(
+            lambda: app.metrics.counters.get("players_matched") >= 400)
+        rec = app.metrics.latency["match_wait"]
+        hist = app.metrics.stages[q.name]["e2e"]
+        assert hist.count == len(rec._samples) == 400
+        for p in (50, 90, 99):
+            exact = rec.percentile(p)
+            upper = hist.percentile(p)
+            assert exact <= upper, (p, exact, upper)
+            assert exact > upper / 2.0, (
+                f"p{p} off by more than one bucket: exact={exact} "
+                f"bucket-upper={upper}")
+        # The same agreement, reconstructed from the PROM rendering (what a
+        # real Prometheus would scrape and histogram_quantile over).
+        report = build_report(app)
+        text = _flatten_prom(report)
+        types, samples = parse_prom(text)
+        e2e = {dict(l)["le"]: float(v) for n, l, v in samples
+               if n == "matchmaking_stage_seconds_bucket"
+               and dict(l).get("stage") == "e2e"
+               and dict(l).get("queue") == q.name}
+        assert e2e["+Inf"] == 400
+    finally:
+        await app.stop()
+
+
+def test_latency_recorder_percentile_helpers_agree():
+    """Satellite: percentile() and summary_ms() share one helper — pin the
+    agreement (they previously duplicated the nearest-rank math)."""
+    from matchmaking_tpu.utils.metrics import LatencyRecorder
+
+    rec = LatencyRecorder()
+    for i in range(101):
+        rec.record(i / 1000.0)
+    s = rec.summary_ms()
+    assert s["p50_ms"] == pytest.approx(rec.percentile(50) * 1e3)
+    assert s["p99_ms"] == pytest.approx(rec.percentile(99) * 1e3)
+    assert s["count"] == 101
+
+
+def test_compile_counter_tracks_duration():
+    """Satellite: CompileCounter accumulates backend-compile seconds and
+    the report exposes xla_compile_seconds."""
+    from matchmaking_tpu.utils.metrics import CompileCounter, Metrics
+
+    CompileCounter.install()
+    before_n, before_s = CompileCounter.count(), CompileCounter.seconds()
+    import jax
+    import jax.numpy as jnp
+
+    # A fresh jitted shape forces one backend compile.
+    fn = jax.jit(lambda x: x * 2.0 + before_n)
+    fn(jnp.zeros(17)).block_until_ready()
+    assert CompileCounter.count() > before_n
+    assert CompileCounter.seconds() > before_s
+    report = Metrics().report()
+    # report rounds to µs
+    assert report["counters"]["xla_compile_seconds"] == pytest.approx(
+        CompileCounter.seconds(), abs=1e-5)
