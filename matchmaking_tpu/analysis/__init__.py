@@ -1,0 +1,33 @@
+"""matchlint — the project's concurrency-and-compile static analyzer.
+
+Five project-specific rules (see each module's docstring for the full
+contract):
+
+- ``await-under-lock``  (locks.py)       suspension points inside
+  ``async with <lock>`` bodies that aren't the sanctioned off-loop seam.
+- ``guarded-by``        (locks.py)       mutation of ``# guarded-by:``
+  declared attributes outside the declared lock's dominance.
+- ``blocking-call``     (blocking.py)    event-loop stalls visible
+  lexically in ``async def`` bodies (time.sleep, sync I/O, host-sync JAX).
+- ``determinism``       (determinism.py) unseeded RNGs and wall-clock
+  deadlines that break chaos-replay determinism.
+- ``recompile``         (recompile.py)   jaxpr drift across same-shape
+  traces + Python-scalar closure captures in the kernel modules.
+
+Run ``python -m matchmaking_tpu.analysis`` (or ``scripts/matchlint.py``)
+from the repo root; ``pytest -m lint`` runs the same gate as a test node.
+Suppress intentional findings inline with an ignore comment naming the
+rule plus a reason (syntax in core.py), or accept them in
+``analysis/baseline.json``.
+"""
+
+from matchmaking_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    RULES,
+    discover,
+)
+from matchmaking_tpu.analysis.engine import (  # noqa: F401
+    analyze_repo,
+    analyze_source,
+    main,
+)

@@ -1,0 +1,8 @@
+"""``python -m matchmaking_tpu.analysis`` — run matchlint over the repo."""
+
+import sys
+
+from matchmaking_tpu.analysis.engine import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,213 @@
+"""matchlint core: findings, ignore comments, baseline, source discovery.
+
+The analyzer is project-specific by design (SURVEY.md §7 "Hard parts"):
+its rules encode THIS codebase's concurrency contract — the service
+serializes all engine access behind ``_engine_lock``, engines are
+single-writer objects driven through ``asyncio.to_thread``, and chaos
+replay determinism forbids unseeded RNGs. Generic linters can't see any of
+that; PR 2 paid for the gap by rediscovering three statically-detectable
+races with a seeded chaos schedule.
+
+Vocabulary shared by every rule module:
+
+- ``Finding`` — one violation: rule, file, line, message, plus a
+  ``context`` (the enclosing ``Class.method`` qualname) that anchors the
+  baseline fingerprint so line drift doesn't churn the baseline.
+- ``# matchlint: ignore[rule-a,rule-b] <reason>`` — inline suppression on
+  the offending line or the line directly above it. The reason is
+  REQUIRED: a bare ignore is inactive (the finding still reports), so
+  every suppression documents why the pattern is intentional.
+- ``analysis/baseline.json`` — checked-in fingerprints of accepted
+  findings (empty when the gate is clean). ``--write-baseline``
+  regenerates it; entries carry a ``reason`` like inline ignores do.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Iterable
+
+#: Every rule the suite ships (rule modules register against these names).
+RULES = (
+    "await-under-lock",
+    "guarded-by",
+    "blocking-call",
+    "determinism",
+    "recompile",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    message: str
+    #: Enclosing ``Class.method`` (or module-level ``<module>``): the
+    #: baseline anchor — stable across unrelated line churn.
+    context: str = ""
+
+    def fingerprint(self) -> tuple[str, str, str]:
+        return (self.rule, self.path, self.context)
+
+    def render(self) -> str:
+        where = f" (in {self.context})" if self.context else ""
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}{where}"
+
+
+_IGNORE_RE = re.compile(
+    r"#\s*matchlint:\s*ignore\[([a-z\-, ]+)\]\s*(\S.*)?")
+
+
+class IgnoreMap:
+    """Per-file map of line → rules suppressed there. An ignore covers its
+    own line and the line below it (so a comment can sit above a long
+    statement). Ignores without a reason are INACTIVE."""
+
+    def __init__(self, lines: list[str]):
+        self._by_line: dict[int, set[str]] = {}
+        self.bare: list[int] = []  # ignores missing the required reason
+        for i, text in enumerate(lines, start=1):
+            m = _IGNORE_RE.search(text)
+            if not m:
+                continue
+            if not (m.group(2) or "").strip():
+                self.bare.append(i)
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            self._by_line.setdefault(i, set()).update(rules)
+            self._by_line.setdefault(i + 1, set()).update(rules)
+
+    def suppressed(self, line: int, rule: str) -> bool:
+        return rule in self._by_line.get(line, ())
+
+
+class SourceFile:
+    """One parsed source file: text, lines, AST, and its ignore map."""
+
+    def __init__(self, root: str, relpath: str):
+        self.root = root
+        self.path = relpath.replace(os.sep, "/")
+        with open(os.path.join(root, relpath), encoding="utf-8") as f:
+            self.text = f.read()
+        self.lines = self.text.splitlines()
+        self.tree = ast.parse(self.text, filename=relpath)
+        self.ignores = IgnoreMap(self.lines)
+
+    def line_at(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+
+#: Directories (repo-relative) the analyzer walks. Rule modules narrow
+#: further via path predicates (e.g. blocking-call scans the package only).
+DEFAULT_SCAN_DIRS = ("matchmaking_tpu", "scripts", "tests")
+DEFAULT_SCAN_FILES = ("bench.py",)
+_SKIP_PARTS = {"__pycache__", ".git"}
+
+
+def discover(root: str) -> list[SourceFile]:
+    out: list[SourceFile] = []
+    for rel in DEFAULT_SCAN_FILES:
+        if os.path.isfile(os.path.join(root, rel)):
+            out.append(SourceFile(root, rel))
+    for base in DEFAULT_SCAN_DIRS:
+        top = os.path.join(root, base)
+        if not os.path.isdir(top):
+            continue
+        for dirpath, dirnames, filenames in os.walk(top):
+            dirnames[:] = sorted(d for d in dirnames if d not in _SKIP_PARTS)
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    rel = os.path.relpath(os.path.join(dirpath, name), root)
+                    out.append(SourceFile(root, rel))
+    return out
+
+
+def in_package(sf: SourceFile) -> bool:
+    return sf.path.startswith("matchmaking_tpu/") and not sf.path.startswith(
+        "matchmaking_tpu/analysis/")
+
+
+def qualname_of(stack: Iterable[ast.AST]) -> str:
+    """``Class.method`` context from an enclosing-node stack."""
+    parts = [
+        node.name for node in stack
+        if isinstance(node, (ast.ClassDef, ast.FunctionDef,
+                             ast.AsyncFunctionDef))
+    ]
+    return ".".join(parts) if parts else "<module>"
+
+
+def dotted_name(node: ast.AST) -> str:
+    """``a.b.c`` for Name/Attribute chains ('' when not a plain chain)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def apply_ignores(findings: list[Finding],
+                  sources: dict[str, SourceFile]) -> list[Finding]:
+    """Drop findings suppressed by an (active, reasoned) inline ignore."""
+    kept = []
+    for f in findings:
+        sf = sources.get(f.path)
+        if sf is not None and sf.ignores.suppressed(f.line, f.rule):
+            continue
+        kept.append(f)
+    return kept
+
+
+# ---- baseline --------------------------------------------------------------
+
+def load_baseline(path: str) -> list[dict]:
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return list(data.get("findings", []))
+
+
+def write_baseline(path: str, findings: list[Finding]) -> None:
+    entries = [
+        {"rule": f.rule, "path": f.path, "context": f.context,
+         "reason": "TODO: document why this finding is accepted"}
+        for f in sorted(set(findings),
+                        key=lambda f: (f.path, f.rule, f.context))
+    ]
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"findings": entries}, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def split_by_baseline(
+    findings: list[Finding], baseline: list[dict]
+) -> tuple[list[Finding], list[Finding]]:
+    """(new, accepted): a finding is accepted when a baseline entry matches
+    its (rule, path, context) fingerprint."""
+    accepted_keys = {(e.get("rule", ""), e.get("path", ""),
+                      e.get("context", "")) for e in baseline}
+    new, accepted = [], []
+    for f in findings:
+        (accepted if f.fingerprint() in accepted_keys else new).append(f)
+    return new, accepted
+
+
+def repo_root() -> str:
+    """The repo the analyzer should scan: cwd when it holds the package,
+    else the checkout this module was imported from."""
+    cwd = os.getcwd()
+    if os.path.isdir(os.path.join(cwd, "matchmaking_tpu")):
+        return cwd
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))

@@ -1,0 +1,139 @@
+"""``determinism``: seeds and clocks that break replay.
+
+The chaos runtime's whole value (utils/chaos.py) is that a fault soak
+replays bit-identically: every decision is a pure function of message
+identity, never of RNG call order or wall-clock jitter. Two patterns
+silently reintroduce the flake class PR 2 eliminated:
+
+- **Unseeded RNGs** — ``random.Random()``, ``np.random.default_rng()``
+  with no seed, or the module-level global RNGs (``random.random()``,
+  ``np.random.rand(...)``, ``random.seed()``): their draw order depends on
+  event-loop scheduling, so accounting differs between identical runs.
+  Scanned in the package AND in tests/ (a test that draws from an
+  unseeded RNG is flaky by construction).
+- **Wall-clock deadlines** — ``deadline = time.time() + N`` or
+  ``while time.time() < deadline``: wall clocks step (NTP) and make
+  timeout behavior irreproducible; ``time.monotonic()`` is the tool
+  (app.py's rescan deadline already uses it). ``time.time()`` for
+  TIMESTAMPS (trace marks, enqueue times, TTLs) is correct and not
+  flagged — only deadline arithmetic is.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from matchmaking_tpu.analysis.core import (
+    Finding,
+    SourceFile,
+    dotted_name,
+    qualname_of,
+)
+
+RULE = "determinism"
+
+#: Module-global RNG draws (call order = schedule order = flaky).
+_GLOBAL_RNG_CALLS = {
+    "random.random", "random.randint", "random.randrange", "random.choice",
+    "random.choices", "random.shuffle", "random.uniform", "random.sample",
+    "random.seed", "random.gauss",
+    "np.random.rand", "np.random.randn", "np.random.randint",
+    "np.random.random", "np.random.uniform", "np.random.choice",
+    "np.random.shuffle", "np.random.seed", "np.random.normal",
+    "numpy.random.rand", "numpy.random.randn", "numpy.random.randint",
+    "numpy.random.random", "numpy.random.uniform", "numpy.random.choice",
+    "numpy.random.shuffle", "numpy.random.seed", "numpy.random.normal",
+}
+#: Constructors that REQUIRE an explicit seed argument.
+_SEED_REQUIRED = {"random.Random", "np.random.default_rng",
+                  "numpy.random.default_rng", "random.SystemRandom"}
+
+
+def _in_scope(sf: SourceFile) -> bool:
+    return (sf.path.startswith(("matchmaking_tpu/", "tests/", "scripts/"))
+            or sf.path == "bench.py") and not sf.path.startswith(
+                "matchmaking_tpu/analysis/")
+
+
+def _contains_time_time(node: ast.AST) -> ast.Call | None:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and dotted_name(sub.func) == "time.time":
+            return sub
+    return None
+
+
+def _name_contains_deadline(node: ast.AST) -> bool:
+    if isinstance(node, ast.Name):
+        return "deadline" in node.id.lower()
+    if isinstance(node, ast.Attribute):
+        return "deadline" in node.attr.lower()
+    return False
+
+
+class _Scanner(ast.NodeVisitor):
+    def __init__(self, sf: SourceFile):
+        self.sf = sf
+        self.findings: list[Finding] = []
+        self._stack: list[ast.AST] = []
+
+    def _ctx(self) -> str:
+        return qualname_of(self._stack)
+
+    def visit_ClassDef(self, node):
+        self._stack.append(node)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = visit_ClassDef
+    visit_AsyncFunctionDef = visit_ClassDef
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = dotted_name(node.func)
+        if name in _GLOBAL_RNG_CALLS:
+            self.findings.append(Finding(
+                RULE, self.sf.path, node.lineno,
+                f"module-global RNG draw {name!r}: call order depends on "
+                f"scheduling — use a seeded instance (random.Random(seed) / "
+                f"np.random.default_rng(seed)) or utils.chaos.hash01",
+                self._ctx()))
+        elif name in _SEED_REQUIRED and not node.args and not node.keywords:
+            self.findings.append(Finding(
+                RULE, self.sf.path, node.lineno,
+                f"unseeded {name}(): seed it explicitly so runs replay "
+                f"bit-identically",
+                self._ctx()))
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if any(_name_contains_deadline(t) for t in node.targets):
+            tt = _contains_time_time(node.value)
+            if tt is not None:
+                self.findings.append(Finding(
+                    RULE, self.sf.path, tt.lineno,
+                    "deadline computed from time.time(): wall clocks step "
+                    "(NTP) — use time.monotonic() for deadlines",
+                    self._ctx()))
+        self.generic_visit(node)
+
+    def visit_Compare(self, node: ast.Compare) -> None:
+        sides = [node.left, *node.comparators]
+        if (any(isinstance(s, ast.Call)
+                and dotted_name(s.func) == "time.time" for s in sides)
+                and any(_name_contains_deadline(s) for s in sides)):
+            self.findings.append(Finding(
+                RULE, self.sf.path, node.lineno,
+                "deadline comparison against time.time(): use "
+                "time.monotonic() for deadlines",
+                self._ctx()))
+        self.generic_visit(node)
+
+
+def check(sources: list[SourceFile]) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in sources:
+        if not _in_scope(sf):
+            continue
+        v = _Scanner(sf)
+        v.visit(sf.tree)
+        findings.extend(v.findings)
+    return findings

@@ -1,0 +1,117 @@
+"""matchlint driver: run the rule suite, diff against the baseline.
+
+Split from ``__main__`` so tests (and ``pytest -m lint``) call the same
+:func:`analyze_repo` the CLI does — one gate, two entry points.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+
+from matchmaking_tpu.analysis import blocking, determinism, locks, recompile
+from matchmaking_tpu.analysis.core import (
+    Finding,
+    SourceFile,
+    apply_ignores,
+    discover,
+    load_baseline,
+    repo_root,
+    split_by_baseline,
+    write_baseline,
+)
+
+#: rule-module checkers run over the discovered sources.
+_STATIC_CHECKS = (locks.check, blocking.check, determinism.check)
+
+
+def analyze_source(code: str, path: str = "snippet.py") -> list[Finding]:
+    """Run the static rules over one source string (the test seam for
+    fixture positives). ``path`` controls which rules consider the snippet
+    in scope — default places it inside the package."""
+    if not path.startswith(("matchmaking_tpu/", "tests/", "scripts/")):
+        path = "matchmaking_tpu/" + path
+    with tempfile.TemporaryDirectory() as tmp:
+        full = os.path.join(tmp, path)
+        os.makedirs(os.path.dirname(full), exist_ok=True)
+        with open(full, "w", encoding="utf-8") as f:
+            f.write(code)
+        sf = SourceFile(tmp, path)
+    findings: list[Finding] = []
+    for chk in _STATIC_CHECKS:
+        findings.extend(chk([sf]))
+    findings.extend(recompile.check_static([sf] if path in
+                                           recompile.KERNEL_MODULES else []))
+    return apply_ignores(findings, {sf.path: sf})
+
+
+def analyze_repo(root: str | None = None, dynamic: bool = True,
+                 rules: set[str] | None = None
+                 ) -> tuple[list[Finding], list[Finding], list[str]]:
+    """Returns (new, baselined, warnings) for the repo at ``root``."""
+    root = root or repo_root()
+    sources = discover(root)
+    by_path = {sf.path: sf for sf in sources}
+    findings: list[Finding] = []
+    for chk in _STATIC_CHECKS:
+        findings.extend(chk(sources))
+    findings.extend(recompile.check(sources, dynamic=dynamic))
+    if rules:
+        findings = [f for f in findings if f.rule in rules]
+    findings = apply_ignores(findings, by_path)
+    warnings = [
+        f"{sf.path}:{ln}: matchlint ignore without a reason is inactive — "
+        f"add one ('# matchlint: ignore[rule] why')"
+        for sf in sources for ln in sf.ignores.bare
+    ]
+    baseline = load_baseline(baseline_path(root))
+    new, accepted = split_by_baseline(findings, baseline)
+    return new, accepted, warnings
+
+
+def baseline_path(root: str) -> str:
+    return os.path.join(root, "matchmaking_tpu", "analysis", "baseline.json")
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="matchlint",
+        description="project static analyzer: concurrency + compile rules")
+    p.add_argument("--root", default=None, help="repo root (default: auto)")
+    p.add_argument("--rules", default="",
+                   help="comma-separated rule subset (default: all)")
+    p.add_argument("--static-only", action="store_true",
+                   help="skip the jax-tracing recompile checks")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="accept all current findings into baseline.json "
+                        "(edit the generated reasons!)")
+    args = p.parse_args(argv)
+    # The recompile rule imports jax for trace-only work; this CLI owns its
+    # process, so default it onto the CPU backend (an explicit JAX_PLATFORMS
+    # from the caller wins) instead of dialing whatever accelerator the
+    # machine-wide config points at.
+    if not args.static_only:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    root = args.root or repo_root()
+    rules = ({r.strip() for r in args.rules.split(",") if r.strip()}
+             or None)
+    new, accepted, warnings = analyze_repo(
+        root, dynamic=not args.static_only, rules=rules)
+    for w in warnings:
+        print(f"warning: {w}", file=sys.stderr)
+    if args.write_baseline:
+        write_baseline(baseline_path(root), new + accepted)
+        print(f"baseline written: {len(new) + len(accepted)} finding(s)")
+        return 0
+    for f in sorted(new, key=lambda f: (f.path, f.line)):
+        print(f.render())
+    if accepted:
+        print(f"({len(accepted)} baselined finding(s) suppressed — see "
+              f"matchmaking_tpu/analysis/baseline.json)")
+    if new:
+        print(f"matchlint: {len(new)} finding(s)")
+        return 1
+    print("matchlint: clean")
+    return 0

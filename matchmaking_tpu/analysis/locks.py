@@ -1,0 +1,343 @@
+"""Lock-discipline rules: ``await-under-lock`` and ``guarded-by``.
+
+Both rules encode the service's one concurrency contract (service/app.py):
+all engine access serializes behind a per-queue ``asyncio.Lock`` named
+``_engine_lock``, engine work runs off the event loop via
+``asyncio.to_thread``, and pool/window bookkeeping must not be observable
+in a half-mutated state across an await.
+
+**await-under-lock** — inside the body of ``async with <...lock>``, every
+``await`` must be one of the sanctioned shapes:
+
+- ``await asyncio.to_thread(...)`` — THE designed seam: the engine step
+  blocks a worker thread while the critical section stays closed to other
+  event-loop tasks (the lock is held, so nothing interleaves with the
+  protected state even though the loop keeps running other queues).
+- ``await self._drain_engine(...)`` — the designated lock-held helper
+  (its own awaits are all ``to_thread``).
+
+Anything else (``asyncio.sleep``, broker RPC, middleware pipelines, bare
+coroutines) suspends the critical section at a point where OTHER tasks can
+acquire nothing but can observe and schedule against half-updated host
+state once the holder resumes — PR 2's await-window double-match was
+exactly this class.
+
+**guarded-by** — a declaration convention on shared attributes::
+
+    self._inflight_meta = {}  # guarded-by: _engine_lock
+
+Every mutation of a declared attribute (rebind, aug-assign, ``del``,
+subscript store, or a mutating method call like ``.pop``/``.append``, and
+attribute stores THROUGH it like ``self.engine.device_error = ...``) must
+be dominated by the declared lock: lexically inside ``with``/``async
+with`` on that lock, or in a method that is ``__init__``, ends with
+``_locked``, or carries ``# holds-lock: <lock>`` on/above its ``def``
+line. Calls to ``self.<m>()`` where ``m`` is a lock-holding method are
+checked the same way, so the caller-holds-lock convention is enforced one
+level deep instead of trusted.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from matchmaking_tpu.analysis.core import (
+    Finding,
+    SourceFile,
+    dotted_name,
+    in_package,
+)
+
+AWAIT_RULE = "await-under-lock"
+GUARD_RULE = "guarded-by"
+
+#: Awaited callables allowed inside a lock body (dotted suffix match).
+ALLOWED_AWAIT_CALLS = ("asyncio.to_thread",)
+#: Methods designed to run with the lock held (awaitable helpers).
+ALLOWED_AWAIT_METHODS = ("_drain_engine",)
+
+#: Container/set/dict methods that mutate their receiver.
+MUTATORS = frozenset({
+    "append", "extend", "insert", "add", "update", "setdefault", "pop",
+    "popleft", "popitem", "appendleft", "remove", "discard", "clear",
+})
+
+_GUARD_RE = re.compile(r"#\s*guarded-by:\s*(\w+)")
+_HOLDS_RE = re.compile(r"#\s*holds-lock:\s*(\w+)")
+
+
+def _is_lock_expr(node: ast.AST) -> str | None:
+    """The lock's attribute/variable name when ``node`` looks like a lock
+    (name ends in ``lock``), else None."""
+    name = dotted_name(node)
+    leaf = name.rsplit(".", 1)[-1] if name else ""
+    return leaf if leaf.lower().endswith("lock") else None
+
+
+def _await_allowed(call: ast.AST) -> bool:
+    if not isinstance(call, ast.Call):
+        return False  # awaiting a bare name/attribute: not analyzable, flag
+    name = dotted_name(call.func)
+    if any(name == a or name.endswith("." + a) for a in ALLOWED_AWAIT_CALLS):
+        return True
+    leaf = name.rsplit(".", 1)[-1] if name else ""
+    return leaf in ALLOWED_AWAIT_METHODS
+
+
+class _AwaitUnderLock(ast.NodeVisitor):
+    def __init__(self, sf: SourceFile):
+        self.sf = sf
+        self.findings: list[Finding] = []
+        self._stack: list[ast.AST] = []
+        self._held: list[str] = []
+
+    def _context(self) -> str:
+        from matchmaking_tpu.analysis.core import qualname_of
+
+        return qualname_of(self._stack)
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._stack.append(node)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # A nested sync def's body runs wherever it is CALLED (often inside
+        # to_thread); its awaits can't exist, its lexical position under a
+        # lock is irrelevant — still descend for nested async defs.
+        self._stack.append(node)
+        held, self._held = self._held, []
+        self.generic_visit(node)
+        self._held = held
+        self._stack.pop()
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._stack.append(node)
+        held, self._held = self._held, []
+        self.generic_visit(node)
+        self._held = held
+        self._stack.pop()
+
+    def visit_AsyncWith(self, node: ast.AsyncWith) -> None:
+        locks = [n for item in node.items
+                 if (n := _is_lock_expr(item.context_expr))]
+        self._held.extend(locks)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in locks:
+            self._held.pop()
+
+    def visit_Await(self, node: ast.Await) -> None:
+        if self._held and not _await_allowed(node.value):
+            awaited = dotted_name(
+                node.value.func if isinstance(node.value, ast.Call)
+                else node.value) or "<expr>"
+            self.findings.append(Finding(
+                AWAIT_RULE, self.sf.path, node.lineno,
+                f"await of {awaited!r} while holding "
+                f"{'/'.join(self._held)}: the critical section suspends at "
+                f"a point other tasks can interleave with "
+                f"(sanction via asyncio.to_thread or a holds-lock helper)",
+                self._context()))
+        self.generic_visit(node)
+
+
+# ---- guarded-by ------------------------------------------------------------
+
+class _MethodInfo:
+    __slots__ = ("node", "holds")
+
+    def __init__(self, node: ast.AST, holds: set[str]):
+        self.node = node
+        self.holds = holds
+
+
+def _comment_match(sf: SourceFile, lineno: int, rx: re.Pattern) -> str | None:
+    """Match ``rx`` on ``lineno`` or the line directly above it."""
+    for ln in (lineno, lineno - 1):
+        m = rx.search(sf.line_at(ln))
+        if m:
+            return m.group(1)
+    return None
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    """``X`` when node is ``self.X``."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _root_self_attr(node: ast.AST) -> str | None:
+    """The first attribute off ``self`` in a chain like
+    ``self.X.y[...].z`` — the object whose state the statement mutates."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            return node.attr
+        node = node.value
+    return None
+
+
+class _GuardedByClass:
+    """Per-class analysis: collect declarations, then check every method."""
+
+    def __init__(self, sf: SourceFile, cls: ast.ClassDef,
+                 findings: list[Finding]):
+        self.sf = sf
+        self.cls = cls
+        self.findings = findings
+        self.guarded: dict[str, str] = {}   # attr -> lock
+        self.methods: dict[str, _MethodInfo] = {}
+        self._collect()
+
+    def _collect(self) -> None:
+        for item in self.cls.body:
+            if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            holds: set[str] = set()
+            lock = _comment_match(self.sf, item.lineno, _HOLDS_RE)
+            if lock:
+                holds.add(lock)
+            self.methods[item.name] = _MethodInfo(item, holds)
+            for node in ast.walk(item):
+                # Both assignment forms declare: `self.x = ...` AND the
+                # annotated `self.x: T = ...` (missing the latter would
+                # silently disarm any guard on an annotated attribute).
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, ast.AnnAssign):
+                    targets = [node.target]
+                else:
+                    continue
+                for tgt in targets:
+                    attr = _self_attr(tgt)
+                    if attr is None:
+                        continue
+                    g = _comment_match(self.sf, node.lineno, _GUARD_RE)
+                    if g:
+                        self.guarded[attr] = g
+
+    def _method_holds(self, name: str, lock: str) -> bool:
+        if name == "__init__" or name.endswith("_locked"):
+            return True
+        info = self.methods.get(name)
+        return info is not None and lock in info.holds
+
+    def check(self) -> None:
+        if not self.guarded:
+            return
+        lockers = {
+            name for name, info in self.methods.items()
+            if info.holds or name.endswith("_locked")
+        }
+        for name, info in self.methods.items():
+            _MethodChecker(self, name, lockers).visit(info.node)
+
+
+class _MethodChecker(ast.NodeVisitor):
+    """Walks one method tracking which locks are lexically held. Nested
+    defs inherit the current held set: a closure defined inside the lock
+    block is dispatched while the section is closed (``to_thread``)."""
+
+    def __init__(self, owner: _GuardedByClass, method: str,
+                 lockers: set[str]):
+        self.owner = owner
+        self.method = method
+        self.lockers = lockers
+        self._held: list[str] = []
+
+    def _ok(self, lock: str) -> bool:
+        return lock in self._held or self.owner._method_holds(
+            self.method, lock)
+
+    def _flag(self, node: ast.AST, attr: str, lock: str, what: str) -> None:
+        self.owner.findings.append(Finding(
+            GUARD_RULE, self.owner.sf.path, node.lineno,
+            f"{what} of {attr!r} (guarded-by: {lock}) outside the lock: "
+            f"hold {lock}, move into a *_locked/holds-lock method, or "
+            f"annotate why the site is safe",
+            f"{self.owner.cls.name}.{self.method}"))
+
+    def _with(self, node: ast.With | ast.AsyncWith) -> None:
+        locks = [n for item in node.items
+                 if (n := _is_lock_expr(item.context_expr))]
+        self._held.extend(locks)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in locks:
+            self._held.pop()
+
+    visit_With = _with
+    visit_AsyncWith = _with
+
+    def _check_target(self, node: ast.AST, tgt: ast.AST, what: str) -> None:
+        attr = _root_self_attr(tgt)
+        if attr is None:
+            return
+        lock = self.owner.guarded.get(attr)
+        if lock is not None and not self._ok(lock):
+            self._flag(node, attr, lock, what)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for tgt in node.targets:
+            targets = tgt.elts if isinstance(tgt, (ast.Tuple,
+                                                   ast.List)) else [tgt]
+            for t in targets:
+                self._check_target(node, t, "mutation")
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._check_target(node, node.target, "mutation")
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._check_target(node, node.target, "mutation")
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for tgt in node.targets:
+            self._check_target(node, tgt, "deletion")
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            # self.X.pop(...) / self.X[...].append(...): receiver mutation.
+            if func.attr in MUTATORS:
+                self._check_target(node, func.value, f"{func.attr}()")
+            # self.M(...) where M is a lock-holding method: the callee
+            # assumes the lock; verify this caller actually provides it.
+            attr = _self_attr(func)
+            if attr in self.lockers:
+                info = self.owner.methods.get(attr)
+                locks = (info.holds if info and info.holds
+                         else {"_engine_lock"})
+                for lock in locks:
+                    if not self._ok(lock):
+                        self.owner.findings.append(Finding(
+                            GUARD_RULE, self.owner.sf.path, node.lineno,
+                            f"call to lock-holding method {attr!r} without "
+                            f"{lock}: acquire it first or mark the caller "
+                            f"holds-lock",
+                            f"{self.owner.cls.name}.{self.method}"))
+        self.generic_visit(node)
+
+
+def check(sources: list[SourceFile]) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in sources:
+        if not in_package(sf):
+            continue
+        v = _AwaitUnderLock(sf)
+        v.visit(sf.tree)
+        findings.extend(v.findings)
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef):
+                _GuardedByClass(sf, node, findings).check()
+    return findings

@@ -302,6 +302,15 @@ class ObservabilityConfig:
     #: is one small object per publish and O(marks) appends per delivery —
     #: measured noise next to decode/publish work.
     trace: bool = True
+    #: Trace every Nth request publish (1 = every publish, the default).
+    #: At the measured service knee one context per publish is noise, but
+    #: a 500k+/s ingress allocates half a million dead objects a second
+    #: for rings that keep 256 — sample instead: stage histograms stay
+    #: statistically true, exemplars stay available, and untraced
+    #: deliveries skip every mark. Applies to broker-side stamping (in-proc
+    #: AND the AMQP header stamp); the lazy ingress fallback only runs at
+    #: N == 1 so sampled-out deliveries aren't resurrected downstream.
+    trace_sample_n: int = 1
     #: Completed traces kept per queue (newest wins; bounded memory).
     trace_ring: int = 256
     #: Slow-trace exemplars kept per queue.

@@ -50,6 +50,15 @@ def init_distributed(coordinator_address: str | None = None,
 
     if _initialized:
         return jax.process_index(), jax.process_count()
+    if cpu_collectives_supported():
+        # The CPU backend needs an explicit collectives implementation for
+        # multiprocess work (gloo over TCP); without it every cross-process
+        # device_put/psum dies with "Multiprocess computations aren't
+        # implemented on the CPU backend". TPU/GPU backends ignore the
+        # knob, so setting it is safe wherever it exists — this is what
+        # lets tests/test_dcn.py run the real 2-process sharded step on a
+        # CPU-only box.
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
     coordinator_address = coordinator_address or os.environ.get(
         "MM_DCN_COORDINATOR")
     if num_processes is None and os.environ.get("MM_DCN_NUM_PROCESSES"):
@@ -63,6 +72,21 @@ def init_distributed(coordinator_address: str | None = None,
     )
     _initialized = True
     return jax.process_index(), jax.process_count()
+
+
+def cpu_collectives_supported() -> bool:
+    """True when this jaxlib ships gloo CPU collectives AND the config knob
+    to select them — the capability multiprocess-on-CPU (tests/test_dcn.py)
+    needs. Checked without initializing any backend."""
+    try:
+        import jax
+        import jaxlib.xla_extension as xe
+    except Exception:  # pragma: no cover - jax is in the image
+        return False
+    if not hasattr(xe, "make_gloo_tcp_collectives"):
+        return False
+    return any("cpu_collectives" in name.lower()
+               for name in jax.config.values)
 
 
 def dcn_configured() -> bool:

@@ -12,6 +12,18 @@ Host/device split (SURVEY.md §7):
   requests. Single writer — windows per queue are serialized, which is the
   atomicity story: a matched player leaves the pool before the next window
   is dispatched (SURVEY.md §7 "Hard parts: atomicity").
+
+Concurrency contract (what matchlint's guarded-by rule enforces on the
+SERVICE side): this engine has NO internal locks and must only be driven
+with the owning queue runtime's ``_engine_lock`` held — every public
+entry (search*/rescan*/collect_ready/flush/expire/remove/restore/
+heartbeat) mutates the mirror and the token books (``_pending``,
+``_open``, ``failed_tokens``, ``rescan_tokens``, ``window_marks``)
+unguarded, and the host-sync readbacks in here (``np.asarray`` on device
+handles in ``_materialize``, ``block_until_ready`` in warmup/probe) are
+DESIGNED to run on a worker thread via ``asyncio.to_thread``, never on
+the event loop (the blocking-call rule's allowance is that these are not
+``async def`` bodies).
 - Device: admission scatter, blockwise score+mask, streaming top-k, greedy
   conflict-free pairing, eviction scatter — one fused jitted step.
 

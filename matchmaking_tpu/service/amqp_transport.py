@@ -46,6 +46,15 @@ import uuid
 from typing import Any, Awaitable, Callable
 
 from matchmaking_tpu.service.broker import Delivery, Properties
+from matchmaking_tpu.utils.trace import TraceContext
+
+#: Message header carrying the publish-time trace stamp (ROADMAP PR 3
+#: follow-up): the in-proc broker attaches a TraceContext object to its
+#: Delivery, but over a real AMQP wire only headers survive — so publish
+#: stamps the wall-clock enqueue time here and the consumer rebuilds the
+#: context from it. Without this, AMQP traces began at first consume and
+#: their ``enqueue`` stage always read 0.
+TRACE_HEADER = "x-trace-enqueue"
 
 #: Delivery-tag generation packing: low 48 bits are the broker's channel
 #: tag (a per-channel counter — 2^48 deliveries per connection incarnation
@@ -115,6 +124,11 @@ class AmqpBroker:
                       "consumer_errors": 0, "unroutable": 0,
                       "reconnects": 0, "consumer_reconnects": 0,
                       "stale_acks": 0}
+        #: Trace stamping via message headers (see TRACE_HEADER); the app
+        #: mirrors ObservabilityConfig.trace/trace_sample_n onto these.
+        self.trace_enabled = True
+        self.trace_sample_n = 1
+        self._trace_count = 0
         with self._lock:
             self._connect_locked()
 
@@ -175,10 +189,22 @@ class AmqpBroker:
 
     def publish(self, queue: str, body: bytes,
                 properties: Properties | None = None) -> None:
+        headers = dict(properties.headers) if properties else None
+        # Stamp requests (reply_to set) at PUBLISH so the consumer-side
+        # trace context starts at true enqueue time — same policy as the
+        # in-proc broker, including sample-N.
+        stamp = (self.trace_enabled and properties is not None
+                 and bool(properties.reply_to))
+        if stamp and self.trace_sample_n > 1:
+            self._trace_count += 1
+            stamp = self._trace_count % self.trace_sample_n == 1
+        if stamp:
+            headers = dict(headers or {})
+            headers[TRACE_HEADER] = repr(time.time())
         props = self._pika.BasicProperties(
             reply_to=properties.reply_to if properties else None,
             correlation_id=properties.correlation_id if properties else None,
-            headers=dict(properties.headers) if properties else None,
+            headers=headers,
         )
         # At-least-once: a retried publish after a mid-op drop may
         # duplicate; consumers dedupe by player id / correlation id.
@@ -232,16 +258,32 @@ class AmqpBroker:
 
                 def on_message(ch, method, props, body,
                                _gen=generation, _q=consumer.queue):
+                    headers = dict(props.headers or {})
+                    # Rebuild the publish-time trace from the header stamp
+                    # (only stamped messages get a context — sample-N is
+                    # decided at publish, so an unstamped delivery stays
+                    # untraced end to end).
+                    trace = None
+                    stamp = headers.get(TRACE_HEADER)
+                    if stamp is not None:
+                        try:
+                            trace = TraceContext(
+                                _q, props.correlation_id or "",
+                                redelivered=method.redelivered,
+                                t=float(stamp))
+                        except (TypeError, ValueError):
+                            trace = None  # foreign/garbled header: no trace
                     delivery = Delivery(
                         body=body,
                         properties=Properties(
                             reply_to=props.reply_to or "",
                             correlation_id=props.correlation_id or "",
-                            headers=dict(props.headers or {}),
+                            headers=headers,
                         ),
                         queue=_q,
                         delivery_tag=(_gen << _TAG_BITS) | method.delivery_tag,
                         redelivered=method.redelivered,
+                        trace=trace,
                     )
                     asyncio.run_coroutine_threadsafe(
                         consumer.callback(delivery), loop)

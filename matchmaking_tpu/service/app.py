@@ -71,15 +71,21 @@ class _QueueRuntime:
                                         observe_window=self._observe_window)
         # Serializes ALL engine access (window flushes vs the timeout
         # sweeper): engines are single-writer objects with no internal locks.
+        # Attributes below marked ``guarded-by: _engine_lock`` are checked
+        # by matchlint (analysis/locks.py): every mutation site must be
+        # dominated by this lock (or live in a *_locked / holds-lock
+        # method).
         self._engine_lock = asyncio.Lock()
         # Pipelined columnar windows: token → (by_id, deliveries) for every
         # dispatched-but-uncollected window. Outcomes are handled (publish +
         # ack) at COLLECTION time, so up to ``engine.pipeline_depth`` windows
         # overlap on device — the discipline the bench measures, now in
         # production (round-3 verdict ask #3).
+        # guarded-by: _engine_lock
         self._inflight_meta: dict[int, tuple[dict[str, Delivery], list[Delivery]]] = {}
         self._collector: asyncio.Task | None = None
         #: A collected window failed on device; revive once in-flight drains.
+        # guarded-by: _engine_lock
         self._needs_revive = False
         #: Windows currently inside a flush (decode → dispatch → [inline
         #: handling]); engine.inflight() only counts DISPATCHED windows, so
@@ -154,6 +160,7 @@ class _QueueRuntime:
             engine.chaos_hook = self._chaos_hook
         return engine
 
+    # holds-lock: _engine_lock
     def _bind_engine(self, engine: Engine) -> None:
         """Install ``engine`` and recompute every engine-shape-dependent
         seam. The single place engine swaps land — boot, crash revive,
@@ -161,6 +168,7 @@ class _QueueRuntime:
         because the device engine and the host oracle differ in ingress
         shape (columnar vs object decode) and dispatch discipline
         (pipelined vs synchronous)."""
+        # guarded-by: _engine_lock
         self.engine = engine
         # Lifecycle event timeline: engine-internal transitions (wildcard
         # delegation, re-promotion) report through the shared log.
@@ -244,9 +252,13 @@ class _QueueRuntime:
 
     def _trace(self, delivery: Delivery) -> "TraceContext | None":
         """The delivery's trace, created lazily for transports that don't
-        stamp at publish (AMQP). None when tracing is off."""
+        stamp at publish (the enqueue stage then reads 0). None when
+        tracing is off — or when sample-N tracing is on: with N > 1 an
+        unstamped delivery means the broker SAMPLED IT OUT, and creating a
+        context here would resurrect every one of them."""
         tr = delivery.trace
-        if tr is None and self.app.trace_enabled:
+        if (tr is None and self.app.trace_enabled
+                and self.app.trace_sample_n <= 1):
             tr = delivery.trace = TraceContext(
                 self.queue_cfg.name, delivery.properties.correlation_id,
                 redelivered=delivery.redelivered)
@@ -420,6 +432,9 @@ class _QueueRuntime:
         except Exception:
             log.exception("engine step crashed; reviving engine from mirror")
             self._record_engine_crash(now)
+            # Sync crash path: the raise released the lock, and no await
+            # separates detection from rebuild, so nothing can interleave.
+            # matchlint: ignore[guarded-by] revive sequence is await-free; the lock guards cross-await atomicity only
             self._revive_engine(now)
             for delivery in deliveries_in:
                 self.app.broker.nack(self.consumer_tag, delivery.delivery_tag,
@@ -607,19 +622,26 @@ class _QueueRuntime:
             try:
                 async with self._engine_lock:
                     outs = await asyncio.to_thread(run_engine)
-                if self.engine.device_error is not None:
-                    err, self.engine.device_error = self.engine.device_error, None
-                    raise err
+                    # Error check + failed-token bookkeeping stay INSIDE
+                    # the lock: a breaker demotion parked on it must not
+                    # swap the engine between the flush and this read.
+                    if self.engine.device_error is not None:
+                        err, self.engine.device_error = (
+                            self.engine.device_error, None)
+                        raise err
+                    for tok, _out in outs:
+                        self.engine.failed_tokens.discard(tok)
             except Exception:
                 log.exception("engine step crashed; reviving engine from mirror")
                 self._record_engine_crash(now)
+                # Sync crash path — see the object-path twin above.
+                # matchlint: ignore[guarded-by] revive sequence is await-free; the lock guards cross-await atomicity only
                 self._revive_engine(now)
                 for d in deliveries_in:
                     self.app.broker.nack(self.consumer_tag,
                                          d.delivery_tag, requeue=True)
                 return
             for tok, out in outs:
-                self.engine.failed_tokens.discard(tok)
                 self._merge_window_marks(tok, deliveries_in)
                 self._handle_columnar_out(out, by_id, deliveries_in, now)
             return
@@ -734,6 +756,7 @@ class _QueueRuntime:
         for tok, out in self.engine.collect_ready():
             self._finish_token(tok, out, now)
 
+    # holds-lock: _engine_lock
     def _finish_token(self, tok: int, out, now: float) -> None:
         meta = self._inflight_meta.pop(tok, None)
         if meta is None:
@@ -835,6 +858,7 @@ class _QueueRuntime:
         self.app.metrics.counters.inc("windows")
         self.app.metrics.counters.inc("requests_batched", len(deliveries))
 
+    # holds-lock: _engine_lock
     async def _drain_engine(self, now: float) -> None:
         """Flush every in-flight window and handle its outcome. Caller holds
         _engine_lock. Restores the ``_open == 0`` invariant rescan/expire/
@@ -989,6 +1013,7 @@ class _QueueRuntime:
         self.app.broker.publish(reply_to, body,
                                 Properties(correlation_id=correlation_id))
 
+    # holds-lock: _engine_lock
     def _revive_engine(self, now: float) -> None:
         """Elastic recovery: rebuild the engine and resubmit the pool from
         the authoritative host mirror (SURVEY.md §5).
@@ -1356,6 +1381,8 @@ class _QueueRuntime:
             except Exception:
                 log.exception("timeout sweep failed; reviving engine from mirror")
                 self._record_engine_crash(now)
+                # Sync crash path — see _flush_inner.
+                # matchlint: ignore[guarded-by] revive sequence is await-free; the lock guards cross-await atomicity only
                 self._revive_engine(now)
                 continue
             for removed in expired:
@@ -1398,6 +1425,9 @@ class MatchmakingApp:
         self.events = EventLog(obs.event_ring)
         #: Trace stamping master switch (flight recorder).
         self.trace_enabled = obs.trace
+        #: Trace every Nth request publish (1 = all; PR 3 follow-up for
+        #: very high ingress — see ObservabilityConfig.trace_sample_n).
+        self.trace_sample_n = max(1, obs.trace_sample_n)
         self.metrics = Metrics(stage_buckets=obs.stage_buckets or None)
         #: Request-lifecycle flight recorder (/debug/traces): per-queue
         #: rings of settled traces + slow exemplars; feeds the per-stage
@@ -1421,6 +1451,8 @@ class MatchmakingApp:
             self.broker.events = self.events
         if hasattr(self.broker, "trace_enabled"):
             self.broker.trace_enabled = self.trace_enabled
+        if hasattr(self.broker, "trace_sample_n"):
+            self.broker.trace_sample_n = self.trace_sample_n
         self._runtimes: dict[str, _QueueRuntime] = {}
         self._started = False
         self._observability = None

@@ -8,6 +8,13 @@ window. Windows per queue are serialized — the next window is not dispatched
 until the previous one's flush callback returns — which is the atomicity
 guarantee (a matched player is out of the pool before anyone else can see
 them; SURVEY.md §7 "Hard parts").
+
+Concurrency contract: all state (``_pending``/``_submitted``/the events)
+is event-loop-confined — ``submit()`` must be called from the loop, never
+from a worker thread (use ``loop.call_soon_threadsafe`` to cross). There
+is deliberately no lock here for matchlint's guarded-by rule to check:
+the ``_run`` task and submitters interleave only at awaits, and ``_cut``
+is await-free, so a window slice is atomic by construction.
 """
 
 from __future__ import annotations

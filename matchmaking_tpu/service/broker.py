@@ -298,6 +298,10 @@ class InProcBroker:
         #: Trace-context stamping at publish (the flight recorder's
         #: "enqueue" mark). The app may disable it via config.
         self.trace_enabled = True
+        #: Stamp every Nth request publish (ObservabilityConfig.
+        #: trace_sample_n, set by the app). 1 = every publish.
+        self.trace_sample_n = 1
+        self._trace_count = 0
         self._queues: dict[str, _Queue] = {}
         self._tags = itertools.count(1)
         self._consumers: dict[str, _Consumer] = {}
@@ -358,6 +362,13 @@ class InProcBroker:
         # ones. Requests published without reply_to still get a trace
         # lazily at ingress (the enqueue stage then reads 0).
         stamp = self.trace_enabled and bool(props.reply_to)
+        if stamp and self.trace_sample_n > 1:
+            # Sample-N tracing (ROADMAP PR 3 follow-up): only every Nth
+            # request publish allocates a context; the counter advances
+            # per stampable publish so the sample is uniform over requests,
+            # not over mixed request/response traffic.
+            self._trace_count += 1
+            stamp = self._trace_count % self.trace_sample_n == 1
         delivery = Delivery(
             body=bytes(body), properties=props,
             queue=queue, delivery_tag=next(self._tags), seq=seq,

@@ -1,3 +1,10 @@
-"""Test doubles shipped with the package (usable by downstream users'
-suites as well as our own CI): currently the in-memory pika fake that lets
-the AMQP adapter run without a RabbitMQ server."""
+"""Test doubles and dynamic checkers shipped with the package (usable by
+downstream users' suites as well as our own CI):
+
+- ``fake_pika`` — the in-memory pika fake that lets the AMQP adapter run
+  without a RabbitMQ server;
+- ``sanitizer`` — the runtime async sanitizer (instrumented asyncio.Lock:
+  lock-order-inversion detection, runtime await-under-lock, event-loop
+  stall watchdog) that the soak/chaos suites run under via the
+  ``sanitizer`` pytest fixture.
+"""

@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# The repo gate, in order:
+#   1. matchlint (python -m matchmaking_tpu.analysis) — fails on any
+#      finding outside analysis/baseline.json. Runs FIRST because it is
+#      seconds, not minutes, and a lock-discipline bug should fail fast.
+#   2. tier-1 tests (the ROADMAP.md verify recipe's pytest selection).
+# Lint time is excluded from any bench numbers by construction: bench.py
+# never invokes this script (see BENCH_CONFIGS.md).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== matchlint =="
+JAX_PLATFORMS=cpu python -m matchmaking_tpu.analysis
+
+echo "== tier-1 =="
+exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider

@@ -1,0 +1,21 @@
+#!/usr/bin/env python
+"""matchlint CLI wrapper (same gate as ``python -m matchmaking_tpu.analysis``).
+
+Lives in scripts/ so CI and editors can call a file path; the repo root is
+derived from this script's location so it works from any cwd.
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from matchmaking_tpu.analysis.engine import main  # noqa: E402
+
+if __name__ == "__main__":
+    # Respect an explicit --root in either form (`--root X` / `--root=X`);
+    # default to this checkout otherwise.
+    has_root = any(a == "--root" or a.startswith("--root=")
+                   for a in sys.argv[1:])
+    sys.exit(main(sys.argv[1:] + ([] if has_root else ["--root", REPO])))

@@ -44,6 +44,28 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long soak — excluded from the tier-1 `-m 'not "
         "slow'` run")
+    config.addinivalue_line(
+        "markers", "lint: static-analysis gate (`pytest -m lint` runs "
+        "matchlint as a test node; part of tier-1)")
+
+
+@pytest.fixture
+def sanitizer():
+    """Runtime async sanitizer (matchmaking_tpu/testing/sanitizer.py):
+    while the test runs, every ``asyncio.Lock()`` the service creates is
+    instrumented — lock-order inversions, non-sanctioned awaits under a
+    lock, and event-loop stalls are collected and asserted empty at
+    teardown. The 2.0 s stall threshold leaves headroom for the CPU test
+    mesh's cold-cache XLA compiles (GIL-holding host slices of to_thread
+    work can stall the loop once per fresh process); a real on-loop bug —
+    time.sleep, a sync device readback — stalls far longer and on every
+    window, not once."""
+    from matchmaking_tpu.testing.sanitizer import AsyncSanitizer
+
+    san = AsyncSanitizer(stall_threshold_s=2.0)
+    with san.installed():
+        yield san
+    san.assert_clean()
 
 
 @pytest.hookimpl(tryfirst=True)

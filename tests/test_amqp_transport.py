@@ -199,3 +199,74 @@ async def test_serve_entrypoint_end_to_end(monkeypatch):
     finally:
         stop.set()
         await task
+
+
+@pytest.mark.asyncio
+async def test_publish_stamps_trace_header_and_consumer_rebuilds_context():
+    """ROADMAP PR 3 follow-up: AMQP traces are stamped VIA MESSAGE HEADERS
+    at publish, so the consumer-side context starts at true enqueue time
+    and the enqueue stage stops reading 0."""
+    import time
+
+    from matchmaking_tpu.service.amqp_transport import TRACE_HEADER
+
+    broker, server = make_broker()
+    got = []
+
+    async def on_delivery(d):
+        got.append(d)
+        broker.ack(tag, d.delivery_tag)
+
+    broker.declare_queue("tq")
+    tag = broker.basic_consume("tq", on_delivery)
+    t0 = time.time()
+    broker.publish("tq", b"x", Properties(reply_to="rq",
+                                          correlation_id="c9"))
+    for _ in range(200):
+        if got:
+            break
+        await drain(0.01)
+    d = got[0]
+    assert TRACE_HEADER in d.properties.headers
+    assert d.trace is not None
+    stage, t_enq = d.trace.marks[0]
+    assert stage == "enqueue"
+    # The mark is the PUBLISH wall clock (from the header), not consume.
+    assert t0 <= t_enq <= time.time()
+    assert float(d.properties.headers[TRACE_HEADER]) == t_enq
+    # Responses (no reply_to) are never stamped.
+    broker.publish("tq", b"resp", Properties(correlation_id="c9"))
+    for _ in range(200):
+        if len(got) == 2:
+            break
+        await drain(0.01)
+    assert got[1].trace is None
+    assert TRACE_HEADER not in got[1].properties.headers
+    broker.close()
+
+
+@pytest.mark.asyncio
+async def test_trace_sample_n_stamps_every_nth_amqp_publish():
+    from matchmaking_tpu.service.amqp_transport import TRACE_HEADER
+
+    broker, server = make_broker()
+    got = []
+
+    async def on_delivery(d):
+        got.append(d)
+        broker.ack(tag, d.delivery_tag)
+
+    broker.trace_sample_n = 3
+    broker.declare_queue("sq")
+    tag = broker.basic_consume("sq", on_delivery)
+    for i in range(9):
+        broker.publish("sq", b"x", Properties(reply_to="rq",
+                                              correlation_id=f"c{i}"))
+    for _ in range(300):
+        if len(got) == 9:
+            break
+        await drain(0.01)
+    stamped = [d for d in got if TRACE_HEADER in d.properties.headers]
+    assert len(stamped) == 3
+    assert sum(d.trace is not None for d in got) == 3
+    broker.close()

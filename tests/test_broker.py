@@ -233,3 +233,43 @@ async def test_batch_hint_preserves_order_and_acks(broker):
     await _drain(received, 50)
     assert [d.body for d in received] == [f"m{i}".encode() for i in range(50)]
     assert broker.stats["acked"] == 50
+
+
+async def test_trace_sample_n_stamps_every_nth_publish():
+    """ObservabilityConfig.trace_sample_n on the in-proc broker: with
+    N > 1, only every Nth request publish allocates a TraceContext —
+    high-ingress runs stop paying one context per message."""
+    broker = InProcBroker(BrokerConfig())
+    broker.trace_sample_n = 3
+    broker.declare_queue("q")
+    for i in range(9):
+        broker.publish("q", b"x", Properties(reply_to="r",
+                                             correlation_id=f"c{i}"))
+    traced = 0
+    for _ in range(9):
+        d = await broker.get("q", timeout=1.0)
+        assert d is not None
+        traced += d.trace is not None
+    assert traced == 3
+    broker.close()
+
+
+async def test_trace_sample_n_wired_from_observability_config():
+    from matchmaking_tpu.config import Config, ObservabilityConfig
+    from matchmaking_tpu.service.app import MatchmakingApp
+
+    app = MatchmakingApp(Config(observability=ObservabilityConfig(
+        trace_sample_n=4)))
+    assert app.trace_sample_n == 4
+    assert app.broker.trace_sample_n == 4
+    # The ingress's lazy-trace fallback must not resurrect sampled-out
+    # deliveries (it only runs at N == 1).
+    rtq = None
+    try:
+        await app.start()
+        rtq = app.runtime(app.cfg.queues[0].name)
+        d = Delivery(body=b"{}", properties=Properties(), queue="q",
+                     delivery_tag=1)
+        assert rtq._trace(d) is None
+    finally:
+        await app.stop()

@@ -147,7 +147,7 @@ async def _crash_storm_run() -> dict:
         await app.stop()
 
 
-def test_chaos_crash_storm_breaker_end_to_end_deterministic():
+def test_chaos_crash_storm_breaker_end_to_end_deterministic(sanitizer):
     """Acceptance run (see module docstring), executed twice with the same
     seed: the transcripts — matched pairs, ack counts, crash/trip/probe
     counts, chaos step indices consumed — must be bit-identical."""
@@ -160,7 +160,7 @@ def test_chaos_crash_storm_breaker_end_to_end_deterministic():
     assert first == second
 
 
-def test_chaos_breaker_gauges_and_healthz_surface_state():
+def test_chaos_breaker_gauges_and_healthz_surface_state(sanitizer):
     """Breaker state is observable while degraded: metrics gauges flip to
     OPEN on the trip and back to CLOSED after re-promotion, and the
     report() payload carries the per-queue snapshot."""
@@ -224,7 +224,7 @@ def test_chaos_breaker_gauges_and_healthz_surface_state():
     asyncio.run(run())
 
 
-def test_idle_delegated_team_queue_repromotes_on_health_timer():
+def test_idle_delegated_team_queue_repromotes_on_health_timer(sanitizer):
     """ADVICE round-5 #3 regression: a wildcard-delegated device team queue
     with ``rescan_interval_s=0`` (the team-queue default) and ZERO further
     traffic must re-promote to the device path via the health timer alone —
@@ -285,7 +285,7 @@ def test_idle_delegated_team_queue_repromotes_on_health_timer():
     asyncio.run(run())
 
 
-def test_chaos_broker_faults_scripted_and_deterministic():
+def test_chaos_broker_faults_scripted_and_deterministic(sanitizer):
     """Scripted broker faults on the host backend (no jit — the fastest
     smoke): a first-attempt drop, a redelivery storm, and a partition
     pause/resume, with stats identical across two seeded runs."""

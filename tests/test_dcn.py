@@ -9,7 +9,24 @@ import os
 import subprocess
 import sys
 
+import pytest
+
+from matchmaking_tpu.engine.distributed import cpu_collectives_supported
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: Capability gate: the 2-process run needs (a) a jaxlib with gloo CPU
+#: collectives (init_distributed selects them; older builds fail every
+#: cross-process op with "Multiprocess computations aren't implemented on
+#: the CPU backend") and (b) at least 2 cores so the ranks can make
+#: synchronous progress through the collective barriers instead of
+#: timing out. MM_FORCE_DCN_TEST=1 overrides both checks.
+_FORCED = os.environ.get("MM_FORCE_DCN_TEST", "") not in ("", "0")
+pytestmark = pytest.mark.skipif(
+    not _FORCED and not (cpu_collectives_supported()
+                         and (os.cpu_count() or 1) >= 2),
+    reason="multiprocess DCN-on-CPU needs a gloo-collectives jaxlib and "
+           ">=2 cores (set MM_FORCE_DCN_TEST=1 to force)")
 
 WORKER = r"""
 import os, sys

@@ -1,0 +1,304 @@
+"""matchlint (matchmaking_tpu/analysis): seeded regression tests.
+
+Every rule gets at least one fixture-triggered POSITIVE (the acceptance
+bar: a rule that can't fire is decoration), the PR 2 await-window
+double-match pattern is proven statically caught, and the `lint`-marked
+node runs the full analyzer over the repo — matchlint wired into tier-1.
+"""
+
+import pytest
+
+from matchmaking_tpu.analysis.engine import analyze_repo, analyze_source
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+# ---- await-under-lock ------------------------------------------------------
+
+def test_await_under_lock_fires_on_non_sanctioned_await():
+    findings = analyze_source('''
+import asyncio
+
+class Runtime:
+    def __init__(self):
+        self._engine_lock = asyncio.Lock()
+
+    async def flush(self, ctx):
+        async with self._engine_lock:
+            await self.pipeline.run(ctx)
+''', path="matchmaking_tpu/service/fixture.py")
+    assert _rules(findings) == ["await-under-lock"]
+    assert findings[0].line == 10
+    assert "pipeline.run" in findings[0].message
+
+
+def test_await_under_lock_sanctions_to_thread_and_drain():
+    findings = analyze_source('''
+import asyncio
+
+class Runtime:
+    def __init__(self):
+        self._engine_lock = asyncio.Lock()
+
+    async def flush(self, window, now):
+        async with self._engine_lock:
+            await self._drain_engine(now)
+            out = await asyncio.to_thread(self.engine.search, window, now)
+        return out
+''', path="matchmaking_tpu/service/fixture.py")
+    assert findings == []
+
+
+def test_pr2_await_window_double_match_pattern_is_caught():
+    """Re-introducing PR 2's race — pool-state mutation across an await
+    inside ``_engine_lock`` (the dup delivery that passed the dedup check
+    re-admitting while its twin's window was in flight) — is caught
+    STATICALLY, without running chaos."""
+    findings = analyze_source('''
+import asyncio
+
+class Runtime:
+    def __init__(self):
+        self._engine_lock = asyncio.Lock()
+        # guarded-by: _engine_lock
+        self._recent = {}
+
+    async def dispatch(self, pairs, now):
+        async with self._engine_lock:
+            stale = {p for p, d in pairs if p in self._recent}
+            await self.broker.confirm(stale)
+            for p, _d in pairs:
+                self._recent[p] = now
+''', path="matchmaking_tpu/service/fixture.py")
+    assert "await-under-lock" in _rules(findings)
+    bad = next(f for f in findings if f.rule == "await-under-lock")
+    assert "broker.confirm" in bad.message
+
+
+# ---- guarded-by ------------------------------------------------------------
+
+GUARDED_CLASS = '''
+import asyncio
+
+class Runtime:
+    def __init__(self):
+        self._engine_lock = asyncio.Lock()
+        # guarded-by: _engine_lock
+        self._inflight_meta = {}
+
+    # holds-lock: _engine_lock
+    def _finish(self, tok):
+        self._inflight_meta.pop(tok, None)
+
+    def _collect_ready_locked(self, now):
+        self._inflight_meta.clear()
+
+    async def good(self, tok, meta):
+        async with self._engine_lock:
+            self._inflight_meta[tok] = meta
+            self._finish(tok)
+%s
+'''
+
+
+def test_guarded_by_accepts_disciplined_mutations():
+    findings = analyze_source(GUARDED_CLASS % "",
+                              path="matchmaking_tpu/service/fixture.py")
+    assert findings == []
+
+
+def test_guarded_by_collects_annotated_assignment_declarations():
+    """Regression: `self.x: T = ...` (ast.AnnAssign) must register a
+    guarded-by declaration exactly like a plain assignment — app.py's
+    `_inflight_meta` declaration is annotated."""
+    findings = analyze_source("""
+import asyncio
+
+class Runtime:
+    def __init__(self):
+        self._engine_lock = asyncio.Lock()
+        # guarded-by: _engine_lock
+        self._inflight_meta: dict[int, str] = {}
+
+    def sweep(self, tok):
+        self._inflight_meta.pop(tok, None)
+""", path="matchmaking_tpu/service/fixture.py")
+    assert _rules(findings) == ["guarded-by"]
+
+
+def test_guarded_by_flags_unlocked_mutation():
+    findings = analyze_source(GUARDED_CLASS % '''
+    def sweep(self, tok):
+        self._inflight_meta.pop(tok, None)
+''', path="matchmaking_tpu/service/fixture.py")
+    assert _rules(findings) == ["guarded-by"]
+    assert "_inflight_meta" in findings[0].message
+    assert findings[0].context == "Runtime.sweep"
+
+
+def test_guarded_by_flags_unlocked_call_to_holding_method():
+    findings = analyze_source(GUARDED_CLASS % '''
+    async def tick(self, tok):
+        self._finish(tok)
+''', path="matchmaking_tpu/service/fixture.py")
+    assert _rules(findings) == ["guarded-by"]
+    assert "_finish" in findings[0].message
+
+
+def test_guarded_by_flags_attribute_store_through_guarded_object():
+    findings = analyze_source('''
+import asyncio
+
+class Runtime:
+    def __init__(self):
+        self._engine_lock = asyncio.Lock()
+        # guarded-by: _engine_lock
+        self.engine = None
+
+    async def poke(self):
+        self.engine.device_error = None
+''', path="matchmaking_tpu/service/fixture.py")
+    assert _rules(findings) == ["guarded-by"]
+
+
+# ---- blocking-call ---------------------------------------------------------
+
+def test_blocking_call_fires_in_async_bodies_only():
+    findings = analyze_source('''
+import time
+
+async def handler(arr):
+    time.sleep(0.1)
+    f = open("/tmp/x")
+    arr.block_until_ready()
+    n = arr.item()
+
+def sync_helper():
+    time.sleep(0.1)  # worker-thread code: fine
+
+async def off_loop():
+    def run():
+        time.sleep(0.1)  # nested sync def: runs via to_thread
+    return run
+''', path="matchmaking_tpu/service/fixture.py")
+    assert _rules(findings) == ["blocking-call"] * 4
+    assert all(f.context == "handler" for f in findings)
+
+
+# ---- determinism -----------------------------------------------------------
+
+def test_determinism_flags_unseeded_rng_and_wallclock_deadlines():
+    findings = analyze_source('''
+import random
+import time
+import numpy as np
+
+def faults():
+    rng = random.Random()
+    g = np.random.default_rng()
+    x = random.random()
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        pass
+''', path="matchmaking_tpu/utils/fixture.py")
+    assert _rules(findings) == ["determinism"] * 5
+    seeded = analyze_source('''
+import random
+import time
+
+def fine():
+    rng = random.Random(42)
+    deadline = time.monotonic() + 5.0
+    return rng.random(), deadline
+''', path="matchmaking_tpu/utils/fixture.py")
+    assert seeded == []
+
+
+# ---- ignore comments -------------------------------------------------------
+
+def test_inline_ignore_with_reason_suppresses_and_bare_does_not():
+    body = '''
+import time
+
+async def handler():
+    # matchlint: ignore[blocking-call] admin endpoint, bounded one-shot
+    time.sleep(0.1)
+'''
+    assert analyze_source(body,
+                          path="matchmaking_tpu/service/fixture.py") == []
+    bare = body.replace(" admin endpoint, bounded one-shot", "")
+    findings = analyze_source(bare,
+                              path="matchmaking_tpu/service/fixture.py")
+    assert _rules(findings) == ["blocking-call"]
+
+
+# ---- recompile -------------------------------------------------------------
+
+def test_recompile_static_flags_loop_variable_capture():
+    findings = analyze_source('''
+import jax
+
+def build_steps():
+    fns = []
+    for k in range(3):
+        fns.append(jax.jit(lambda x: x * k))
+    return fns
+''', path="matchmaking_tpu/engine/kernels.py")
+    assert _rules(findings) == ["recompile"]
+    assert "'k'" in findings[0].message and "for-loop" in findings[0].message
+
+
+def test_recompile_static_accepts_factory_constants():
+    findings = analyze_source('''
+import functools
+
+import jax
+
+def kernel_factory(capacity, top_k):
+    @functools.partial(jax.jit, donate_argnums=0)
+    def step(pool, packed):
+        return pool, packed[:top_k] * capacity
+
+    return step
+''', path="matchmaking_tpu/engine/kernels.py")
+    assert findings == []
+
+
+def test_recompile_dynamic_catches_jaxpr_drift():
+    import jax.numpy as jnp
+
+    from matchmaking_tpu.analysis import recompile
+
+    calls = {"n": 0}
+
+    def drifting(x):
+        calls["n"] += 1
+        return x + calls["n"]
+
+    out = []
+    recompile._drift(drifting, lambda v: (jnp.zeros(4),), "drifting",
+                     "fixture", out)
+    assert len(out) == 1 and "jaxpr drift" in out[0].message
+
+    def stable(x):
+        return x * 2.0
+
+    out = []
+    recompile._drift(stable, lambda v: (jnp.full(4, float(v)),), "stable",
+                     "fixture", out)
+    assert out == []
+
+
+# ---- the gate itself -------------------------------------------------------
+
+@pytest.mark.lint
+def test_repo_is_clean():
+    """The tier-1 lint node: the full analyzer (static rules + jaxpr-drift
+    tracing) over the repo must report nothing outside the baseline —
+    exactly what ``python -m matchmaking_tpu.analysis`` gates in CI."""
+    new, _accepted, warnings = analyze_repo()
+    assert not warnings, "\n".join(warnings)
+    assert not new, "matchlint findings:\n" + "\n".join(
+        f.render() for f in new)

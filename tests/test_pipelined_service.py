@@ -40,8 +40,8 @@ async def test_two_windows_in_flight(monkeypatch):
     client = MatchmakingClient(app.broker, "matchmaking.search")
     handles = [client.submit({"id": f"p{i}", "rating": 1500 + 7 * i})
                for i in range(8)]  # 2 full windows of 4
-    deadline = time.time() + 10.0
-    while time.time() < deadline and (rt.engine.inflight() < 2
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline and (rt.engine.inflight() < 2
                                       or len(rt._inflight_meta) < 2):
         await asyncio.sleep(0.005)
     assert rt.engine.inflight() >= 2, (
@@ -54,8 +54,8 @@ async def test_two_windows_in_flight(monkeypatch):
     for h in handles:
         resp = await client.next_response(h, timeout=15.0)
         assert resp.status in ("queued", "matched")
-    deadline = time.time() + 10.0
-    while time.time() < deadline and rt.engine.inflight() > 0:
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline and rt.engine.inflight() > 0:
         await asyncio.sleep(0.005)
     assert rt.engine.inflight() == 0
     assert not rt._inflight_meta
@@ -76,8 +76,8 @@ async def test_pipelined_e2e_matches_and_acks():
                                       + (i % 2) * 10})
     matched = set()
     for pid, h in handles.items():
-        deadline = time.time() + 15.0
-        while time.time() < deadline:
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
             resp = await client.next_response(h, timeout=15.0)
             if resp.status == "matched":
                 matched.add(pid)
@@ -129,8 +129,8 @@ async def test_team_queue_windows_pipeline_and_overlap_1v1(monkeypatch):
         handles[f"t{i}"] = client.submit(
             {"id": f"t{i}", "rating": 1500 + 5 * i, "region": "eu",
              "game_mode": "ranked"}, queue="mm.team")
-    deadline = time.time() + 10.0
-    while time.time() < deadline and not (
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline and not (
             rt_solo.engine.inflight() >= 2 and rt_team.engine.inflight() >= 2):
         await asyncio.sleep(0.005)
     assert rt_solo.engine.inflight() >= 2, rt_solo.engine.inflight()
@@ -166,13 +166,13 @@ async def test_failed_window_nacks_and_revives(monkeypatch):
     # survive the revive.
     a = client.submit({"id": "alice", "rating": 1500})
     b = client.submit({"id": "bob", "rating": 2500})
-    deadline = time.time() + 10.0
-    while time.time() < deadline and failed["n"] == 0:
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline and failed["n"] == 0:
         await asyncio.sleep(0.01)
     assert failed["n"] == 1
     # Wait for the revive to land (engine object replaced).
-    deadline = time.time() + 10.0
-    while time.time() < deadline and app.metrics.counters.get("engine_crashes") == 0:
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline and app.metrics.counters.get("engine_crashes") == 0:
         await asyncio.sleep(0.01)
     # Follow-up traffic matches against the revived pool.
     c = client.submit({"id": "carol", "rating": 1505})

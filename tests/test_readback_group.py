@@ -94,8 +94,8 @@ def test_partial_group_seals_on_collect():
     rng = np.random.default_rng(5)
     tok = engine.search_columns_async(_cols(rng, 16, 0), 1.0)
     got = []
-    deadline = time.time() + 30.0
-    while not got and time.time() < deadline:
+    deadline = time.monotonic() + 30.0
+    while not got and time.monotonic() < deadline:
         time.sleep(0.002)
         got = engine.collect_ready()
     assert got and got[0][0] == tok

@@ -1,0 +1,146 @@
+"""Runtime async sanitizer (matchmaking_tpu/testing/sanitizer.py): the
+deliberate-violation tests. Each detector gets a planted positive asserted
+WITH file:line attribution, plus the sanctioned-path negative that keeps
+the soak fixture viable (to_thread under the engine lock is the design,
+not a bug)."""
+
+import asyncio
+import inspect
+import time
+
+from matchmaking_tpu.testing.sanitizer import AsyncSanitizer
+
+THIS_FILE = "test_sanitizer.py"
+
+
+def test_lock_order_inversion_reported_with_both_sites():
+    san = AsyncSanitizer(stall_threshold_s=60.0)
+
+    async def main():
+        lock_a = asyncio.Lock()
+        lock_b = asyncio.Lock()
+        async with lock_a:
+            async with lock_b:
+                pass
+        async with lock_b:
+            async with lock_a:  # reverse order: the planted inversion
+                pass
+
+    with san.installed():
+        asyncio.run(main())
+    inversions = [f for f in san.findings
+                  if f.kind == "lock-order-inversion"]
+    assert len(inversions) == 1, san.findings
+    msg = inversions[0].message
+    # Both acquisition orders are cited with file:line.
+    assert msg.count(THIS_FILE) >= 3, msg
+    assert "REVERSE order" in msg
+
+
+def test_await_under_lock_reported_with_await_site():
+    san = AsyncSanitizer(stall_threshold_s=60.0)
+    await_line = {}
+
+    async def main():
+        lock = asyncio.Lock()
+        async with lock:
+            await_line["n"] = inspect.currentframe().f_lineno + 1
+            await asyncio.sleep(0.05)  # planted non-sanctioned suspension
+
+    with san.installed():
+        asyncio.run(main())
+    awaits = [f for f in san.findings if f.kind == "await-under-lock"]
+    assert len(awaits) == 1, san.findings
+    msg = awaits[0].message
+    assert f"{THIS_FILE}:{await_line['n']}" in msg.replace("tests/", ""), msg
+    assert "to_thread" in msg  # the fix is named in the report
+
+
+def test_to_thread_under_lock_is_sanctioned():
+    """The service's designed seam — engine work via asyncio.to_thread with
+    the engine lock held — must NOT report (otherwise the soak fixture
+    would reject the architecture it is guarding)."""
+    san = AsyncSanitizer(stall_threshold_s=60.0)
+
+    async def main():
+        lock = asyncio.Lock()
+        async with lock:
+            await asyncio.to_thread(time.sleep, 0.05)
+
+    with san.installed():
+        asyncio.run(main())
+    assert [f for f in san.findings if f.kind == "await-under-lock"] == []
+
+
+def test_loop_stall_detector_reports_blocking_callback():
+    san = AsyncSanitizer(stall_threshold_s=0.1, stall_interval_s=0.02)
+
+    async def main():
+        # The watchdog starts lazily on the first instrumented acquire.
+        lock = asyncio.Lock()
+        async with lock:
+            pass
+        await asyncio.sleep(0.05)
+        time.sleep(0.3)  # planted on-loop blocking work
+        await asyncio.sleep(0.05)
+
+    with san.installed():
+        asyncio.run(main())
+    stalls = [f for f in san.findings if f.kind == "loop-stall"]
+    assert stalls, san.findings
+    assert "ms" in stalls[0].message
+
+
+def test_assert_clean_raises_with_findings_and_passes_clean():
+    san = AsyncSanitizer(stall_threshold_s=60.0)
+
+    async def dirty():
+        lock = asyncio.Lock()
+        async with lock:
+            await asyncio.sleep(0.05)
+
+    with san.installed():
+        asyncio.run(dirty())
+    try:
+        san.assert_clean()
+    except AssertionError as e:
+        assert "await-under-lock" in str(e)
+    else:  # pragma: no cover - the planted finding must raise
+        raise AssertionError("assert_clean passed with findings")
+
+    clean = AsyncSanitizer(stall_threshold_s=60.0)
+
+    async def fine():
+        lock = asyncio.Lock()
+        async with lock:
+            pass
+
+    with clean.installed():
+        asyncio.run(fine())
+    clean.assert_clean()
+
+
+def test_stall_detector_installs_on_consecutive_event_loops():
+    """Regression: CPython reuses event-loop object ids across consecutive
+    asyncio.run calls; the watchdog registry must key on live loop objects
+    or the second run is silently unwatched."""
+    san = AsyncSanitizer(stall_threshold_s=0.1, stall_interval_s=0.02)
+
+    async def quiet():
+        lock = asyncio.Lock()
+        async with lock:
+            pass
+        await asyncio.sleep(0.05)
+
+    async def stalling():
+        lock = asyncio.Lock()
+        async with lock:
+            pass
+        await asyncio.sleep(0.05)
+        time.sleep(0.3)
+        await asyncio.sleep(0.05)
+
+    with san.installed():
+        asyncio.run(quiet())     # first loop: no stall
+        asyncio.run(stalling())  # second loop must still be watched
+    assert [f for f in san.findings if f.kind == "loop-stall"], san.findings

@@ -33,7 +33,7 @@ import pytest
 
 @pytest.mark.chaos
 @pytest.mark.parametrize("readback_group", [1, 3])
-def test_soak_faulty_broker_no_double_match(readback_group):
+def test_soak_faulty_broker_no_double_match(readback_group, sanitizer):
     """readback_group=3 additionally soaks the grouped-readback transfer
     path (full stacks, loose stale seals, flush force-seals) under the same
     drop/dup fault injection and pipelined service flushes."""
@@ -109,7 +109,7 @@ def test_soak_faulty_broker_no_double_match(readback_group):
     asyncio.run(run())
 
 
-def test_soak_multi_queue_isolation():
+def test_soak_multi_queue_isolation(sanitizer):
     """Two queues with separate engines: traffic on both, no cross-talk."""
     async def run():
         qa = QueueConfig(name="mm.a", rating_threshold=100.0)
@@ -158,7 +158,7 @@ def test_soak_multi_queue_isolation():
 
 
 @pytest.mark.chaos
-def test_soak_role_queue_faulty_broker():
+def test_soak_role_queue_faulty_broker(sanitizer):
     """Role-queue soak (config #5 device path): seeded drop/dup chaos,
     role'd solo traffic, overlapped rescans, invariants armed — the device
     cover/split kernel under the same at-least-once chaos the 1v1 soak
