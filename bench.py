@@ -412,6 +412,7 @@ def bench_e2e(args) -> dict:
             BrokerConfig,
             Config,
             EngineConfig,
+            OverloadConfig,
             QueueConfig,
         )
         from matchmaking_tpu.service.app import MatchmakingApp
@@ -429,6 +430,11 @@ def bench_e2e(args) -> dict:
                 warm_start=True),
             batcher=BatcherConfig(max_batch=args.window, max_wait_ms=3.0),
             broker=BrokerConfig(prefetch=max(8 * args.window, 4096)),
+            # Overload mode (ISSUE 5): bound the waiting pool so the
+            # saturation sweep measures ADMITTED-request latency under an
+            # honest shed policy instead of unbounded queueing collapse.
+            overload=(OverloadConfig(max_waiting=args.e2e_max_waiting)
+                      if args.e2e_max_waiting > 0 else OverloadConfig()),
         )
         app = MatchmakingApp(cfg)
         await app.start()
@@ -513,6 +519,12 @@ def bench_e2e(args) -> dict:
             isn't woken per message on this 1-core host."""
             lat_ms.clear()
             match_ids.clear()
+            # Per-PHASE shed accounting: the counters are app-lifetime
+            # monotone and every sweep row shares this app — absolute
+            # reads would fold the headline + earlier rows' sheds into
+            # each later row.
+            shed0 = app.metrics.counters.get("shed_requests")
+            expired0 = app.metrics.counters.get("expired_requests")
             ratings = rng.normal(1500.0, 300.0,
                                  size=int(rate * duration * 2) + 16)
             gaps = rng.exponential(1.0 / rate, size=ratings.size)
@@ -556,7 +568,7 @@ def bench_e2e(args) -> dict:
                     "later rows may be contaminated")
             arr = (np.sort(np.asarray(lat_ms)) if lat_ms
                    else np.array([0.0]))
-            return {
+            row = {
                 "e2e_offered_req_s": rate,
                 "e2e_requests": i,
                 "e2e_rate_req_s": round(i / span, 1),
@@ -568,6 +580,12 @@ def bench_e2e(args) -> dict:
                 "e2e_drained": drained,
                 "e2e_pool_end": rt.engine.pool_size(),
             }
+            if args.e2e_max_waiting > 0:
+                row["e2e_shed"] = int(
+                    app.metrics.counters.get("shed_requests") - shed0)
+                row["e2e_expired"] = int(
+                    app.metrics.counters.get("expired_requests") - expired0)
+            return row
 
         headline = await poisson(float(args.e2e_rate),
                                  float(args.e2e_seconds), "h")
@@ -647,6 +665,7 @@ def bench_multiproc(args) -> dict:
             extra[i] = {
                 "MM_LOADGEN_RATE": str(args.mp_rate),
                 "MM_LOADGEN_SECONDS": str(args.mp_seconds),
+                "MM_LOADGEN_DEADLINE_MS": str(args.mp_deadline_ms),
                 "MM_LOADGEN_OUT": path,
                 "JAX_PLATFORMS": "cpu",
             }
@@ -871,6 +890,11 @@ def main() -> None:
                    help="comma-separated offered rates for the saturation "
                         "sweep (finds the single-process knee); empty "
                         "string skips the sweep")
+    p.add_argument("--e2e-max-waiting", type=int, default=0,
+                   help="overload mode: bound the e2e phase's waiting pool "
+                        "(OverloadConfig.max_waiting) so the saturation "
+                        "sweep measures admitted-request latency under "
+                        "explicit shedding (0 = unbounded, the default)")
     p.add_argument("--e2e-sweep-seconds", type=float, default=4.0,
                    help="duration of each saturation-sweep step")
     p.add_argument("--skip-multiproc", action="store_true",
@@ -880,6 +904,9 @@ def main() -> None:
                         "(above the ~77k/s single-process ceiling so the "
                         "phase measures saturation, not the offered rate)")
     p.add_argument("--mp-seconds", type=float, default=4.0)
+    p.add_argument("--mp-deadline-ms", type=float, default=0.0,
+                   help="stamp x-deadline on every multiproc loadgen "
+                        "request (overload mode; 0 = off)")
     p.add_argument("--latency", action="store_true",
                    help="latency mode: small window, depth 1, grouping "
                         "off — reports the tunnel-floor-bounded measured "

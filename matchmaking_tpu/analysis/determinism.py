@@ -16,7 +16,14 @@ silently reintroduce the flake class PR 2 eliminated:
   timeout behavior irreproducible; ``time.monotonic()`` is the tool
   (app.py's rescan deadline already uses it). ``time.time()`` for
   TIMESTAMPS (trace marks, enqueue times, TTLs) is correct and not
-  flagged — only deadline arithmetic is.
+  flagged — only deadline arithmetic is. The overload subsystem's
+  deadline PROPAGATION (service/overload.py) widened the surface, so
+  the rule covers the new shapes too: subscript stores whose key names
+  a deadline (``headers["x-deadline"] = time.time() + n`` — the header
+  must be stamped through ``overload.stamp_deadline(headers, now, n)``,
+  which takes the one wall-clock read as a parameter), ``deadline +=
+  time.time()`` aug-assigns, and ``f(deadline=time.time() + n)`` keyword
+  arguments.
 """
 
 from __future__ import annotations
@@ -67,6 +74,13 @@ def _name_contains_deadline(node: ast.AST) -> bool:
         return "deadline" in node.id.lower()
     if isinstance(node, ast.Attribute):
         return "deadline" in node.attr.lower()
+    if isinstance(node, ast.Subscript):
+        # headers["x-deadline"] = ... — the deadline-propagation header
+        # store (service/overload.py) and any dict-carried deadline.
+        key = node.slice
+        if isinstance(key, ast.Constant) and isinstance(key.value, str):
+            return "deadline" in key.value.lower()
+        return _name_contains_deadline(node.value)
     return False
 
 
@@ -102,6 +116,18 @@ class _Scanner(ast.NodeVisitor):
                 f"unseeded {name}(): seed it explicitly so runs replay "
                 f"bit-identically",
                 self._ctx()))
+        for kw in node.keywords:
+            # f(deadline=time.time() + n): the deadline is born from the
+            # wall clock at the call site — pass `now` through and derive
+            # inside (overload.stamp_deadline is the sanctioned shape).
+            if (kw.arg is not None and "deadline" in kw.arg.lower()
+                    and _contains_time_time(kw.value) is not None):
+                self.findings.append(Finding(
+                    RULE, self.sf.path, node.lineno,
+                    f"keyword {kw.arg}=... computed from time.time(): wall "
+                    f"clocks step (NTP) — take `now` as a parameter "
+                    f"(overload.stamp_deadline) or use time.monotonic()",
+                    self._ctx()))
         self.generic_visit(node)
 
     def visit_Assign(self, node: ast.Assign) -> None:
@@ -113,6 +139,16 @@ class _Scanner(ast.NodeVisitor):
                     "deadline computed from time.time(): wall clocks step "
                     "(NTP) — use time.monotonic() for deadlines",
                     self._ctx()))
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        if (_name_contains_deadline(node.target)
+                and _contains_time_time(node.value) is not None):
+            self.findings.append(Finding(
+                RULE, self.sf.path, node.lineno,
+                "deadline adjusted from time.time(): wall clocks step "
+                "(NTP) — use time.monotonic() for deadlines",
+                self._ctx()))
         self.generic_visit(node)
 
     def visit_Compare(self, node: ast.Compare) -> None:

@@ -291,6 +291,80 @@ class ChaosConfig:
 
 
 @dataclass(frozen=True)
+class OverloadConfig:
+    """End-to-end overload control (service/overload.py): per-queue
+    admission control, deadline propagation, adaptive load shedding, and
+    graceful drain/handoff. The reference's survival story under load is
+    RabbitMQ buffering — queues grow without bound and clients that gave
+    up keep consuming engine windows; this subsystem bounds the queue in
+    front of the matcher and is honest about rejection (explicit ``shed``
+    responses with retry-after hints, never silent rot).
+
+    Every knob is deterministic: admission decisions are pure functions of
+    the controller's credit/pool counts at the decision point, so a chaos
+    soak with burst ingress replays bit-identically (tests/test_overload).
+    """
+
+    #: Token/credit limiter: max admitted-but-unsettled deliveries per
+    #: queue (a credit is held from admission until the delivery's ack or
+    #: nack). 0 = unlimited. Also bounds the broker consumer's prefetch.
+    max_inflight: int = 0
+    #: Max waiting-pool occupancy counted at admission (live pool size +
+    #: admitted credits on their way into it). 0 = unlimited.
+    max_waiting: int = 0
+    #: What to shed when the waiting cap is hit: ``"reject"`` sheds the
+    #: INCOMING request (cheapest — nothing decoded, nothing dispatched);
+    #: ``"oldest"`` admits it and sheds the longest-waiting pool player
+    #: instead (freshness-biased queues, e.g. quick-play).
+    shed_policy: str = "reject"
+    #: Retry-after hint (ms) carried on shed responses — clients back off
+    #: instead of hammering an overloaded queue.
+    retry_after_ms: float = 1000.0
+    #: Deadline propagation: requests arriving WITHOUT an ``x-deadline``
+    #: header get one stamped at admission, first-received + this budget
+    #: (0 = don't stamp; client-stamped deadlines are always honored).
+    #: Deadlines are checked at admission, batch formation, and
+    #: pre-dispatch — an expired request is cancelled (``timeout``
+    #: response, ``expired`` trace mark) before any device work is spent.
+    #: Transport caveat (same as ``x-first-received``): consumer-side
+    #: stamps survive redelivery on the in-proc broker (the Delivery
+    #: object is reused) but NOT over real AMQP, where a nack-requeue
+    #: redelivers the originally PUBLISHED headers — a crash-looping
+    #: request then gets a fresh budget per attempt. Clients that need a
+    #: hard end-to-end deadline over AMQP must stamp it themselves at
+    #: publish (``MatchmakingClient.submit(deadline_s=...)``), which is
+    #: immune: publish-time headers do survive the wire and redelivery.
+    default_deadline_ms: float = 0.0
+    #: Adaptive shedding: tighten the credit limit from live signals
+    #: (pipeline occupancy, batch fill, per-stage p99) so the limiter
+    #: reacts BEFORE the circuit breaker trips.
+    adaptive: bool = False
+    #: Adaptive target: when the queue's end-to-end stage p99 exceeds this,
+    #: the effective credit limit is multiplied by ``tighten_step``; when
+    #: p99 falls below half the target and the pipeline has headroom it is
+    #: relaxed by ``relax_step`` (clamped to [min_credit_fraction, 1.0]).
+    target_p99_ms: float = 250.0
+    min_credit_fraction: float = 0.25
+    tighten_step: float = 0.5
+    relax_step: float = 1.25
+    #: Graceful drain/handoff: SIGTERM (service.app.serve) stops admission,
+    #: drains in-flight windows, and checkpoints every queue's waiting pool
+    #: into this directory (utils/checkpoint.py); a restarted app restores
+    #: it — zero waiting players lost. "" = drain without checkpointing.
+    drain_checkpoint_dir: str = ""
+
+    def enabled(self) -> bool:
+        """Any admission/deadline/drain machinery configured? The ingress
+        hot path pays zero per-delivery overhead when False.
+        ``drain_checkpoint_dir`` alone counts: the drain sequence needs a
+        controller to flip into shed-everything mode (and /healthz needs
+        it to report ``draining``) even when no cap is set."""
+        return bool(self.max_inflight > 0 or self.max_waiting > 0
+                    or self.default_deadline_ms > 0 or self.adaptive
+                    or self.drain_checkpoint_dir)
+
+
+@dataclass(frozen=True)
 class ObservabilityConfig:
     """Request-lifecycle flight recorder + debug surfaces (utils/trace.py,
     service/observability.py). The BASELINE north star asserts a p99;
@@ -361,6 +435,9 @@ class Config:
     #: Deterministic fault-injection schedule (off by default — every field
     #: zero/empty means no chaos plumbing is touched on the hot path).
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
+    #: Admission control / load shedding / deadline propagation / graceful
+    #: drain (off by default — see OverloadConfig.enabled()).
+    overload: OverloadConfig = field(default_factory=OverloadConfig)
     #: Flight recorder / debug endpoints (tracing on by default).
     observability: ObservabilityConfig = field(
         default_factory=ObservabilityConfig)
@@ -394,6 +471,7 @@ class Config:
             ("batcher", BatcherConfig),
             ("auth", AuthConfig),
             ("chaos", ChaosConfig),
+            ("overload", OverloadConfig),
             ("observability", ObservabilityConfig),
         ):
             if name in d:

@@ -56,6 +56,13 @@ from matchmaking_tpu.utils.trace import TraceContext
 #: their ``enqueue`` stage always read 0.
 TRACE_HEADER = "x-trace-enqueue"
 
+#: Message header carrying the chaos publish-sequence number (ROADMAP PR 2
+#: follow-up — chaos schedules for the AMQP transport): fault decisions are
+#: pure functions of (seed, queue, seq, attempt), and over a real wire the
+#: seq must ride the message itself or a reconnect would desynchronize the
+#: replay. Same identity scheme as the in-proc broker's ``Delivery.seq``.
+CHAOS_SEQ_HEADER = "x-chaos-seq"
+
 #: Delivery-tag generation packing: low 48 bits are the broker's channel
 #: tag (a per-channel counter — 2^48 deliveries per connection incarnation
 #: is unreachable), high bits the consumer's connection generation.
@@ -123,12 +130,32 @@ class AmqpBroker:
         self.stats = {"published": 0, "acked": 0, "dead_lettered": 0,
                       "consumer_errors": 0, "unroutable": 0,
                       "reconnects": 0, "consumer_reconnects": 0,
-                      "stale_acks": 0}
+                      "stale_acks": 0, "dropped": 0, "duplicated": 0,
+                      "partitions": 0}
         #: Trace stamping via message headers (see TRACE_HEADER); the app
         #: mirrors ObservabilityConfig.trace/trace_sample_n onto these.
         self.trace_enabled = True
         self.trace_sample_n = 1
         self._trace_count = 0
+        #: Deterministic chaos schedule (utils/chaos.ChaosState) + event
+        #: log, attached by the app after construction — same wiring seam
+        #: as the in-proc broker, closing the PR 2 follow-up ("chaos
+        #: schedules for the AMQP transport"). Faults emulated at the
+        #: adapter layer: consume-side drops nack-requeue before the
+        #: callback (a consumer crash, as AMQP would replay it), publish
+        #: dups publish extra copies, partitions gate each queue's
+        #: consumer thread (deliveries buffer broker-side meanwhile).
+        self.chaos: Any = None
+        self.events: Any = None
+        self._chaos_lock = threading.Lock()
+        #: Per-queue publish seq counters (publish side, event loop) and
+        #: per-(queue, seq) attempt counters (consume side, consumer
+        #: threads) — both under _chaos_lock, both only touched when a
+        #: schedule is attached.
+        self._pub_seq: dict[str, int] = {}
+        self._attempts: dict[tuple[str, int], int] = {}
+        #: Partition gates: set = flowing, cleared = paused.
+        self._gates: dict[str, threading.Event] = {}
         with self._lock:
             self._connect_locked()
 
@@ -201,16 +228,84 @@ class AmqpBroker:
         if stamp:
             headers = dict(headers or {})
             headers[TRACE_HEADER] = repr(time.time())
+        chaos = self.chaos
+        seq = -1
+        if chaos is not None and chaos.applies(queue):
+            with self._chaos_lock:
+                seq = self._pub_seq.get(queue, 0)
+                self._pub_seq[queue] = seq + 1
+            headers = dict(headers or {})
+            headers[CHAOS_SEQ_HEADER] = seq
         props = self._pika.BasicProperties(
             reply_to=properties.reply_to if properties else None,
             correlation_id=properties.correlation_id if properties else None,
             headers=headers,
         )
+        action = (chaos.partition_action(queue, seq)
+                  if chaos is not None and seq >= 0 else None)
+        if action == "pause":
+            # Gate shut BEFORE the pause-seq message reaches the broker:
+            # the consumer runs on its own thread, and pausing after the
+            # publish races it — the partitioned delivery could slip past
+            # the gate check, making chaos replay order nondeterministic.
+            # (The in-proc broker gets this ordering for free: its pause
+            # runs on the same event loop before any consumer task can.)
+            self._pause(queue)
         # At-least-once: a retried publish after a mid-op drop may
         # duplicate; consumers dedupe by player id / correlation id.
         self._with_channel(lambda ch: ch.basic_publish(
             exchange="", routing_key=queue, body=body, properties=props))
         self.stats["published"] += 1
+        if chaos is None or seq < 0:
+            return
+        # Scripted/seeded redelivery storms: extra copies carry their OWN
+        # seqs (distinct deliveries for drop accounting — in-proc parity)
+        # but are never re-evaluated for duplication, so storms can't
+        # cascade.
+        n_copies = chaos.dup_copies(queue, seq)
+        if n_copies and self.events is not None:
+            self.events.append("chaos_dup", queue,
+                               f"seq {seq} +{n_copies} copies")
+        for _ in range(n_copies):
+            with self._chaos_lock:
+                cseq = self._pub_seq[queue]
+                self._pub_seq[queue] = cseq + 1
+            dup_headers = dict(headers or {})
+            dup_headers[CHAOS_SEQ_HEADER] = cseq
+            dup_props = self._pika.BasicProperties(
+                reply_to=props.reply_to, correlation_id=props.correlation_id,
+                headers=dup_headers)
+            self._with_channel(lambda ch: ch.basic_publish(
+                exchange="", routing_key=queue, body=body,
+                properties=dup_props))
+            self.stats["duplicated"] += 1
+        if action == "resume":
+            self._resume(queue)
+
+    # ---- chaos partitions (gate the consumer thread) ----------------------
+
+    def _gate(self, queue: str) -> threading.Event:
+        with self._chaos_lock:
+            gate = self._gates.get(queue)
+            if gate is None:
+                gate = self._gates[queue] = threading.Event()
+                gate.set()
+            return gate
+
+    def _pause(self, queue: str) -> None:
+        gate = self._gate(queue)
+        if gate.is_set():
+            gate.clear()
+            self.stats["partitions"] += 1
+            if self.events is not None:
+                self.events.append("partition_pause", queue)
+
+    def _resume(self, queue: str) -> None:
+        gate = self._gate(queue)
+        if not gate.is_set():
+            gate.set()
+            if self.events is not None:
+                self.events.append("partition_resume", queue)
 
     # ---- consuming --------------------------------------------------------
 
@@ -259,6 +354,44 @@ class AmqpBroker:
                 def on_message(ch, method, props, body,
                                _gen=generation, _q=consumer.queue):
                     headers = dict(props.headers or {})
+                    chaos = self.chaos
+                    seq = -1
+                    if chaos is not None:
+                        # Chaos partition: the queue's consumer thread
+                        # pauses here (deliveries buffer broker-side) until
+                        # the scripted resume publish opens the gate or the
+                        # failsafe timeout expires — a mis-scripted
+                        # schedule must not wedge the consumer forever.
+                        gate = self._gate(_q)
+                        if not gate.is_set():
+                            max_s = chaos.cfg.partition_max_s
+                            if not gate.wait(timeout=max_s if max_s > 0
+                                             else None):
+                                self._resume(_q)
+                        try:
+                            seq = int(headers.get(CHAOS_SEQ_HEADER, -1))
+                        except (TypeError, ValueError):
+                            seq = -1
+                    if chaos is not None and seq >= 0:
+                        with self._chaos_lock:
+                            attempt = self._attempts.get((_q, seq), 0)
+                        if chaos.should_drop(_q, seq, attempt):
+                            # Consume-side drop: the "consumer crashed
+                            # before processing" fault — nack-requeue, as
+                            # AMQP replays a dead channel's unacked
+                            # deliveries. Attempt counters live host-side
+                            # (the wire has no redelivery count), advanced
+                            # only on injected drops so the identity
+                            # matches the in-proc broker's.
+                            with self._chaos_lock:
+                                self._attempts[(_q, seq)] = attempt + 1
+                            self.stats["dropped"] += 1
+                            if self.events is not None:
+                                self.events.append(
+                                    "chaos_drop", _q,
+                                    f"seq {seq} attempt {attempt}")
+                            ch.basic_nack(method.delivery_tag, requeue=True)
+                            return
                     # Rebuild the publish-time trace from the header stamp
                     # (only stamped messages get a context — sample-N is
                     # decided at publish, so an unstamped delivery stays

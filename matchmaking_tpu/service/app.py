@@ -40,11 +40,27 @@ from matchmaking_tpu.service.middleware import (
     columnar_pipeline,
     default_pipeline,
 )
+from matchmaking_tpu.service.overload import (
+    ADMIT,
+    EXPIRED,
+    AdmissionController,
+    deadline_of,
+)
 from matchmaking_tpu.utils.chaos import ChaosState
 from matchmaking_tpu.utils.metrics import Metrics
 from matchmaking_tpu.utils.trace import EventLog, FlightRecorder, TraceContext
 
 log = logging.getLogger(__name__)
+
+
+def _body_with_trace_id(body: bytes, trace_id: str) -> bytes:
+    """Splice ``"trace_id": ...`` into an already-encoded JSON response
+    body (the native batch encoder builds matched bodies in C and knows
+    nothing of tracing; re-encoding in Python would forfeit the batch win
+    for every response, this costs one concat for the traced few)."""
+    import json
+
+    return body[:-1] + b',"trace_id":' + json.dumps(trace_id).encode() + b"}"
 
 
 class _QueueRuntime:
@@ -99,14 +115,34 @@ class _QueueRuntime:
         # verbatim — a player always sees a self-consistent response.
         self._recent: dict[str, tuple[bytes, float]] = {}
         self._next_prune = 0.0
+        #: Overload admission control (service/overload.py): credit
+        #: limiter + deadline gate + adaptive shedding. None when no
+        #: OverloadConfig knob is set — the ingress path then pays nothing.
+        self.admission: AdmissionController | None = (
+            AdmissionController(app.cfg.overload, queue_cfg.name,
+                                app.metrics, app.events)
+            if app.cfg.overload.enabled() else None)
+        #: Previous "total"-stage histogram snapshot (counts, overflow,
+        #: count) for the adaptive limiter's per-window DELTA p99 — the
+        #: lifetime-cumulative histogram would tighten on stale history
+        #: (a startup compile spike) and take half of forever to relax.
+        self._stage_total_prev: tuple[list[int], int, int] | None = None
         # batch_hint: _on_delivery is non-blocking for auth modes other
         # than "rpc" (decode defers to the batched codec; static/none auth
         # never awaits), so the broker may drain bursts into one handler
         # task. RPC auth keeps per-delivery tasks — its round trips must
         # overlap up to prefetch (the GenServer-pool parallelism analog).
+        # With admission control on, prefetch must keep headroom ABOVE the
+        # credit cap: admitted deliveries hold a prefetch slot until their
+        # window settles, and if the two bounds were equal the excess load
+        # would rot unacked in the broker instead of flowing through
+        # admission to be shed with an explicit response.
+        prefetch = app.cfg.broker.prefetch
+        if app.cfg.overload.max_inflight > 0:
+            prefetch = max(prefetch, 2 * app.cfg.overload.max_inflight)
         self.consumer_tag = app.broker.basic_consume(
             queue_cfg.name, self._on_delivery,
-            prefetch=app.cfg.broker.prefetch,
+            prefetch=prefetch,
             batch_hint=app.cfg.auth.mode != "rpc",
         )
         self._sweeper: asyncio.Task | None = None
@@ -247,8 +283,50 @@ class _QueueRuntime:
         m = self.app.metrics
         q = self.queue_cfg.name
         m.observe_stage(q, "batch_window", age_s)
-        m.set_gauge(f"batch_fill[{q}]",
-                    size / max(1, self.app.cfg.batcher.max_batch))
+        fill = size / max(1, self.app.cfg.batcher.max_batch)
+        m.set_gauge(f"batch_fill[{q}]", fill)
+        if self.admission is not None and self.app.cfg.overload.adaptive:
+            # Adaptive shedding feeds on the signals the service already
+            # exports: batch fill (this hook), pipeline occupancy, and the
+            # per-queue stage p99 from the PR 3 histograms — the limiter
+            # tightens BEFORE the circuit breaker trips. Once per cut
+            # window, a deterministic point in the ingress sequence. The
+            # p99 is over the DELTA since the previous window (the
+            # histogram is lifetime-cumulative; tightening on all-time
+            # history would hold the limiter down long after recovery).
+            depth = self.app.cfg.engine.pipeline_depth
+            pipeline_frac = (self.engine.inflight() / depth
+                             if depth > 0 and hasattr(self.engine, "inflight")
+                             else 0.0)
+            hist = m.stages.get(q, {}).get("total")
+            self.admission.observe_window(fill, pipeline_frac,
+                                          self._delta_p99(hist))
+
+    def _delta_p99(self, hist) -> float | None:
+        """p99 (bucket upper edge) of the "total"-stage observations that
+        settled SINCE the previous window cut — a sliding signal built by
+        differencing cumulative histogram snapshots. None when no trace
+        settled in the interval (the limiter then judges on occupancy
+        signals alone)."""
+        if hist is None:
+            return None
+        prev = self._stage_total_prev
+        cur = (list(hist.counts), hist.overflow, hist.count)
+        self._stage_total_prev = cur
+        if prev is None:
+            prev = ([0] * len(cur[0]), 0, 0)
+        n = cur[2] - prev[2]
+        if n <= 0:
+            return None
+        import math
+
+        rank = max(1, math.ceil(0.99 * n))
+        cum = 0
+        for edge, c0, c1 in zip(hist.buckets, prev[0], cur[0]):
+            cum += c1 - c0
+            if cum >= rank:
+                return edge
+        return hist.buckets[-1] if hist.buckets else None
 
     def _trace(self, delivery: Delivery) -> "TraceContext | None":
         """The delivery's trace, created lazily for transports that don't
@@ -314,6 +392,78 @@ class _QueueRuntime:
             if d.trace is not None:
                 d.trace.extend(marks)
 
+    # ---- settle + admission (overload control) ----------------------------
+
+    def _ack(self, delivery: Delivery) -> None:
+        """Ack + release the delivery's admission credit. EVERY runtime
+        settle path comes through here (or _nack): the credit limiter's
+        inflight count is exactly the deliveries admitted but unsettled,
+        and a leaked credit would tighten admission forever."""
+        self.app.broker.ack(self.consumer_tag, delivery.delivery_tag)
+        if self.admission is not None:
+            self.admission.release(delivery.delivery_tag)
+
+    def _nack(self, delivery: Delivery, requeue: bool = True) -> None:
+        """Nack twin of _ack. The credit is released even on requeue: the
+        redelivery re-enters through admission and takes a fresh credit
+        (or a shed/expired response, if the queue tightened meanwhile)."""
+        self.app.broker.nack(self.consumer_tag, delivery.delivery_tag,
+                             requeue=requeue)
+        if self.admission is not None:
+            self.admission.release(delivery.delivery_tag)
+
+    def _shed_delivery(self, delivery: Delivery) -> None:
+        """Explicit rejection under overload: a ``shed`` response with a
+        retry-after hint, acked — never silent rot in an unbounded queue.
+        Runs BEFORE decode (nothing is spent on a request we won't serve),
+        so player_id is unknown; clients correlate by correlation_id."""
+        assert self.admission is not None
+        tr = self._trace(delivery)
+        if tr is not None:
+            tr.mark("shed")
+        self.admission.record_shed(
+            f"inflight={self.admission.inflight()} "
+            f"pool={self.engine.pool_size()}")
+        self._respond_raw(
+            delivery.properties.reply_to, delivery.properties.correlation_id,
+            SearchResponse(
+                status="shed", player_id="",
+                retry_after_ms=self.app.cfg.overload.retry_after_ms,
+                trace_id=tr.trace_id if tr is not None else ""))
+        self._ack(delivery)
+        if tr is not None:
+            self._settle_trace(delivery, "shed")
+
+    def _expire_delivery(self, delivery: Delivery, now: float,
+                         player_id: str = "") -> None:
+        """Deadline-expired: cancel without dispatch. The ``expired`` trace
+        mark with NO ``dispatch`` mark after it is the auditable proof no
+        device work was spent on a client that already gave up."""
+        tr = self._trace(delivery)
+        if tr is not None:
+            if player_id:
+                tr.player_id = player_id
+            tr.mark("expired", now)
+        if self.admission is not None:
+            self.admission.record_expired(
+                f"player={player_id or '?'} tag={delivery.delivery_tag}")
+        self._respond_raw(
+            delivery.properties.reply_to, delivery.properties.correlation_id,
+            SearchResponse(status="timeout", player_id=player_id,
+                           trace_id=tr.trace_id if tr is not None else ""))
+        self._ack(delivery)
+        if tr is not None:
+            self._settle_trace(delivery, "expired")
+
+    def _deadline_expired(self, delivery: Delivery, now: float) -> bool:
+        """Has this delivery's propagated deadline passed? Gated on the
+        admission controller so a service without overload control pays
+        zero header lookups per delivery."""
+        if self.admission is None:
+            return False
+        deadline = deadline_of(delivery.properties.headers)
+        return deadline is not None and now >= deadline
+
     # ---- ingress ----------------------------------------------------------
 
     async def _on_delivery(self, delivery: Delivery) -> None:
@@ -321,27 +471,64 @@ class _QueueRuntime:
         tr = self._trace(delivery)
         if tr is not None:
             tr.mark("consume", ctx.received_at)
+        if self.admission is not None:
+            # Admission runs FIRST — before decode and before any auth RPC
+            # round trip: an overloaded queue must not spend middleware
+            # work on a request it is about to shed.
+            decision = self.admission.decide(delivery, ctx.received_at,
+                                             self.engine.pool_size())
+            if decision is EXPIRED and delivery.redelivered:
+                # A REDELIVERED expired copy may belong to a player who
+                # already reached a terminal state (its matched response
+                # lost in flight) — admission can't consult the dedup
+                # cache pre-decode, so let it through: the flush checks
+                # terminal-replay BEFORE deadline and either replays the
+                # cached truth or expires it there.
+                decision = ADMIT
+            if decision is not ADMIT:
+                if decision is EXPIRED:
+                    self._expire_delivery(delivery, ctx.received_at)
+                else:
+                    self._shed_delivery(delivery)
+                return
+            self.admission.admit(delivery.delivery_tag)
         try:
             await self.pipeline.run(ctx)
         except MiddlewareReject as e:
             self.app.metrics.counters.inc("rejected_by_middleware")
             self._respond_error(delivery, e.code, e.reason)
-            self.app.broker.ack(self.consumer_tag, delivery.delivery_tag)
+            self._ack(delivery)
             if tr is not None:
                 tr.mark("reject")
                 self._settle_trace(delivery, "rejected")
             return
+        except BaseException:
+            # Any other ingress crash is settled by the BROKER layer (the
+            # consumer's crash handler nacks without coming through _nack),
+            # which would strand this delivery's admission credit: over
+            # AMQP every redelivery carries a fresh tag, so leaked credits
+            # accumulate until the queue sheds 100% of traffic. Release
+            # before the broker takes over; the redelivery re-admits.
+            if self.admission is not None:
+                self.admission.release(delivery.delivery_tag)
+            raise
         if tr is not None:
             tr.mark("batch")
-        if ctx.request is None:
-            # Columnar ingress: the pipeline left decoding to the batched
-            # native codec (1v1 queues) — middleware only ran auth/validity
-            # checks that need headers.
-            self.batcher.submit((None, delivery))
-            return
-        if tr is not None:
-            tr.player_id = ctx.request.id
-        self.batcher.submit((ctx.request, delivery))
+        try:
+            if ctx.request is None:
+                # Columnar ingress: the pipeline left decoding to the
+                # batched native codec (1v1 queues) — middleware only ran
+                # auth/validity checks that need headers.
+                self.batcher.submit((None, delivery))
+                return
+            if tr is not None:
+                tr.player_id = ctx.request.id
+            self.batcher.submit((ctx.request, delivery))
+        except BaseException:
+            # Same leak via a closed/crashed batcher submit.
+            if self.admission is not None:
+                self.admission.release(delivery.delivery_tag)
+            raise
 
     # ---- the window flush: THE seam into Engine.search --------------------
 
@@ -360,8 +547,7 @@ class _QueueRuntime:
             log.exception("window flush failed; nacking its deliveries")
             self.app.metrics.counters.inc("flush_errors")
             for _, delivery in window:
-                self.app.broker.nack(self.consumer_tag, delivery.delivery_tag,
-                                     requeue=True)
+                self._nack(delivery)
         finally:
             self._flushing -= 1
 
@@ -391,12 +577,21 @@ class _QueueRuntime:
                 del self._recent[req.id]  # expired: a genuine re-queue
                 cached = None
             if cached is not None:
+                # Terminal replay BEFORE the deadline check (same order as
+                # the pipelined pre-dispatch sweep): a redelivered copy of
+                # an already-matched player must replay "matched", not
+                # contradict it with a post-deadline "timeout".
                 self.app.metrics.counters.inc("deduped_replays")
                 self._publish_body(req.reply_to, req.correlation_id, cached[0])
-                self.app.broker.ack(self.consumer_tag, delivery.delivery_tag)
+                self._ack(delivery)
                 if tr is not None:
                     tr.mark("dedup_replay")
                     self._settle_trace(delivery, "deduped")
+            elif self._deadline_expired(delivery, now):
+                # Deadline check #2 (batch formation): the request was live
+                # at admission but its deadline passed while it waited in
+                # the batcher — cancel before any engine work.
+                self._expire_delivery(delivery, now, player_id=req.id)
             else:
                 fresh.append((req, delivery))
         window = fresh
@@ -428,6 +623,16 @@ class _QueueRuntime:
             # loop responsive for other queues. The lock serializes against
             # the timeout sweeper.
             async with self._engine_lock:
+                if self.admission is not None:
+                    # shed_policy="oldest" debt from actual occupancy
+                    # (synchronous engines have no windows in flight, so
+                    # eviction is legal here).
+                    debt = self.admission.eviction_debt(
+                        len(requests), self.engine.pool_size())
+                    if debt:
+                        evicted = await asyncio.to_thread(
+                            self._evict_oldest, debt, now)
+                        self._publish_shed_evictions(evicted, now)
                 outcome = await asyncio.to_thread(self.engine.search, requests, now)
         except Exception:
             log.exception("engine step crashed; reviving engine from mirror")
@@ -437,16 +642,16 @@ class _QueueRuntime:
             # matchlint: ignore[guarded-by] revive sequence is await-free; the lock guards cross-await atomicity only
             self._revive_engine(now)
             for delivery in deliveries_in:
-                self.app.broker.nack(self.consumer_tag, delivery.delivery_tag,
-                                     requeue=True)
+                self._nack(delivery)
             return
         t_col = time.time()
         for delivery in deliveries_in:
             if delivery.trace is not None:
                 delivery.trace.mark("collect", t_col)
-        self._publish_outcome(outcome, now)
+        self._publish_outcome(outcome, now,
+                              trace_ids=self._trace_id_map(deliveries_in))
         for delivery in deliveries_in:
-            self.app.broker.ack(self.consumer_tag, delivery.delivery_tag)
+            self._ack(delivery)
         self._settle_outcome_traces(outcome, deliveries_in)
         self.app.metrics.counters.inc("windows")
         self.app.metrics.counters.inc("requests_batched", len(window))
@@ -481,7 +686,7 @@ class _QueueRuntime:
         except ContractError as e:
             self.app.metrics.counters.inc("rejected_by_middleware")
             self._respond_error(delivery, e.code, e.reason)
-            self.app.broker.ack(self.consumer_tag, delivery.delivery_tag)
+            self._ack(delivery)
             if delivery.trace is not None:
                 delivery.trace.mark("reject")
                 self._settle_trace(delivery, "rejected")
@@ -537,7 +742,7 @@ class _QueueRuntime:
                 self.app.metrics.counters.inc("rejected_by_middleware")
                 self._respond_error(delivery, codec.error_code(native[6][i]),
                                     "malformed payload")
-                self.app.broker.ack(self.consumer_tag, delivery.delivery_tag)
+                self._ack(delivery)
                 if delivery.trace is not None:
                     delivery.trace.mark("reject")
                     self._settle_trace(delivery, "rejected")
@@ -552,7 +757,7 @@ class _QueueRuntime:
                     self.app.metrics.counters.inc("rejected_by_engine")
                     self._respond_error(delivery, "party_not_supported",
                                         "engine rejected request: party_not_supported")
-                    self.app.broker.ack(self.consumer_tag, delivery.delivery_tag)
+                    self._ack(delivery)
                     if delivery.trace is not None:
                         delivery.trace.mark("reject")
                         self._settle_trace(delivery, "rejected")
@@ -571,14 +776,22 @@ class _QueueRuntime:
                 del self._recent[row[0]]
                 cached = None
             if cached is not None:
+                # Terminal replay BEFORE the deadline check — see the
+                # object-path twin: "matched" must never be followed by a
+                # contradictory post-deadline "timeout".
                 self.app.metrics.counters.inc("deduped_replays")
                 self._publish_body(delivery.properties.reply_to,
                                    delivery.properties.correlation_id,
                                    cached[0])
-                self.app.broker.ack(self.consumer_tag, delivery.delivery_tag)
+                self._ack(delivery)
                 if delivery.trace is not None:
                     delivery.trace.mark("dedup_replay")
                     self._settle_trace(delivery, "deduped")
+                continue
+            if self._deadline_expired(delivery, now):
+                # Deadline check #2 (batch formation), columnar twin —
+                # after decode, so the timeout quotes the player id.
+                self._expire_delivery(delivery, now, player_id=row[0])
                 continue
             lanes.append(row)
 
@@ -608,9 +821,7 @@ class _QueueRuntime:
 
         if not self._pipelined:
             deliveries_in = [r[7] for r in lanes]
-            # depth-1 mode (pipeline_depth <= 1, or an engine without the
-            # pipelined API): dispatch + flush together, outcomes handled
-            # inline — the pre-round-4 behavior.
+
             def run_engine():
                 # Dispatch + flush OFF the event loop: first-window jit
                 # compilation and per-window pack/H2D host work would
@@ -621,6 +832,19 @@ class _QueueRuntime:
 
             try:
                 async with self._engine_lock:
+                    if self.admission is not None:
+                        # shed_policy="oldest" debt, depth-1 twin — debt
+                        # from occupancy read UNDER the lock (a sweeper
+                        # parked ahead of us may have just freed slots;
+                        # a pre-lock read would over-evict) and paid
+                        # before the dispatch opens a window (remove()
+                        # requires _open == 0).
+                        evict_debt = self.admission.eviction_debt(
+                            len(lanes), self.engine.pool_size())
+                        if evict_debt:
+                            evicted = await asyncio.to_thread(
+                                self._evict_oldest, evict_debt, now)
+                            self._publish_shed_evictions(evicted, now)
                     outs = await asyncio.to_thread(run_engine)
                     # Error check + failed-token bookkeeping stay INSIDE
                     # the lock: a breaker demotion parked on it must not
@@ -638,8 +862,7 @@ class _QueueRuntime:
                 # matchlint: ignore[guarded-by] revive sequence is await-free; the lock guards cross-await atomicity only
                 self._revive_engine(now)
                 for d in deliveries_in:
-                    self.app.broker.nack(self.consumer_tag,
-                                         d.delivery_tag, requeue=True)
+                    self._nack(d)
                 return
             for tok, out in outs:
                 self._merge_window_marks(tok, deliveries_in)
@@ -684,11 +907,61 @@ class _QueueRuntime:
             self.app.metrics.counters.inc("deduped_replays")
             self._publish_body(delivery.properties.reply_to,
                                delivery.properties.correlation_id, cached[0])
-            self.app.broker.ack(self.consumer_tag, delivery.delivery_tag)
+            self._ack(delivery)
             if delivery.trace is not None:
                 delivery.trace.mark("dedup_replay")
                 self._settle_trace(delivery, "deduped")
         return stale
+
+    # holds-lock: _engine_lock
+    def _settle_expired_locked(self, pairs: list[tuple[str, Delivery]],
+                               now: float) -> set[str]:
+        """Deadline check #3 (pre-dispatch), run under the engine lock
+        immediately before the window dispatches: the batch-formation check
+        raced the batcher wait and pipeline backpressure — a request can
+        expire between the two. Cancelled here it costs zero device work
+        (the acceptance proof: an ``expired`` trace mark with no
+        ``dispatch`` mark after it). Returns the expired ids for the
+        dispatch to drop."""
+        if self.admission is None:
+            return set()
+        expired: set[str] = set()
+        for pid, delivery in pairs:
+            if self._deadline_expired(delivery, now):
+                expired.add(pid)
+                self._expire_delivery(delivery, now, player_id=pid)
+        return expired
+
+    # holds-lock: _engine_lock
+    def _evict_oldest(self, k: int, now: float) -> list[SearchRequest]:
+        """shed_policy="oldest": evict the k longest-waiting pool players
+        (freshness-biased shedding). Runs in a worker thread with the
+        engine lock held and no windows in flight (remove() requires it).
+        O(pool) object materialization — acceptable: it only runs while
+        the queue is at its occupancy cap, which the cap keeps small."""
+        waiting = sorted(self.engine.waiting(), key=lambda r: r.enqueued_at)
+        out: list[SearchRequest] = []
+        for req in waiting[:k]:
+            removed = self.engine.remove(req.id)
+            if removed is not None:
+                out.append(removed)
+        return out
+
+    def _publish_shed_evictions(self, evicted: list[SearchRequest],
+                                now: float) -> None:
+        """Shed responses for pool players evicted under the "oldest"
+        policy. Remembered in the dedup cache: a redelivered copy of an
+        evicted player must replay the shed, not silently re-enter."""
+        for req in evicted:
+            if self.admission is not None:
+                self.admission.record_shed(f"evicted oldest {req.id}")
+            body = encode_response(SearchResponse(
+                status="shed", player_id=req.id,
+                retry_after_ms=self.app.cfg.overload.retry_after_ms,
+                latency_ms=((now - req.enqueued_at) * 1e3
+                            if req.enqueued_at else 0.0)))
+            self._remember(req.id, body, now)
+            self._publish_body(req.reply_to, req.correlation_id, body)
 
     async def _dispatch_pipelined(self, dispatch,
                                   pairs: list[tuple[str, Delivery]],
@@ -715,11 +988,37 @@ class _QueueRuntime:
                     # revive may otherwise never fire).
                     await self._drain_engine(now)
                 stale = self._settle_terminal_locked(pairs, now)
+                # Only still-live pairs reach the expired sweep: a delivery
+                # that was just terminal-replayed is SETTLED — expiring it
+                # too would double-respond and double-settle its trace.
+                stale |= self._settle_expired_locked(
+                    [p for p in pairs if p[0] not in stale], now)
                 if stale:
                     pairs = [(p, d) for p, d in pairs if p not in stale]
                     deliveries_in = [d for _, d in pairs]
                     if not pairs:
-                        return  # every row replayed + acked
+                        return  # every row replayed/expired + acked
+                if self.admission is not None:
+                    # shed_policy="oldest": evict the longest-waiting pool
+                    # players so this window's (fresher) arrivals fit under
+                    # the cap — debt computed from ACTUAL occupancy at this
+                    # dispatch point. remove() requires no windows in
+                    # flight, so paying costs a pipeline drain; at a
+                    # sustained cap that would collapse pipeline_depth to 1
+                    # on every window. Pay when the pipeline is already
+                    # empty (free) or once the debt exceeds one batch
+                    # (bounded occupancy overshoot); otherwise the next
+                    # flush recomputes from occupancy and settles then.
+                    debt = self.admission.eviction_debt(
+                        len(pairs), self.engine.pool_size())
+                    if debt:
+                        busy = (hasattr(self.engine, "inflight")
+                                and self.engine.inflight() > 0)
+                        if not busy or debt >= self.app.cfg.batcher.max_batch:
+                            await self._drain_engine(now)
+                            evicted = await asyncio.to_thread(
+                                self._evict_oldest, debt, now)
+                            self._publish_shed_evictions(evicted, now)
                 tok = await asyncio.to_thread(dispatch, stale)
                 self._inflight_meta[tok] = (dict(pairs), deliveries_in)
                 recorded = True
@@ -789,8 +1088,7 @@ class _QueueRuntime:
             self.app.events.append("window_failed", self.queue_cfg.name,
                                    f"token {tok}, {len(deliveries)} nacked")
             for d in deliveries:
-                self.app.broker.nack(self.consumer_tag, d.delivery_tag,
-                                     requeue=True)
+                self._nack(d)
             self._needs_revive = True
             return
         try:
@@ -808,21 +1106,31 @@ class _QueueRuntime:
             log.exception("window %d outcome handling failed; nacking", tok)
             self.app.metrics.counters.inc("outcome_errors")
             for d in deliveries:
-                self.app.broker.nack(self.consumer_tag, d.delivery_tag,
-                                     requeue=True)
+                self._nack(d)
+
+    def _trace_id_map(self, deliveries: list[Delivery]) -> dict[str, str]:
+        """player id → flight-recorder trace id for this window's TRACED
+        deliveries — responses quote the id a client can hand to
+        ``/debug/traces?id=``. Only same-window deliveries are attributable:
+        a pool member matched windows later settled its trace as "queued"
+        back when it was admitted."""
+        return {d.trace.player_id: d.trace.trace_id for d in deliveries
+                if d.trace is not None and d.trace.player_id}
 
     def _handle_columnar_out(self, out, by_id: dict[str, Delivery],
                              deliveries: list[Delivery], now: float) -> None:
         """Publish one collected window's outcome and ack its deliveries."""
         m = self.app.metrics
-        self._publish_columnar_matches(out, now)
+        trace_ids = self._trace_id_map(deliveries)
+        self._publish_columnar_matches(out, now, trace_ids=trace_ids)
         if self.queue_cfg.send_queued_ack:
             for pid in out.q_ids:
                 d = by_id.get(pid)
                 if d is not None:
                     self._respond_raw(
                         d.properties.reply_to, d.properties.correlation_id,
-                        SearchResponse(status="queued", player_id=pid))
+                        SearchResponse(status="queued", player_id=pid,
+                                       trace_id=trace_ids.get(pid, "")))
         for pid, code in out.rejected:
             m.counters.inc("rejected_by_engine")
             d = by_id.get(pid)
@@ -830,7 +1138,7 @@ class _QueueRuntime:
                 self._respond_error(d, code,
                                     f"engine rejected request: {code}")
         for d in deliveries:
-            self.app.broker.ack(self.consumer_tag, d.delivery_tag)
+            self._ack(d)
         if any(d.trace is not None for d in deliveries):
             matched_ids = set(out.m_id_a.tolist()) | set(out.m_id_b.tolist())
             rejected_ids = {pid for pid, _ in out.rejected}
@@ -851,9 +1159,10 @@ class _QueueRuntime:
         """Publish one collected OBJECT window's outcome (device team
         queues) and ack its deliveries — _publish_outcome covers matches,
         queued acks, rejections, and timeouts."""
-        self._publish_outcome(out, now)
+        self._publish_outcome(out, now,
+                              trace_ids=self._trace_id_map(deliveries))
         for d in deliveries:
-            self.app.broker.ack(self.consumer_tag, d.delivery_tag)
+            self._ack(d)
         self._settle_outcome_traces(out, deliveries)
         self.app.metrics.counters.inc("windows")
         self.app.metrics.counters.inc("requests_batched", len(deliveries))
@@ -894,8 +1203,7 @@ class _QueueRuntime:
             for tok, out in outs:
                 self._finish_token(tok, out, now)
             for d in extra_nack or ():
-                self.app.broker.nack(self.consumer_tag, d.delivery_tag,
-                                     requeue=True)
+                self._nack(d)
             # _revive_engine nacks + clears whatever meta the salvage flush
             # could not finish.
             self._revive_locked(now)
@@ -924,14 +1232,18 @@ class _QueueRuntime:
                 self.app.metrics.counters.inc("collector_errors")
                 await asyncio.sleep(0.05)
 
-    def _publish_columnar_matches(self, out, now: float) -> None:
+    def _publish_columnar_matches(self, out, now: float,
+                                  trace_ids: dict[str, str] | None = None,
+                                  ) -> None:
         """Matched responses for one ColumnarOutcome (window flush AND
         rescan both come through here). Bodies are built by the native
         batch encoder when available (one C call per window — at grouped-
         readback match rates the per-response dict+json.dumps is the
         service's next hot loop); the Python path is the fallback and the
         semantic source of truth (parsed-value equivalence pinned by
-        tests/test_native_codec.py)."""
+        tests/test_native_codec.py). ``trace_ids`` maps this window's
+        traced players to flight-recorder ids quoted in their responses
+        (spliced into native bodies — only traced players pay)."""
         import numpy as np
 
         from matchmaking_tpu.native import codec
@@ -966,11 +1278,19 @@ class _QueueRuntime:
             corr_a, corr_b = out.m_corr_a.tolist(), out.m_corr_b.tolist()
             for j in range(n):
                 body_a, body_b = bodies[2 * j], bodies[2 * j + 1]
+                if trace_ids:
+                    tid = trace_ids.get(ids_a[j])
+                    if tid:
+                        body_a = _body_with_trace_id(body_a, tid)
+                    tid = trace_ids.get(ids_b[j])
+                    if tid:
+                        body_b = _body_with_trace_id(body_b, tid)
                 self._remember(ids_a[j], body_a, now)
                 self._remember(ids_b[j], body_b, now)
                 self._publish_body(reply_a[j], corr_a[j], body_a)
                 self._publish_body(reply_b[j], corr_b[j], body_b)
             return
+        trace_ids = trace_ids or {}
         for j in range(n):
             id_a, id_b = out.m_id_a[j], out.m_id_b[j]
             result = MatchResult(
@@ -979,12 +1299,15 @@ class _QueueRuntime:
                 quality=float(out.m_quality[j]),
             )
             self._publish_matched(id_a, out.m_reply_a[j], out.m_corr_a[j],
-                                  float(out.m_enq_a[j]), result, now)
+                                  float(out.m_enq_a[j]), result, now,
+                                  trace_id=trace_ids.get(id_a, ""))
             self._publish_matched(id_b, out.m_reply_b[j], out.m_corr_b[j],
-                                  float(out.m_enq_b[j]), result, now)
+                                  float(out.m_enq_b[j]), result, now,
+                                  trace_id=trace_ids.get(id_b, ""))
 
     def _publish_matched(self, pid: str, reply_to: str, correlation_id: str,
-                         enqueued_at: float, result, now: float) -> None:
+                         enqueued_at: float, result, now: float,
+                         trace_id: str = "") -> None:
         """One matched player's response + metrics + dedup memory — the
         slow-path builder (object flush; the columnar flush uses the native
         batch encoder when available and only falls back here)."""
@@ -995,7 +1318,8 @@ class _QueueRuntime:
             m.observe_stage(self.queue_cfg.name, "e2e", now - enqueued_at)
         body = encode_response(SearchResponse(
             status="matched", player_id=pid, match=result,
-            latency_ms=(now - enqueued_at) * 1e3 if enqueued_at else 0.0))
+            latency_ms=(now - enqueued_at) * 1e3 if enqueued_at else 0.0,
+            trace_id=trace_id))
         self._remember(pid, body, now)
         self._publish_body(reply_to, correlation_id, body)
 
@@ -1025,8 +1349,7 @@ class _QueueRuntime:
         with the new engine's token numbering."""
         for tok, (_by_id, deliveries) in list(self._inflight_meta.items()):
             for d in deliveries:
-                self.app.broker.nack(self.consumer_tag, d.delivery_tag,
-                                     requeue=True)
+                self._nack(d)
             del self._inflight_meta[tok]
         try:
             snapshot = self.engine.waiting()
@@ -1044,27 +1367,34 @@ class _QueueRuntime:
 
     # ---- egress -----------------------------------------------------------
 
-    def _publish_outcome(self, outcome: SearchOutcome, now: float) -> None:
+    def _publish_outcome(self, outcome: SearchOutcome, now: float,
+                         trace_ids: dict[str, str] | None = None) -> None:
         m = self.app.metrics
+        tids = trace_ids or {}
         if self._invariants is not None:
             self._invariants.observe_outcome(outcome)
         for match in outcome.matches:
             result = match.result()
             for req in match.requests():
                 self._publish_matched(req.id, req.reply_to, req.correlation_id,
-                                      req.enqueued_at, result, now)
+                                      req.enqueued_at, result, now,
+                                      trace_id=tids.get(req.id, ""))
         if self.queue_cfg.send_queued_ack:
             for req in outcome.queued:
-                self._respond(req, SearchResponse(status="queued", player_id=req.id))
+                self._respond(req, SearchResponse(
+                    status="queued", player_id=req.id,
+                    trace_id=tids.get(req.id, "")))
         for req, code in outcome.rejected:
             m.counters.inc("rejected_by_engine")
             self._respond(req, SearchResponse(
                 status="error", player_id=req.id, error_code=code,
                 error_reason=f"engine rejected request: {code}",
+                trace_id=tids.get(req.id, ""),
             ))
         for req in outcome.timed_out:
-            body = encode_response(SearchResponse(status="timeout",
-                                                  player_id=req.id))
+            body = encode_response(SearchResponse(
+                status="timeout", player_id=req.id,
+                trace_id=tids.get(req.id, "")))
             self._remember(req.id, body, now)
             self._publish_body(req.reply_to, req.correlation_id, body)
 
@@ -1097,10 +1427,13 @@ class _QueueRuntime:
     def _respond_error(self, delivery: Delivery, code: str, reason: str) -> None:
         if not delivery.properties.reply_to:
             return
+        tr = delivery.trace
         self.app.broker.publish(
             delivery.properties.reply_to,
             encode_response(SearchResponse(
-                status="error", player_id="", error_code=code, error_reason=reason,
+                status="error", player_id="", error_code=code,
+                error_reason=reason,
+                trace_id=tr.trace_id if tr is not None else "",
             )),
             Properties(correlation_id=delivery.properties.correlation_id),
         )
@@ -1449,6 +1782,12 @@ class MatchmakingApp:
         # broker has both attrs; foreign transports may have neither).
         if hasattr(self.broker, "events"):
             self.broker.events = self.events
+        # Chaos schedule for injected transports (AmqpBroker carries the
+        # same drop/dup/partition hooks as the in-proc broker — PR 2
+        # follow-up closed): the in-proc default got it at construction.
+        if (self.chaos is not None and hasattr(self.broker, "chaos")
+                and self.broker.chaos is None):
+            self.broker.chaos = self.chaos
         if hasattr(self.broker, "trace_enabled"):
             self.broker.trace_enabled = self.trace_enabled
         if hasattr(self.broker, "trace_sample_n"):
@@ -1475,12 +1814,56 @@ class MatchmakingApp:
         self._started = True
 
     async def stop(self) -> None:
+        if not self._started:
+            return  # drain() already shut everything down
         if self._observability is not None:
             await self._observability.stop()
         for rt in self._runtimes.values():
             await rt.close()
         self.broker.close()
         self._started = False
+
+    async def drain(self, checkpoint_dir: str | None = None) -> dict[str, int]:
+        """Graceful drain/handoff (SIGTERM path — see ``serve``): stop
+        admission (late arrivals get ``shed`` + retry-after, not silence),
+        drain every in-flight window so earned matches still publish,
+        checkpoint each queue's waiting pool (utils/checkpoint.py), then
+        stop. A restarted app pointed at the same directory restores the
+        pools via ``restore_checkpoint`` — zero waiting players lost, and
+        restore-side dedup means zero duplicate matches when the broker
+        redelivers the same requests (at-least-once world).
+
+        Returns per-queue checkpointed player counts ({} when no directory
+        is configured)."""
+        directory = (checkpoint_dir if checkpoint_dir is not None
+                     else self.cfg.overload.drain_checkpoint_dir)
+        self.events.append("drain_begin", "",
+                           f"checkpoint={'on' if directory else 'off'}")
+        # Admission off FIRST, across all queues: deliveries that race the
+        # per-queue close below are shed with an explicit response instead
+        # of being half-processed into a pool we are about to freeze.
+        for rt in self._runtimes.values():
+            if rt.admission is not None:
+                rt.admission.begin_drain()
+        # Per-queue close: stops the timers, drains the batcher (final
+        # windows still publish + ack), collects in-flight device windows,
+        # cancels the consumer. Engines stay bound — the checkpoint below
+        # reads their quiesced pools.
+        for rt in self._runtimes.values():
+            await rt.close()
+        counts: dict[str, int] = {}
+        if directory:
+            counts = await self.save_checkpoint(directory)
+        self.events.append(
+            "drain_complete", "",
+            f"{sum(counts.values())} waiting players checkpointed"
+            if directory else "no checkpoint directory")
+        if self._observability is not None:
+            await self._observability.stop()
+            self._observability = None
+        self.broker.close()
+        self._started = False
+        return counts
 
     def runtime(self, queue_name: str) -> _QueueRuntime:
         return self._runtimes[queue_name]
@@ -1554,7 +1937,11 @@ async def serve(stop: "asyncio.Event | None" = None,
     (Config.from_env), real AMQP transport when ``MM_BROKER_URL`` points at
     a RabbitMQ (``amqp://``/``amqps://``), in-process broker otherwise.
     Runs until SIGTERM/SIGINT (or ``stop`` is set — the test seam, which
-    also injects ``pika_module``) — the Docker CMD."""
+    also injects ``pika_module``) — the Docker CMD. With
+    ``MM_OVERLOAD_DRAIN_CHECKPOINT_DIR`` set, shutdown is a graceful drain
+    (admission stops, in-flight windows finish, waiting pools checkpoint)
+    and the next boot restores the pools — zero lost waiting players."""
+    import os
     import signal
 
     # Multi-host (DCN): when MM_DCN_* names a topology, join the jax
@@ -1583,6 +1970,18 @@ async def serve(stop: "asyncio.Event | None" = None,
             "(demo/test semantics; clients must run in this process)", url)
     app = MatchmakingApp(cfg, broker=broker)
     await app.start()
+    # Graceful handoff (OverloadConfig.drain_checkpoint_dir): restore the
+    # waiting pools a predecessor checkpointed at its SIGTERM — zero lost
+    # waiting players across a restart. Restore re-admits WITHOUT matching,
+    # and pool-membership dedup absorbs the broker's redeliveries of the
+    # same requests, so no player can land in two matches.
+    drain_dir = cfg.overload.drain_checkpoint_dir
+    if drain_dir and os.path.isdir(drain_dir):
+        restored = await app.restore_checkpoint(drain_dir)
+        if restored:
+            logging.getLogger(__name__).info(
+                "restored %d waiting players from drain checkpoint %s",
+                sum(restored.values()), drain_dir)
     if stop is None:
         stop = asyncio.Event()
         loop = asyncio.get_running_loop()
@@ -1594,7 +1993,12 @@ async def serve(stop: "asyncio.Event | None" = None,
     try:
         await stop.wait()
     finally:
-        await app.stop()
+        if drain_dir:
+            # SIGTERM = drain, not drop: admission stops, in-flight windows
+            # finish, the waiting pools checkpoint for the successor.
+            await app.drain(drain_dir)
+        else:
+            await app.stop()
 
 
 if __name__ == "__main__":

@@ -14,6 +14,7 @@ from typing import Any, Mapping
 
 from matchmaking_tpu.service.broker import InProcBroker, Properties
 from matchmaking_tpu.service.contract import SearchResponse, decode_response
+from matchmaking_tpu.service.overload import stamp_deadline
 
 
 class MatchmakingClient:
@@ -23,11 +24,24 @@ class MatchmakingClient:
         self.request_queue = request_queue
         self.auth_token = auth_token
 
-    def submit(self, player: Mapping[str, Any], *, queue: str | None = None) -> str:
-        """Fire a search request; returns the private reply queue name."""
+    def submit(self, player: Mapping[str, Any], *, queue: str | None = None,
+               deadline_s: float | None = None) -> str:
+        """Fire a search request; returns the private reply queue name.
+        ``deadline_s`` propagates the client's patience to the service as
+        an absolute ``x-deadline`` header (service/overload.py): a request
+        whose deadline passes before dispatch is cancelled (explicit
+        ``timeout``) instead of matched. Deadlines are enforced on the way
+        INTO the pool (admission / batch formation / pre-dispatch); bound
+        the wait of players already pooled with the queue-level
+        ``QueueConfig.request_timeout_s`` sweeper."""
+        import time
+
         reply_to = f"amq.gen-{uuid.uuid4().hex}"
         self.broker.declare_queue(reply_to)  # before publish: replies must route
-        headers = {"authorization": self.auth_token} if self.auth_token else {}
+        headers: dict[str, Any] = (
+            {"authorization": self.auth_token} if self.auth_token else {})
+        if deadline_s is not None:
+            stamp_deadline(headers, time.time(), deadline_s)
         self.broker.publish(
             queue or self.request_queue,
             json.dumps(dict(player)).encode(),
@@ -45,10 +59,15 @@ class MatchmakingClient:
 
     async def search_until_matched(self, player: Mapping[str, Any], *,
                                    timeout: float = 5.0,
-                                   queue: str | None = None) -> SearchResponse:
+                                   queue: str | None = None,
+                                   deadline_s: float | None = None,
+                                   ) -> SearchResponse:
         """Submit and wait through ``queued`` acks until a terminal response
-        (matched / timeout / error) or the deadline."""
-        reply_to = self.submit(player, queue=queue)
+        (matched / timeout / error / shed) or the deadline. Pass
+        ``deadline_s`` (usually = ``timeout``) to propagate the patience
+        window to the service; a ``shed`` response carries
+        ``retry_after_ms`` — back off, don't hammer."""
+        reply_to = self.submit(player, queue=queue, deadline_s=deadline_s)
         import asyncio
 
         deadline = asyncio.get_event_loop().time() + timeout

@@ -118,12 +118,19 @@ class MatchResult:
 
 @dataclass(frozen=True)
 class SearchResponse:
-    status: str  # matched | queued | timeout | error
+    status: str  # matched | queued | timeout | error | shed
     player_id: str
     match: MatchResult | None = None
     error_code: str = ""
     error_reason: str = ""
     latency_ms: float = 0.0
+    #: Back-off hint on ``shed`` responses (overload admission control —
+    #: service/overload.py): retry this queue after this many ms.
+    retry_after_ms: float = 0.0
+    #: Flight-recorder id of the request's trace, when it was traced — the
+    #: handle a client quotes to ``/debug/traces?id=`` so a shed/timeout/
+    #: matched response is directly explainable (ROADMAP PR 3 follow-up).
+    trace_id: str = ""
 
 
 # ---- decode ---------------------------------------------------------------
@@ -262,6 +269,10 @@ def encode_response(resp: SearchResponse) -> bytes:
         }
     if resp.status == "error":
         payload["error"] = {"code": resp.error_code, "reason": resp.error_reason}
+    if resp.status == "shed":
+        payload["retry_after_ms"] = round(resp.retry_after_ms, 3)
+    if resp.trace_id:
+        payload["trace_id"] = resp.trace_id
     return json.dumps(payload, separators=(",", ":")).encode()
 
 
@@ -284,6 +295,8 @@ def decode_response(body: bytes | str) -> SearchResponse:
         error_code=err.get("code", ""),
         error_reason=err.get("reason", ""),
         latency_ms=float(payload.get("latency_ms", 0.0)),
+        retry_after_ms=float(payload.get("retry_after_ms", 0.0)),
+        trace_id=str(payload.get("trace_id", "")),
     )
 
 

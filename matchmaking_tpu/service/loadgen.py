@@ -10,10 +10,21 @@ which is exactly the per-consumer work the reference fans out across AMQP
 consumers. The supervisor-level bench (bench.py --multiproc phase) spawns N
 of these via WorkerSupervisor and sums the per-worker throughput.
 
-Env contract (set by the bench on top of the multiproc worker env):
-    MM_LOADGEN_RATE     offered req/s (Poisson)
-    MM_LOADGEN_SECONDS  measured duration
-    MM_LOADGEN_OUT      path for the one-line JSON result
+Overload mode (``--offered-rate``, ISSUE 5): the offered rate may exceed
+the service's clearing rate on purpose — the report then accounts for every
+response class (matched / queued / shed / timeout / error) instead of only
+matches, and stamps per-request deadlines (``--deadline-ms``) so the
+deadline-propagation path is exercised. The seeded overload soak
+(tests/test_overload.py) and bench.py's multiproc phase both drive this
+entry point.
+
+Env contract (set by the bench on top of the multiproc worker env; each has
+a CLI flag that wins when both are given):
+    MM_LOADGEN_RATE         offered req/s (Poisson)      (--offered-rate)
+    MM_LOADGEN_SECONDS      measured duration            (--seconds)
+    MM_LOADGEN_SEED         arrival/rating RNG seed      (--seed)
+    MM_LOADGEN_DEADLINE_MS  per-request deadline, 0=off  (--deadline-ms)
+    MM_LOADGEN_OUT          path for the JSON result     (--out)
 """
 
 from __future__ import annotations
@@ -25,35 +36,58 @@ import time
 
 import numpy as np
 
+#: Response classes tallied from reply bodies (cheap substring probes — at
+#: overload rates a full json.loads per reply would bill the loadgen, not
+#: the service, for the decode).
+_STATUS_PROBES = (
+    ("matched", b'"status":"matched"'),
+    ("queued", b'"status":"queued"'),
+    ("shed", b'"status":"shed"'),
+    ("timeout", b'"status":"timeout"'),
+    ("error", b'"status":"error"'),
+)
 
-async def _run() -> dict:
-    from matchmaking_tpu.config import Config
-    from matchmaking_tpu.service.app import MatchmakingApp
+
+async def offered_load(app, queue: str, *, rate: float, duration: float,
+                       seed: int, deadline_s: float = 0.0,
+                       reply_q: str = "loadgen.replies",
+                       drain_polls: int = 200) -> dict:
+    """Offer a seeded Poisson load to ``app``'s broker and account for
+    every response class. Reusable by the CLI below, bench.py's workers,
+    and the overload soak (tests/test_overload.py) — one load driver, not
+    three drifting copies.
+
+    Consecutive near-equal ratings: arrivals pair off almost immediately,
+    keeping the pool small so the measured cost is INGRESS (decode →
+    middleware → batcher → publish) — or, when ``rate`` exceeds the
+    clearing rate, ADMISSION (the shed path).
+    """
     from matchmaking_tpu.service.broker import Properties
+    from matchmaking_tpu.service.overload import stamp_deadline
 
-    cfg = Config.from_env()
-    rate = float(os.environ.get("MM_LOADGEN_RATE", "10000"))
-    duration = float(os.environ.get("MM_LOADGEN_SECONDS", "4"))
-    app = MatchmakingApp(cfg)
-    await app.start()
-    queue = cfg.queues[0].name
-
-    reply_q = "loadgen.replies"
     app.broker.declare_queue(reply_q)
-    replies = {"n": 0, "matched": 0}
+    tally = {name: 0 for name, _ in _STATUS_PROBES}
+    tally["replies"] = 0
 
     async def on_reply(delivery) -> None:
-        replies["n"] += 1
-        if b'"matched"' in delivery.body:
-            replies["matched"] += 1
+        tally["replies"] += 1
+        body = bytes(delivery.body)
+        for name, probe in _STATUS_PROBES:
+            if probe in body:
+                tally[name] += 1
+                return
 
-    app.broker.basic_consume(reply_q, on_reply, prefetch=1_000_000)
+    tag = app.broker.basic_consume(reply_q, on_reply, prefetch=1_000_000)
 
-    rng = np.random.default_rng(os.getpid())
+    # Counter BASELINES: shed/expired are app-lifetime monotone counters,
+    # and this driver is reused (warmup + measured phases, soak re-runs) —
+    # reporting deltas keeps a second call from inheriting the first's.
+    counters = app.metrics.counters
+    shed0 = counters.get("shed_requests")
+    expired0 = counters.get("expired_requests")
+
+    rng = np.random.default_rng(seed)
     n_max = int(rate * duration * 2) + 16
-    # Consecutive near-equal ratings: arrivals pair off almost immediately,
-    # keeping the CPU-oracle pool tiny so the measured cost is INGRESS
-    # (decode → middleware → batcher → publish), not the O(pool) scan.
     ratings = np.repeat(rng.normal(1500.0, 300.0, size=n_max // 2 + 1), 2)
     gaps = rng.exponential(1.0 / rate, size=n_max)
     sched = np.cumsum(gaps)
@@ -62,39 +96,90 @@ async def _run() -> dict:
     while i < n_max and sched[i] <= duration:
         now_rel = time.perf_counter() - t0
         while i < n_max and sched[i] <= min(now_rel, duration):
-            pid = f"g{os.getpid()}_{i}"
+            pid = f"g{seed}_{i}"
+            headers: dict = {}
+            if deadline_s > 0:
+                stamp_deadline(headers, time.time(), deadline_s)
             app.broker.publish(
                 queue,
                 f'{{"id":"{pid}","rating":{ratings[i]:.2f}}}'.encode(),
-                Properties(reply_to=reply_q, correlation_id=pid))
+                Properties(reply_to=reply_q, correlation_id=pid,
+                           headers=headers))
             i += 1
         if i < n_max and sched[i] > now_rel:
             await asyncio.sleep(min(sched[i] - now_rel, 0.005))
     span = time.perf_counter() - t0
-    for _ in range(200):  # drain
+    for _ in range(drain_polls):
         await asyncio.sleep(0.025)
         if (app.broker.queue_depth(queue) == 0
                 and app.broker.handlers_idle()):
             break
-    out = {
-        "pid": os.getpid(),
+    app.broker.basic_cancel(tag)
+    return {
         "queue": queue,
         "offered_req_s": rate,
         "sent": i,
         "sent_req_s": round(i / span, 1),
-        "players_matched": replies["matched"],
-        "matched_per_s": round(replies["matched"] / span, 1),
+        "players_matched": tally["matched"],
+        "matched_per_s": round(tally["matched"] / span, 1),
+        "replies": tally["replies"],
+        "queued_acks": tally["queued"],
+        "shed": tally["shed"],
+        "timeout": tally["timeout"],
+        "error": tally["error"],
+        "shed_requests": int(counters.get("shed_requests") - shed0),
+        "expired_requests": int(counters.get("expired_requests") - expired0),
     }
+
+
+async def _run(args) -> dict:
+    from matchmaking_tpu.config import Config
+    from matchmaking_tpu.service.app import MatchmakingApp
+
+    cfg = Config.from_env()
+    app = MatchmakingApp(cfg)
+    await app.start()
+    result = await offered_load(
+        app, cfg.queues[0].name,
+        rate=args.offered_rate, duration=args.seconds, seed=args.seed,
+        deadline_s=args.deadline_ms / 1e3 if args.deadline_ms > 0 else 0.0)
+    result["pid"] = os.getpid()
     await app.stop()
-    return out
+    return result
 
 
-def main() -> None:
-    result = asyncio.run(_run())
-    path = os.environ.get("MM_LOADGEN_OUT", "")
+def _parse_args(argv=None):
+    import argparse
+
+    env = os.environ
+    p = argparse.ArgumentParser(
+        description="self-driving offered-load worker (overload mode: set "
+                    "--offered-rate above the clearing rate and read the "
+                    "shed/timeout accounting)")
+    p.add_argument("--offered-rate", type=float,
+                   default=float(env.get("MM_LOADGEN_RATE", "10000")),
+                   help="offered req/s (Poisson)")
+    p.add_argument("--seconds", type=float,
+                   default=float(env.get("MM_LOADGEN_SECONDS", "4")),
+                   help="measured duration")
+    p.add_argument("--seed", type=int,
+                   default=int(env.get("MM_LOADGEN_SEED", str(os.getpid()))),
+                   help="arrival/rating RNG seed (defaults to the pid so "
+                        "multiproc workers don't correlate)")
+    p.add_argument("--deadline-ms", type=float,
+                   default=float(env.get("MM_LOADGEN_DEADLINE_MS", "0")),
+                   help="stamp x-deadline on every request (0 = off)")
+    p.add_argument("--out", default=env.get("MM_LOADGEN_OUT", ""),
+                   help="path for the one-line JSON result")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> None:
+    args = _parse_args(argv)
+    result = asyncio.run(_run(args))
     line = json.dumps(result, sort_keys=True)
-    if path:
-        with open(path, "w") as f:
+    if args.out:
+        with open(args.out, "w") as f:
             f.write(line + "\n")
     print(line, flush=True)
 

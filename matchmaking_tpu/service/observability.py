@@ -72,6 +72,16 @@ def build_report(app) -> dict[str, Any]:
     }
     if breakers:
         report["breakers"] = breakers
+    # Overload admission control (service/overload.py): credits held,
+    # adaptive credit fraction, shed/expired totals, drain state — the
+    # shed story must be readable from /metrics alone.
+    overload = {
+        name: rt.admission.snapshot()
+        for name, rt in app._runtimes.items()
+        if getattr(rt, "admission", None) is not None
+    }
+    if overload:
+        report["overload"] = overload
     return report
 
 
@@ -195,11 +205,17 @@ class ObservabilityServer:
                 entry["breaker"] = breaker.snapshot(now)
                 if breaker.state != "closed":
                     degraded.append(name)
+            admission = getattr(rt, "admission", None)
+            if admission is not None:
+                entry["overload"] = admission.snapshot()
             queues[name] = entry
         body = {
             # Degraded ≠ dead: matches still flow on the host path, so the
             # service stays live — operators alert on the field instead.
-            "status": "degraded" if degraded else "ok",
+            # Draining trumps both: a load balancer must stop routing here.
+            "status": ("draining" if any(
+                q.get("overload", {}).get("draining") for q in queues.values())
+                else "degraded" if degraded else "ok"),
             "degraded_queues": degraded,
             "queues": queues,
         }

@@ -1,0 +1,266 @@
+"""Overload control: admission, deadline propagation, adaptive shedding.
+
+The reference's only defense against offered load is RabbitMQ buffering —
+queues grow without bound, client timeouts never reach the engine, and the
+device burns windows matching players whose clients gave up. Serving-systems
+work (PAPERS.md: Nitsum admission tiers, Cinder's bounded-queue assumption)
+says the fix is explicit: bound the queue in front of the matcher, be honest
+about rejection, and never dispatch work whose deadline already passed.
+
+Three pieces, all deterministic by construction:
+
+- **Deadline propagation** — clients stamp an absolute wall-clock deadline
+  into the ``x-deadline`` message header (like ``x-first-received`` and
+  ``x-trace-enqueue``, headers are the only thing that survives a real AMQP
+  wire AND broker redelivery). The service checks it at admission, batch
+  formation, and pre-dispatch; an expired request is cancelled — ``timeout``
+  response, ``expired`` trace mark, no device work — instead of matching a
+  player whose client hung up. All arithmetic here takes ``now`` as a
+  parameter: the matchlint ``determinism`` rule bans ``time.time()``
+  deadline math at call sites (wall clocks step; the ONE wall-clock
+  conversion is the header stamp itself, which must cross processes).
+
+- **AdmissionController** — a per-queue token/credit limiter: a credit is
+  held from admission (``_on_delivery``) until the delivery settles
+  (ack/nack), so ``inflight`` counts exactly the deliveries the service has
+  committed to but not finished. Admission sheds when credits or projected
+  pool occupancy (live pool + credits on their way in) exceed the
+  configured caps — an explicit ``status="shed"`` response with a
+  retry-after hint, never silent rot in an unbounded queue. Decisions are
+  pure functions of the controller's counts at the decision point, so a
+  burst soak replays bit-identically (tests/test_overload.py).
+
+- **Adaptive tightening** — the effective credit limit is scaled by a
+  fraction updated once per cut window from the signals the service
+  already exports (batch fill, pipeline occupancy, per-queue stage p99):
+  multiplicative decrease when p99 overshoots the target, gentle relax
+  when it recovers — the limiter tightens BEFORE the circuit breaker
+  trips, which is the whole point (the breaker handles component failure;
+  this handles offered load).
+
+Graceful drain rides the same controller: ``begin_drain()`` flips it to
+shed-everything while the app collects in-flight windows and checkpoints
+every waiting pool (service/app.MatchmakingApp.drain).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, MutableMapping
+
+from matchmaking_tpu.config import OverloadConfig
+
+#: Message header carrying the absolute wall-clock request deadline
+#: (epoch seconds, ``repr(float)`` — same convention as x-trace-enqueue).
+DEADLINE_HEADER = "x-deadline"
+
+#: Admission decisions (AdmissionController.decide).
+ADMIT = "admit"
+SHED = "shed"
+EXPIRED = "expired"
+
+
+def stamp_deadline(headers: MutableMapping[str, Any], now: float,
+                   budget_s: float) -> None:
+    """Stamp ``now + budget_s`` as the request deadline unless one is
+    already set (client-stamped deadlines win; redeliveries reuse the same
+    headers dict, so the clock survives requeue by construction). ``now``
+    is a parameter on purpose — the caller passes its one wall-clock read
+    and every derived comparison stays replay-checkable."""
+    headers.setdefault(DEADLINE_HEADER, repr(now + budget_s))
+
+
+def deadline_of(headers: Mapping[str, Any]) -> float | None:
+    """The absolute deadline stamped in ``headers``, or None. A foreign or
+    garbled value must not crash a window flush — it reads as no deadline."""
+    raw = headers.get(DEADLINE_HEADER)
+    if raw is None:
+        return None
+    try:
+        return float(raw)
+    except (TypeError, ValueError):
+        return None
+
+
+class AdmissionController:
+    """Per-queue credit limiter + deadline gate + adaptive shedding.
+
+    Event-loop-confined like the batcher (service/batcher.py): ``decide``/
+    ``admit``/``release`` are called from the queue runtime's ingress and
+    settle paths, never from worker threads — there is deliberately no lock
+    here.
+    """
+
+    def __init__(self, cfg: OverloadConfig, queue: str, metrics=None,
+                 events=None):
+        self.cfg = cfg
+        self.queue = queue
+        self._metrics = metrics
+        self._events = events
+        #: Delivery tags holding an admission credit (admitted, not yet
+        #: settled). A set keyed by tag makes release idempotent: every
+        #: settle path (ack, nack, requeue, revive) can release blindly.
+        self._credits: set[int] = set()
+        #: Adaptive credit fraction in [min_credit_fraction, 1.0]; scales
+        #: BOTH caps so occupancy and concurrency tighten together.
+        self._fraction = 1.0
+        #: Drain mode: shed everything (MatchmakingApp.drain).
+        self.draining = False
+        self.shed_total = 0
+        self.expired_total = 0
+        self._publish_gauges()
+
+    # ---- decisions ---------------------------------------------------------
+
+    def _eff(self, cap: int) -> int:
+        """Cap scaled by the adaptive fraction, floored at 1 so tightening
+        can starve but never wedge a queue shut."""
+        if cap <= 0:
+            return 0
+        return max(1, int(cap * self._fraction))
+
+    def decide(self, delivery, now: float, pool_size: int) -> str:
+        """ADMIT / SHED / EXPIRED for one arriving delivery. Pure function
+        of (draining, deadline header vs now, credits held, pool_size) —
+        no RNG, no clock reads — so identical ingress replays identically."""
+        headers = delivery.properties.headers
+        if self.cfg.default_deadline_ms > 0:
+            # Stamp relative to first receive, not now: a redelivered copy
+            # must not get a fresh budget on every attempt. (Holds on the
+            # in-proc broker, which requeues the same Delivery/headers;
+            # over real AMQP a redelivery restores the PUBLISHED headers,
+            # so this default is best-effort there — hard deadlines must
+            # be client-stamped at publish. See OverloadConfig.)
+            try:
+                first = float(headers.get("x-first-received", now))
+            except (TypeError, ValueError):
+                first = now
+            stamp_deadline(headers, first, self.cfg.default_deadline_ms / 1e3)
+        deadline = deadline_of(headers)
+        if deadline is not None and now >= deadline:
+            return EXPIRED
+        if self.draining:
+            return SHED
+        cap = self._eff(self.cfg.max_inflight)
+        if cap and len(self._credits) >= cap:
+            return SHED
+        cap = self._eff(self.cfg.max_waiting)
+        if cap and pool_size + len(self._credits) >= cap:
+            # Projected occupancy: credits are deliveries already committed
+            # toward the pool (in the batcher or an in-flight window) —
+            # counting the live pool alone would over-admit a whole
+            # batcher's worth per window. Under shed_policy="oldest" the
+            # over-cap arrival admits anyway; the flush settles the debt
+            # from ACTUAL occupancy (eviction_debt), so an admit that
+            # never reaches the pool (bad auth, dedup replay, expired
+            # deadline) cannot cost an innocent waiting player their slot.
+            if self.cfg.shed_policy == "oldest":
+                return ADMIT
+            return SHED
+        return ADMIT
+
+    def admit(self, delivery_tag: int) -> None:
+        self._credits.add(delivery_tag)
+        if self._metrics is not None:
+            self._metrics.set_gauge(f"overload_inflight[{self.queue}]",
+                                    len(self._credits))
+
+    def release(self, delivery_tag: int) -> None:
+        """Return the delivery's credit (idempotent; unknown tags — never
+        admitted, or already settled — are no-ops)."""
+        if delivery_tag in self._credits:
+            self._credits.discard(delivery_tag)
+            if self._metrics is not None:
+                self._metrics.set_gauge(f"overload_inflight[{self.queue}]",
+                                        len(self._credits))
+
+    def inflight(self) -> int:
+        return len(self._credits)
+
+    def record_shed(self, detail: str = "") -> None:
+        self.shed_total += 1
+        if self._metrics is not None:
+            self._metrics.counters.inc("shed_requests")
+        if self._events is not None:
+            self._events.append("shed", self.queue, detail)
+
+    def record_expired(self, detail: str = "") -> None:
+        self.expired_total += 1
+        if self._metrics is not None:
+            self._metrics.counters.inc("expired_requests")
+        if self._events is not None:
+            self._events.append("expired", self.queue, detail)
+
+    def eviction_debt(self, n_entering: int, pool_size: int) -> int:
+        """shed_policy="oldest": how many longest-waiting pool players the
+        flush must shed so the ``n_entering`` requests about to dispatch
+        fit under the occupancy cap. Computed from ACTUAL occupancy at the
+        dispatch point (not accumulated at admission), so rejected/
+        replayed/expired admits never charge the pool for a slot they
+        never took. Requests that match within their own window slightly
+        overcount — accepted: at a sustained cap the freshness bias is
+        the policy's point."""
+        if self.cfg.shed_policy != "oldest":
+            return 0
+        cap = self._eff(self.cfg.max_waiting)
+        if not cap:
+            return 0
+        return max(0, pool_size + n_entering - cap)
+
+    # ---- adaptive tightening ----------------------------------------------
+
+    def observe_window(self, batch_fill: float, pipeline_frac: float,
+                       p99_s: float | None) -> None:
+        """One batcher window was cut — update the adaptive fraction from
+        the live signals. Called once per window (a deterministic point in
+        the ingress sequence), not on a wall-clock timer, so two identical
+        runs tighten at identical windows."""
+        if not self.cfg.adaptive:
+            return
+        target_s = self.cfg.target_p99_ms / 1e3
+        old = self._fraction
+        overloaded = ((p99_s is not None and p99_s > target_s)
+                      or pipeline_frac >= 1.0)
+        if overloaded:
+            self._fraction = max(self.cfg.min_credit_fraction,
+                                 self._fraction * self.cfg.tighten_step)
+        elif ((p99_s is None or p99_s < target_s / 2.0)
+              and pipeline_frac < 1.0 and batch_fill < 1.0):
+            self._fraction = min(1.0, self._fraction * self.cfg.relax_step)
+        if self._fraction != old:
+            self._publish_gauges()
+            if self._events is not None and self._fraction < old:
+                self._events.append(
+                    "overload_tighten", self.queue,
+                    f"credit fraction {old:.3f} -> {self._fraction:.3f} "
+                    f"(p99 {0.0 if p99_s is None else p99_s * 1e3:.1f} ms, "
+                    f"pipeline {pipeline_frac:.2f})")
+
+    # ---- drain / observability --------------------------------------------
+
+    def begin_drain(self) -> None:
+        """Stop admission: every delivery from here on is shed with a
+        retry-after hint (clients go elsewhere while this process drains,
+        checkpoints, and hands off)."""
+        self.draining = True
+        if self._events is not None:
+            self._events.append("drain_admission_stopped", self.queue)
+
+    def _publish_gauges(self) -> None:
+        if self._metrics is None:
+            return
+        self._metrics.set_gauge(f"overload_inflight[{self.queue}]",
+                                len(self._credits))
+        self._metrics.set_gauge(f"overload_credit_fraction[{self.queue}]",
+                                self._fraction)
+
+    def snapshot(self) -> dict[str, Any]:
+        return {
+            "inflight": len(self._credits),
+            "credit_fraction": round(self._fraction, 4),
+            "max_inflight": self.cfg.max_inflight,
+            "max_waiting": self.cfg.max_waiting,
+            "shed_policy": self.cfg.shed_policy,
+            "shed_total": self.shed_total,
+            "expired_total": self.expired_total,
+            "draining": self.draining,
+        }

@@ -23,6 +23,12 @@ service's own ``asyncio.Lock()`` calls come back instrumented:
   threshold is recorded with the observed stall. Started lazily on the
   first instrumented acquire in each loop (soak tests run their own
   ``asyncio.run``).
+- **held-lock duration histogram** — every release records the hold time
+  against the acquire site (``hold_report()``: count / max / p50 / p99 per
+  site, log-spaced buckets from utils/metrics.Histogram). Overload-induced
+  lock convoys — one slow engine step serializing every queue behind the
+  engine lock — show up as a fat p99 at one site; ``assert_clean`` quotes
+  the slowest sites so a failing soak names its convoy.
 
 Usage (the ``sanitizer`` fixture in tests/conftest.py wraps this):
 
@@ -39,6 +45,7 @@ from __future__ import annotations
 
 import asyncio
 import sys
+import time
 from typing import Any
 
 __all__ = ["AsyncSanitizer", "InstrumentedLock", "SanitizerFinding"]
@@ -101,6 +108,7 @@ class InstrumentedLock(asyncio.Lock):
         self._generation = 0
         self._holder: asyncio.Task | None = None
         self._acquire_site = ""
+        self._acquired_at = 0.0
         self._reported_hold = False
 
     async def acquire(self) -> bool:
@@ -111,6 +119,34 @@ class InstrumentedLock(asyncio.Lock):
     def release(self) -> None:
         self._san._on_release(self)
         super().release()
+
+
+class _HoldStats:
+    """Hold-time distribution for one lock acquire site. Uses the shared
+    log-spaced Histogram (utils/metrics.py) — stdlib-only, bounded memory,
+    p99 accurate to one factor-2 bucket — plus the exact max, because the
+    single worst convoy is the number a failing soak needs."""
+
+    __slots__ = ("hist", "max_s")
+
+    def __init__(self) -> None:
+        from matchmaking_tpu.utils.metrics import Histogram
+
+        self.hist = Histogram()
+        self.max_s = 0.0
+
+    def observe(self, seconds: float) -> None:
+        self.hist.observe(seconds)
+        if seconds > self.max_s:
+            self.max_s = seconds
+
+    def to_dict(self) -> dict[str, float]:
+        return {
+            "count": self.hist.count,
+            "max_ms": round(self.max_s * 1e3, 3),
+            "p50_ms": round(self.hist.percentile(50) * 1e3, 3),
+            "p99_ms": round(self.hist.percentile(99) * 1e3, 3),
+        }
 
 
 class AsyncSanitizer:
@@ -138,6 +174,9 @@ class AsyncSanitizer:
         #: an id-keyed set would then silently skip installing the
         #: watchdog on every later loop.
         self._watched_loops: set[Any] = set()
+        #: Held-lock duration accounting: acquire site → _HoldStats (PR 4
+        #: follow-up: make overload-induced lock convoys visible).
+        self._holds: dict[str, _HoldStats] = {}
         self._orig_lock: Any = None
 
     # ---- installation ------------------------------------------------------
@@ -174,11 +213,28 @@ class AsyncSanitizer:
         self._reported.add(dedup)
         self.findings.append(SanitizerFinding(kind, message))
 
+    def hold_report(self, top: int = 0) -> dict[str, dict[str, float]]:
+        """Held-lock durations per acquire site (count / max / p50 / p99 in
+        ms), slowest max first. ``top`` caps the row count (0 = all)."""
+        rows = sorted(self._holds.items(),
+                      key=lambda kv: kv[1].max_s, reverse=True)
+        if top:
+            rows = rows[:top]
+        return {site: stats.to_dict() for site, stats in rows}
+
     def assert_clean(self) -> None:
         if self.findings:
+            # Quote the slowest lock sites alongside the findings: an
+            # overload-induced convoy (every queue serialized behind one
+            # slow engine step) is usually WHY the stall/await finding
+            # fired, and the hold histogram names the site.
+            holds = "\n".join(
+                f"    {site}: {stats}"
+                for site, stats in self.hold_report(top=3).items())
             raise AssertionError(
                 "async sanitizer findings:\n" + "\n".join(
-                    f"  {f!r}" for f in self.findings))
+                    f"  {f!r}" for f in self.findings)
+                + (f"\n  slowest lock sites:\n{holds}" if holds else ""))
 
     # ---- lock events -------------------------------------------------------
 
@@ -210,11 +266,23 @@ class AsyncSanitizer:
         lock._generation += 1
         lock._holder = task
         lock._acquire_site = site
+        lock._acquired_at = time.monotonic()
         lock._reported_hold = False
         loop.call_soon(self._canary, lock, lock._generation, 0)
         self._ensure_stall_watch(loop)
 
     def _on_release(self, lock: InstrumentedLock) -> None:
+        if lock._acquired_at:
+            # Held-lock duration, attributed to the ACQUIRE site (the code
+            # that decided to close the critical section, not whoever
+            # releases it) — lock convoys read as a fat p99 at one site.
+            held_s = time.monotonic() - lock._acquired_at
+            lock._acquired_at = 0.0
+            site = lock._acquire_site or lock._where
+            stats = self._holds.get(site)
+            if stats is None:
+                stats = self._holds[site] = _HoldStats()
+            stats.observe(held_s)
         lock._generation += 1  # invalidate in-flight canaries
         lock._holder = None
         for task, held in list(self._held.items()):

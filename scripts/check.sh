@@ -12,6 +12,13 @@ cd "$(dirname "$0")/.."
 echo "== matchlint =="
 JAX_PLATFORMS=cpu python -m matchmaking_tpu.analysis
 
+echo "== overload =="
+# The overload-control suite (ISSUE 5) runs by marker first: admission /
+# shed / deadline / drain regressions fail fast and by name before the
+# full tier-1 sweep repeats them in context.
+JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'overload and not slow' \
+    --continue-on-collection-errors -p no:cacheprovider
+
 echo "== tier-1 =="
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider

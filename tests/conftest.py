@@ -47,6 +47,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "lint: static-analysis gate (`pytest -m lint` runs "
         "matchlint as a test node; part of tier-1)")
+    config.addinivalue_line(
+        "markers", "overload: overload-control suite (admission/shed/"
+        "deadline/drain — scripts/check.sh runs it by marker; the fast "
+        "ones are tier-1, soaks additionally carry `slow`)")
 
 
 @pytest.fixture

@@ -270,3 +270,93 @@ async def test_trace_sample_n_stamps_every_nth_amqp_publish():
     assert len(stamped) == 3
     assert sum(d.trace is not None for d in got) == 3
     broker.close()
+
+
+# ---- chaos schedules on the AMQP transport (ROADMAP PR 2 follow-up) --------
+
+def _chaos_state(**kw):
+    from matchmaking_tpu.config import ChaosConfig
+    from matchmaking_tpu.utils.chaos import ChaosState
+
+    return ChaosState(ChaosConfig(**kw))
+
+
+@pytest.mark.asyncio
+async def test_amqp_chaos_scripted_drop_and_dup():
+    """The in-proc broker's scripted drop/dup semantics carried over the
+    wire: the seq rides the x-chaos-seq header, a scripted first-attempt
+    drop nack-requeues before the callback (redelivery makes progress),
+    and a dup storm publishes extra copies with their own seqs."""
+    from matchmaking_tpu.service.amqp_transport import CHAOS_SEQ_HEADER
+    from matchmaking_tpu.utils.trace import EventLog
+
+    broker, server = make_broker()
+    broker.chaos = _chaos_state(seed=3, queues=("cq",), drop_seqs=(1,),
+                                dup_seqs=((2, 2),))
+    broker.events = EventLog(64)
+    got = []
+
+    async def on_delivery(d):
+        got.append(d)
+        broker.ack(tag, d.delivery_tag)
+
+    broker.declare_queue("cq")
+    tag = broker.basic_consume("cq", on_delivery)
+    for i in range(3):  # seqs 0,1,2 (storm copies take 3,4)
+        broker.publish("cq", f"m{i}".encode(),
+                       Properties(reply_to="rq", correlation_id=f"c{i}"))
+    # 0 once + 1 once (after one injected drop) + 2 three times = 5.
+    for _ in range(400):
+        if len(got) >= 5:
+            break
+        await drain(0.01)
+    bodies = sorted(d.body for d in got)
+    assert bodies == [b"m0", b"m1", b"m2", b"m2", b"m2"]
+    assert broker.stats["dropped"] == 1
+    assert broker.stats["duplicated"] == 2
+    # The dropped delivery's redelivery is marked redelivered.
+    m1 = [d for d in got if d.body == b"m1"]
+    assert m1[0].redelivered
+    # Storm copies carry their own seq identity (header survives the wire).
+    seqs = sorted(int(d.properties.headers[CHAOS_SEQ_HEADER])
+                  for d in got if d.body == b"m2")
+    assert seqs == [2, 3, 4]
+    kinds = [e["kind"] for e in broker.events.snapshot()]
+    assert "chaos_drop" in kinds and "chaos_dup" in kinds
+    broker.close()
+
+
+@pytest.mark.asyncio
+async def test_amqp_chaos_partition_pause_and_resume():
+    """Scripted partition [pause_seq, resume_seq): the queue's consumer
+    gates shut when the pause seq publishes (deliveries buffer broker-side;
+    at-least-once holds) and reopens on the resume seq — with the
+    partition_max_s failsafe bounding a mis-scripted schedule."""
+    broker, server = make_broker()
+    broker.chaos = _chaos_state(seed=4, queues=("pq",),
+                                partitions=((1, 3),), partition_max_s=10.0)
+    got = []
+
+    async def on_delivery(d):
+        got.append(d)
+        broker.ack(tag, d.delivery_tag)
+
+    broker.declare_queue("pq")
+    tag = broker.basic_consume("pq", on_delivery)
+    broker.publish("pq", b"a", Properties(reply_to="r", correlation_id="a"))
+    for _ in range(200):
+        if got:
+            break
+        await drain(0.01)
+    broker.publish("pq", b"b", Properties(reply_to="r", correlation_id="b"))
+    await drain(0.3)  # paused: b (and anything later) must NOT deliver
+    assert [d.body for d in got] == [b"a"]
+    assert broker.stats["partitions"] == 1
+    broker.publish("pq", b"c", Properties(reply_to="r", correlation_id="c"))
+    broker.publish("pq", b"d", Properties(reply_to="r", correlation_id="d"))
+    for _ in range(400):  # seq 3 (d) resumes the gate
+        if len(got) == 4:
+            break
+        await drain(0.01)
+    assert sorted(d.body for d in got) == [b"a", b"b", b"c", b"d"]
+    broker.close()

@@ -302,3 +302,30 @@ def test_repo_is_clean():
     assert not warnings, "\n".join(warnings)
     assert not new, "matchlint findings:\n" + "\n".join(
         f.render() for f in new)
+
+
+def test_determinism_covers_deadline_propagation_arithmetic():
+    """ISSUE 5 satellite: the rule covers the overload subsystem's new
+    deadline shapes — header-subscript stores, aug-assigns, and
+    deadline= keyword arguments computed from time.time()."""
+    findings = analyze_source('''
+import time
+
+def faults(headers, submit):
+    headers["x-deadline"] = time.time() + 5.0
+    deadline = 10.0
+    deadline += time.time()
+    submit(deadline=time.time() + 1.0)
+''', path="matchmaking_tpu/service/fixture.py")
+    assert _rules(findings) == ["determinism"] * 3
+    # The sanctioned shape: the one wall-clock read is a plain argument
+    # and every derivation takes `now` as a parameter (overload.py).
+    clean = analyze_source('''
+def stamp_deadline(headers, now, budget_s):
+    headers.setdefault("x-deadline", repr(now + budget_s))
+
+def check(headers, now):
+    raw = headers.get("x-deadline")
+    return raw is not None and now >= float(raw)
+''', path="matchmaking_tpu/service/fixture.py")
+    assert clean == []

@@ -144,3 +144,56 @@ def test_stall_detector_installs_on_consecutive_event_loops():
         asyncio.run(quiet())     # first loop: no stall
         asyncio.run(stalling())  # second loop must still be watched
     assert [f for f in san.findings if f.kind == "loop-stall"], san.findings
+
+
+def test_held_lock_duration_histogram_per_site():
+    """PR 4 follow-up: every release records the hold time against the
+    acquire site — lock convoys (one slow critical section serializing
+    everything) become a fat max/p99 at one named site, and a dirty
+    assert_clean quotes the slowest sites."""
+    san = AsyncSanitizer(stall_threshold_s=60.0)
+    hold_line = {}
+
+    async def main():
+        lock = asyncio.Lock()
+        for _ in range(3):
+            hold_line["n"] = inspect.currentframe().f_lineno + 1
+            async with lock:
+                await asyncio.to_thread(time.sleep, 0.05)  # sanctioned hold
+        async with lock:
+            pass  # near-zero hold at a DIFFERENT acquire site
+
+    with san.installed():
+        asyncio.run(main())
+    san.assert_clean()  # sanctioned holds: no findings
+    report = san.hold_report()
+    assert report, "hold report must not be empty"
+    site, stats = next(iter(report.items()))  # slowest-max first
+    assert THIS_FILE in site and str(hold_line["n"]) in site
+    assert stats["count"] == 3
+    assert sum(s["count"] for s in report.values()) == 4  # both sites kept
+    assert stats["max_ms"] >= 50.0
+    # p50 lives in the 50 ms holds' bucket (log-spaced, factor 2).
+    assert stats["p50_ms"] >= 25.0
+    assert stats["p99_ms"] >= stats["p50_ms"]
+    # top=N caps the rows.
+    assert len(san.hold_report(top=1)) == 1
+
+
+def test_assert_clean_failure_quotes_slowest_lock_sites():
+    san = AsyncSanitizer(stall_threshold_s=60.0)
+
+    async def main():
+        lock = asyncio.Lock()
+        async with lock:
+            await asyncio.sleep(0.05)  # planted non-sanctioned suspension
+
+    with san.installed():
+        asyncio.run(main())
+    try:
+        san.assert_clean()
+    except AssertionError as e:
+        assert "slowest lock sites" in str(e)
+        assert THIS_FILE in str(e)
+    else:
+        raise AssertionError("expected findings")
