@@ -23,7 +23,12 @@ silently reintroduce the flake class PR 2 eliminated:
   must be stamped through ``overload.stamp_deadline(headers, now, n)``,
   which takes the one wall-clock read as a parameter), ``deadline +=
   time.time()`` aug-assigns, and ``f(deadline=time.time() + n)`` keyword
-  arguments.
+  arguments. The continuous-telemetry sampler (utils/timeseries.py,
+  ISSUE 6) added another schedule-shaped surface — next-snapshot /
+  next-sample / scrape-due arithmetic — so the same name heuristic covers
+  those tokens too: the sanctioned shapes are ``asyncio.sleep(interval)``
+  cadence (no stored wake time at all) or ``time.monotonic()``;
+  ``time.time()`` remains fine as snapshot DATA (the ring's timestamps).
 """
 
 from __future__ import annotations
@@ -69,17 +74,31 @@ def _contains_time_time(node: ast.AST) -> ast.Call | None:
     return None
 
 
+#: Name substrings that mark a value as schedule-like: wall-clock
+#: arithmetic INTO one of these is the replay hazard. "deadline" covers
+#: the overload subsystem; the snapshot/sample/scrape tokens cover the
+#: telemetry sampler's next-tick shapes (ISSUE 6).
+_CLOCKLIKE_TOKENS = ("deadline", "next_snapshot", "snapshot_due",
+                     "next_sample", "sample_due", "next_scrape",
+                     "scrape_due")
+
+
+def _clocklike(text: str) -> bool:
+    low = text.lower()
+    return any(tok in low for tok in _CLOCKLIKE_TOKENS)
+
+
 def _name_contains_deadline(node: ast.AST) -> bool:
     if isinstance(node, ast.Name):
-        return "deadline" in node.id.lower()
+        return _clocklike(node.id)
     if isinstance(node, ast.Attribute):
-        return "deadline" in node.attr.lower()
+        return _clocklike(node.attr)
     if isinstance(node, ast.Subscript):
         # headers["x-deadline"] = ... — the deadline-propagation header
         # store (service/overload.py) and any dict-carried deadline.
         key = node.slice
         if isinstance(key, ast.Constant) and isinstance(key.value, str):
-            return "deadline" in key.value.lower()
+            return _clocklike(key.value)
         return _name_contains_deadline(node.value)
     return False
 
@@ -120,7 +139,7 @@ class _Scanner(ast.NodeVisitor):
             # f(deadline=time.time() + n): the deadline is born from the
             # wall clock at the call site — pass `now` through and derive
             # inside (overload.stamp_deadline is the sanctioned shape).
-            if (kw.arg is not None and "deadline" in kw.arg.lower()
+            if (kw.arg is not None and _clocklike(kw.arg)
                     and _contains_time_time(kw.value) is not None):
                 self.findings.append(Finding(
                     RULE, self.sf.path, node.lineno,
@@ -136,8 +155,8 @@ class _Scanner(ast.NodeVisitor):
             if tt is not None:
                 self.findings.append(Finding(
                     RULE, self.sf.path, tt.lineno,
-                    "deadline computed from time.time(): wall clocks step "
-                    "(NTP) — use time.monotonic() for deadlines",
+                    "deadline/schedule value computed from time.time(): wall "
+                    "clocks step (NTP) — use time.monotonic()",
                     self._ctx()))
         self.generic_visit(node)
 
@@ -146,8 +165,8 @@ class _Scanner(ast.NodeVisitor):
                 and _contains_time_time(node.value) is not None):
             self.findings.append(Finding(
                 RULE, self.sf.path, node.lineno,
-                "deadline adjusted from time.time(): wall clocks step "
-                "(NTP) — use time.monotonic() for deadlines",
+                "deadline/schedule value adjusted from time.time(): wall "
+                "clocks step (NTP) — use time.monotonic()",
                 self._ctx()))
         self.generic_visit(node)
 
@@ -158,8 +177,8 @@ class _Scanner(ast.NodeVisitor):
                 and any(_name_contains_deadline(s) for s in sides)):
             self.findings.append(Finding(
                 RULE, self.sf.path, node.lineno,
-                "deadline comparison against time.time(): use "
-                "time.monotonic() for deadlines",
+                "deadline/schedule comparison against time.time(): use "
+                "time.monotonic()",
                 self._ctx()))
         self.generic_visit(node)
 

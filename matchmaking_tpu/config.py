@@ -402,6 +402,32 @@ class ObservabilityConfig:
     #: Where /debug/profile?secs=N writes its jax.profiler capture;
     #: "" → a fresh temp directory per capture.
     profile_dir: str = ""
+    #: Continuous telemetry (utils/timeseries.TelemetryRing): the app
+    #: samples a snapshot of per-queue signals (pool size, batch fill,
+    #: breaker state, shed/expired totals, device busy/idle counters,
+    #: stage p99, SLO good/total) every this many seconds into a bounded
+    #: in-proc ring with delta/rate queries — the load signal the elastic
+    #: placement controller (ROADMAP) consumes. 0 disables the sampler.
+    snapshot_interval_s: float = 1.0
+    #: Snapshots kept in the telemetry ring (newest wins).
+    telemetry_ring: int = 512
+    #: Per-queue SLO monitoring (utils/timeseries.SloMonitor): a settled
+    #: request is GOOD when it reached a served outcome (matched / queued /
+    #: deduped — shed and expired burn the budget on purpose) within this
+    #: many milliseconds end to end (enqueue→publish). 0 disables SLO
+    #: accounting and the burn monitors entirely.
+    slo_target_ms: float = 0.0
+    #: Attainment objective: the fraction of requests that must be GOOD
+    #: (0.99 = "99% of requests served within the target").
+    slo_objective: float = 0.99
+    #: Multi-window burn-rate evaluation: the FAST window detects a budget
+    #: bleed quickly, the SLOW window de-flaps; the queue is declared
+    #: burning (``slo_burn`` EventLog event, ``slo_burning`` gauge,
+    #: /healthz ``slo``) only when BOTH windows' burn rates exceed
+    #: ``slo_burn_threshold``.
+    slo_fast_window_s: float = 60.0
+    slo_slow_window_s: float = 300.0
+    slo_burn_threshold: float = 1.0
 
 
 @dataclass(frozen=True)

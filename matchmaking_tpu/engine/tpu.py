@@ -347,6 +347,21 @@ class TpuEngine(Engine):
             "dedupe_s": 0.0, "alloc_s": 0.0, "pack_s": 0.0,
             "h2d_s": 0.0, "jit_s": 0.0,
         }
+        #: Device-utilization accounting (ISSUE 6): monotone busy/idle
+        #: second counters — busy while >= 1 window is dispatched-but-
+        #: unfinalized, idle otherwise — accrued at the open-count 0↔1
+        #: transitions (the spans between transitions are uniformly one or
+        #: the other by construction), plus batch-fill lane counts so
+        #: "effective occupancy" weights windows by how full they were.
+        #: Counters, not gauges: idle FRACTION over any interval is
+        #: delta(idle) / delta(busy + idle) between two scrapes.
+        self.util = {
+            "busy_s": 0.0, "idle_s": 0.0, "readback_s": 0.0,
+            "lanes_valid": 0, "lanes_padded": 0,
+        }
+        #: perf_counter at the last busy/idle transition; written only on
+        #: the caller thread (same single-writer discipline as the mirror).
+        self._util_mark = time.perf_counter()
 
     def _chaos_step(self) -> None:
         """Scripted device-step fault point: called BEFORE any state is
@@ -401,6 +416,10 @@ class TpuEngine(Engine):
                 # grouped windows get their seal mark from the group at
                 # finalize time.
                 pending.marks.append(("readback_seal", time.time()))
+        if self._open == 0:
+            now_pc = time.perf_counter()
+            self.util["idle_s"] += max(0.0, now_pc - self._util_mark)
+            self._util_mark = now_pc
         self._open += 1
         self._pending.append(pending)
 
@@ -663,13 +682,15 @@ class TpuEngine(Engine):
                 reply_to=pool.m_reply[slots].copy(),
                 correlation_id=pool.m_corr[slots].copy(),
             )
-            batch = pool.batch_arrays_cols(cols, slots,
-                                           self._bucket_for(slots.size), t0)
+            bucket = self._bucket_for(slots.size)
+            batch = pool.batch_arrays_cols(cols, slots, bucket, t0)
             step = (rescan_step if rescan_step is not None
                     else self._step_fn(batch))
             self._dev_pool, out = step(
                 self._dev_pool, jnp.asarray(pack_batch(batch, now - t0))
             )
+            self.util["lanes_valid"] += int(slots.size)
+            self.util["lanes_padded"] += bucket
             pending.chunks.append(((cols, slots), (out,), now))
         self._submit(pending)
         self.rescan_tokens.add(pending.token)
@@ -765,6 +786,8 @@ class TpuEngine(Engine):
         )
         self.spans["jit_s"] += time.perf_counter() - _t
         pending.marks.append(("device_step", time.time()))
+        self.util["lanes_valid"] += len(cols)
+        self.util["lanes_padded"] += bucket
         pending.chunks.append(((cols, slots), (out,), now))
 
     def span_report(self) -> dict[str, float]:
@@ -779,6 +802,33 @@ class TpuEngine(Engine):
             **{k.replace("_s", "_ms_avg"): v / w * 1e3
                for k, v in self.spans.items()
                if k in ("dedupe_s", "alloc_s", "pack_s", "h2d_s", "jit_s")},
+        }
+
+    def util_report(self) -> dict[str, float]:
+        """Device-utilization counters (ISSUE 6): monotone busy/idle
+        seconds (the CURRENT open-ended span is added read-only, so two
+        scrapes delta cleanly without a dispatch in between), the
+        h2d/step/readback split, and batch-fill-weighted effective
+        occupancy. Read-only and thread-tolerant: floats read under the
+        GIL, no mutation — /metrics may call this off the engine lock."""
+        now_pc = time.perf_counter()
+        open_span = max(0.0, now_pc - self._util_mark)
+        busy = self.util["busy_s"] + (open_span if self._open else 0.0)
+        idle = self.util["idle_s"] + (0.0 if self._open else open_span)
+        lanes_valid = self.util["lanes_valid"]
+        lanes_padded = self.util["lanes_padded"]
+        return {
+            "device_busy_s": round(busy, 6),
+            "device_idle_s": round(idle, 6),
+            "idle_fraction": round(idle / max(1e-9, busy + idle), 6),
+            "h2d_s": round(self.spans["h2d_s"], 6),
+            "device_step_s": round(self.spans["jit_s"], 6),
+            "readback_s": round(self.util["readback_s"], 6),
+            "windows": self.spans["windows"],
+            "lanes_valid": lanes_valid,
+            "lanes_padded": lanes_padded,
+            "effective_occupancy": round(
+                lanes_valid / max(1, lanes_padded), 6),
         }
 
     def inflight(self) -> int:
@@ -1183,6 +1233,8 @@ class TpuEngine(Engine):
             self._dev_pool, packed_dev
         )
         pending.marks.append(("device_step", time.time()))
+        self.util["lanes_valid"] += len(window)
+        self.util["lanes_padded"] += bucket
         pending.chunks.append((list(window), (out,), now))
 
     def _finalize(self, pending: _Pending) -> None:
@@ -1198,6 +1250,10 @@ class TpuEngine(Engine):
         to check — sync ``search()`` re-raises it so the service's revive
         path fires."""
         self._open -= 1
+        if self._open == 0:
+            now_pc = time.perf_counter()
+            self.util["busy_s"] += max(0.0, now_pc - self._util_mark)
+            self._util_mark = now_pc
         if pending.created:
             self.spans["windows"] += 1
             self.spans["turnaround_s"] += time.perf_counter() - pending.created
@@ -1210,7 +1266,14 @@ class TpuEngine(Engine):
                         if isinstance(h, _GroupSlot)), default=0.0)
             if seal:
                 pending.marks.append(("readback_seal", seal))
-        pending.marks.append(("collect", time.time()))
+        t_collect = time.time()
+        pending.marks.append(("collect", t_collect))
+        # Readback split: seal (D2H queued) → collect is the transfer +
+        # poll span; one monotone counter alongside the spans h2d/jit split.
+        seal_t = next((t for name, t in reversed(pending.marks)
+                       if name == "readback_seal"), None)
+        if seal_t is not None:
+            self.util["readback_s"] += max(0.0, t_collect - seal_t)
         self.window_marks[pending.token] = pending.marks
         while len(self.window_marks) > 512:
             # Unconsumed entries (sync callers, crashed windows) must not

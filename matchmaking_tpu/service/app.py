@@ -46,8 +46,10 @@ from matchmaking_tpu.service.overload import (
     AdmissionController,
     deadline_of,
 )
+from matchmaking_tpu.service.attribution import Attribution
 from matchmaking_tpu.utils.chaos import ChaosState
 from matchmaking_tpu.utils.metrics import Metrics
+from matchmaking_tpu.utils.timeseries import SloMonitor, TelemetryRing
 from matchmaking_tpu.utils.trace import EventLog, FlightRecorder, TraceContext
 
 log = logging.getLogger(__name__)
@@ -1768,6 +1770,21 @@ class MatchmakingApp:
         self.recorder = FlightRecorder(
             self.metrics, ring=obs.trace_ring, slow_ring=obs.slow_trace_ring,
             slow_threshold_s=obs.slow_trace_ms / 1e3)
+        #: Critical-path attribution (service/attribution.py): every
+        #: settled trace's adjacent mark pairs are classified work-vs-wait
+        #: into per-queue category histograms — the numbers behind
+        #: /debug/attribution and the SLO good/total counters.
+        self.attribution = Attribution(
+            buckets=obs.stage_buckets or None,
+            slo_target_s=obs.slo_target_ms / 1e3)
+        self.recorder.attribution = self.attribution
+        #: Continuous telemetry ring (utils/timeseries.py): periodic
+        #: snapshots of per-queue load/SLO/idle signals with delta/rate
+        #: queries; sampled by _telemetry_loop every
+        #: ObservabilityConfig.snapshot_interval_s.
+        self.telemetry = TelemetryRing(obs.telemetry_ring)
+        self._slo_monitors: dict[str, SloMonitor] = {}
+        self._telemetry_task: "asyncio.Task | None" = None
         #: Deterministic chaos runtime (None when no schedule configured):
         #: one shared state so broker faults and per-queue engine fault
         #: hooks replay from a single script (utils/chaos.py).
@@ -1804,6 +1821,26 @@ class MatchmakingApp:
             self._runtimes[queue_cfg.name] = rt
             if self.cfg.engine.warm_start:
                 rt.engine.warmup()
+        obs = self.cfg.observability
+        if obs.slo_target_ms > 0:
+            for name in self._runtimes:
+                self._slo_monitors[name] = SloMonitor(
+                    name, target_ms=obs.slo_target_ms,
+                    objective=obs.slo_objective,
+                    fast_window_s=obs.slo_fast_window_s,
+                    slow_window_s=obs.slo_slow_window_s,
+                    burn_threshold=obs.slo_burn_threshold,
+                    events=self.events, metrics=self.metrics)
+        if obs.snapshot_interval_s > 0:
+            self._telemetry_task = asyncio.create_task(self._telemetry_loop())
+        elif self._slo_monitors:
+            # The burn monitors only evaluate on telemetry ticks — with the
+            # sampler off they would sit inert while a queue misses its SLO.
+            log.warning(
+                "slo_target_ms is set but snapshot_interval_s=0 disables "
+                "the telemetry sampler — SLO burn monitors will never "
+                "evaluate (call sample_telemetry() manually, or set an "
+                "interval)")
         if self.cfg.metrics_port:
             from matchmaking_tpu.service.observability import ObservabilityServer
 
@@ -1816,6 +1853,7 @@ class MatchmakingApp:
     async def stop(self) -> None:
         if not self._started:
             return  # drain() already shut everything down
+        self._stop_telemetry()
         if self._observability is not None:
             await self._observability.stop()
         for rt in self._runtimes.values():
@@ -1837,6 +1875,7 @@ class MatchmakingApp:
         is configured)."""
         directory = (checkpoint_dir if checkpoint_dir is not None
                      else self.cfg.overload.drain_checkpoint_dir)
+        self._stop_telemetry()
         self.events.append("drain_begin", "",
                            f"checkpoint={'on' if directory else 'off'}")
         # Admission off FIRST, across all queues: deliveries that race the
@@ -1854,6 +1893,29 @@ class MatchmakingApp:
         counts: dict[str, int] = {}
         if directory:
             counts = await self.save_checkpoint(directory)
+            # Broker-backlog handoff (ROADMAP carry-over): the consumers
+            # above are cancelled, so any delivery still buffered on a
+            # request queue would die with this process on the in-proc
+            # transport. Include them in the drain checkpoint; the
+            # successor re-publishes them at restore (at-least-once —
+            # restore-side dedup absorbs any overlap with redeliveries).
+            if hasattr(self.broker, "drain_backlog"):
+                import os
+
+                from matchmaking_tpu.utils.checkpoint import save_backlog
+
+                backlog = {
+                    name: self.broker.drain_backlog(name)
+                    for name in self._runtimes
+                }
+                backlog = {k: v for k, v in backlog.items() if v}
+                n_backlog = save_backlog(
+                    os.path.join(directory, "_backlog.json"), backlog)
+                if n_backlog:
+                    self.events.append(
+                        "backlog_checkpointed", "",
+                        f"{n_backlog} unconsumed deliveries across "
+                        f"{len(backlog)} queue(s)")
         self.events.append(
             "drain_complete", "",
             f"{sum(counts.values())} waiting players checkpointed"
@@ -1867,6 +1929,86 @@ class MatchmakingApp:
 
     def runtime(self, queue_name: str) -> _QueueRuntime:
         return self._runtimes[queue_name]
+
+    # ---- continuous telemetry (utils/timeseries.py) ------------------------
+
+    def sample_telemetry(self, now: float | None = None) -> dict[str, float]:
+        """Take one telemetry snapshot into the ring and run the SLO burn
+        monitors. Called by _telemetry_loop on its interval; also public so
+        bench/tests can force a final point before reading trajectories.
+        Read-only against runtimes (pool_size / gauges / monotone counters
+        — the same unguarded surface /metrics already scrapes)."""
+        now = time.time() if now is None else now
+        prev = self.telemetry.latest()
+        prev_vals = prev["values"] if prev is not None else {}
+        vals: dict[str, float] = {
+            "players_matched": self.metrics.counters.get("players_matched"),
+        }
+        gauges = self.metrics.gauges
+        for name, rt in self._runtimes.items():
+            vals[f"pool_size[{name}]"] = float(rt.engine.pool_size())
+            for gauge in ("batch_fill", "breaker_state"):
+                g = gauges.get(f"{gauge}[{name}]")
+                if g is not None:
+                    vals[f"{gauge}[{name}]"] = g
+            if rt.admission is not None:
+                vals[f"shed_total[{name}]"] = float(rt.admission.shed_total)
+                vals[f"expired_total[{name}]"] = float(
+                    rt.admission.expired_total)
+            hist = self.metrics.stages.get(name, {}).get("total")
+            if hist is not None and hist.count:
+                vals[f"stage_total_p99_ms[{name}]"] = round(
+                    hist.percentile(99) * 1e3, 3)
+            totals = self.attribution.queue_totals(name)
+            vals[f"work_s[{name}]"] = round(totals["work_s"], 6)
+            vals[f"wait_s[{name}]"] = round(totals["wait_s"], 6)
+            good, total = self.attribution.slo_counts(name)
+            vals[f"slo_good[{name}]"] = float(good)
+            vals[f"slo_total[{name}]"] = float(total)
+            if hasattr(rt.engine, "util_report"):
+                u = rt.engine.util_report()
+                vals[f"device_busy_s[{name}]"] = u["device_busy_s"]
+                vals[f"device_idle_s[{name}]"] = u["device_idle_s"]
+                vals[f"effective_occupancy[{name}]"] = (
+                    u["effective_occupancy"])
+                # Idle fraction over the SNAPSHOT interval (the trajectory
+                # signal), not lifetime: delta of the monotone counters vs
+                # the previous ring entry; lifetime fraction on the first.
+                # A NEGATIVE delta means the counters reset under us (crash
+                # revive / breaker swap installed a fresh engine) — the
+                # interval spans two engines, so fall back to the new
+                # engine's lifetime fraction instead of a corrupt ratio.
+                db = u["device_busy_s"] - prev_vals.get(
+                    f"device_busy_s[{name}]", 0.0)
+                di = u["device_idle_s"] - prev_vals.get(
+                    f"device_idle_s[{name}]", 0.0)
+                vals[f"idle_frac[{name}]"] = (
+                    round(di / (db + di), 6)
+                    if db >= 0.0 and di >= 0.0 and db + di > 0
+                    else u["idle_fraction"])
+        self.telemetry.append(now, vals)
+        for mon in self._slo_monitors.values():
+            mon.evaluate(self.telemetry, now)
+        return vals
+
+    async def _telemetry_loop(self) -> None:
+        """Periodic sampler. Supervised like the collector: one bad tick
+        must not end the trajectory."""
+        interval = self.cfg.observability.snapshot_interval_s
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                self.sample_telemetry()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("telemetry snapshot failed; retrying")
+                self.metrics.counters.inc("telemetry_errors")
+
+    def _stop_telemetry(self) -> None:
+        if self._telemetry_task is not None:
+            self._telemetry_task.cancel()
+            self._telemetry_task = None
 
     # ---- checkpoint / resume (SURVEY.md §5) --------------------------------
 
@@ -1904,6 +2046,31 @@ class MatchmakingApp:
             async with rt._engine_lock:
                 await rt._drain_engine(now if now is not None else time.time())
                 counts[name] = load_pool(rt.engine, path, now)
+        # Re-publish the predecessor's unconsumed broker backlog (see
+        # drain()): each entry flows through the normal publish path —
+        # fresh delivery tags and trace contexts, original headers
+        # (x-first-received / x-deadline budgets survive the handoff).
+        backlog_path = os.path.join(directory, "_backlog.json")
+        if os.path.exists(backlog_path):
+            from matchmaking_tpu.utils.checkpoint import load_backlog
+
+            per_queue = load_backlog(backlog_path)
+            republished = 0
+            for qname, rows in per_queue.items():
+                for row in rows:
+                    self.broker.publish(
+                        qname, row["body"],
+                        Properties(reply_to=row["reply_to"],
+                                   correlation_id=row["correlation_id"],
+                                   headers=dict(row["headers"])))
+                    republished += 1
+            if republished:
+                self.events.append(
+                    "backlog_restored", "",
+                    f"{republished} unconsumed deliveries re-published "
+                    f"from drain checkpoint")
+                log.info("restored %d unconsumed broker deliveries from %s",
+                         republished, backlog_path)
         return counts
 
 

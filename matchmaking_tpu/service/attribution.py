@@ -1,0 +1,288 @@
+"""Critical-path attribution: decompose settled traces into work vs wait.
+
+BENCH_r04 showed the engine sustaining 48k matches/s while the e2e service
+path delivered 5.9k req/s — an 8x gap the flight recorder (PR 3) could only
+*gesture* at: per-stage histograms say which stage is slow, but not whether
+a request's latency was spent doing work (decode, pack, device step) or
+WAITING for something (broker dwell, the batcher's window clock, a pipeline
+slot, the publish loop). Closing the gap — and the Nitsum-style elastic
+placement controller ROADMAP names next — needs that attribution as
+numbers, continuously.
+
+This module classifies every adjacent mark pair of a settled trace
+(utils/trace.TraceContext) into a named category with a WORK/WAIT kind:
+
+==================  =====  =====================================================
+gap (prev → cur)    kind   meaning
+==================  =====  =====================================================
+enqueue→consume     wait   broker_dwell — queued in the broker before a
+                           consumer picked it up
+*→consume (redel.)  wait   redelivery_wait — nack/drop to redelivery pickup
+consume→middleware  work   middleware — auth + validity checks
+*→batch             work   ingress — decode/submit into the batcher
+batch→flush         wait   batcher_hold — the window clock (max_wait_ms) or
+                           windows queued ahead under saturation
+flush→dispatch      wait   pipeline_slot_wait — engine-lock + pipeline-depth
+                           backpressure + pre-dispatch sweeps
+dispatch→h2d        work   pack_h2d — host pack + host→device transfer
+h2d→device_step     work   device_step — the jitted kernel dispatch
+dispatch→collect    work   engine_step — synchronous host-oracle engines
+                           (no h2d/readback marks)
+dispatch→oracle_…   work   oracle_step — delegated team/role oracle window
+device_step→seal    wait   readback_group_wait — results waiting for their
+                           readback group to fill/go stale
+seal→collect        wait   readback_transfer — D2H in flight + collect poll
+collect→publish     wait   publish_lag — outcome handling queue on the loop
+*→dedup_replay      work   dedup_replay — terminal-response replay
+*→shed / *→expired  work   admission — shed/expire decision + response
+*→reject            work   reject — middleware/contract rejection
+*→chaos_drop        wait   broker_dwell — the drop happened at the consume
+                           point; the dwell before it is broker time
+==================  =====  =====================================================
+
+Per queue it maintains, for each category: gap count, cumulative seconds, a
+log-bucketed histogram (utils/metrics.Histogram), and the number of distinct
+traces touching the category (the replay-stable count: chunked windows emit
+a variable number of h2d/device_step gaps per trace, but whether a trace
+touched a category at all is a pure function of its lifecycle under seeded
+chaos). Work + wait sums telescope to the enqueue→publish span exactly, by
+construction — that identity is the smoke test scripts/check.sh runs.
+
+When an SLO target is configured (ObservabilityConfig.slo_target_ms) it also
+counts per-queue attainment: a settled trace is GOOD when it reached a
+served outcome (not shed/expired/rejected/timeout) within the target.
+Shed/expired requests burn the SLO on purpose — an objective met by
+rejecting everyone is not met.
+
+Loop-confined like the batcher and AdmissionController: ``observe`` runs on
+the event loop (every trace-settle path does), never from worker threads —
+there is deliberately no lock here.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from matchmaking_tpu.utils.metrics import DEFAULT_STAGE_BUCKETS, Histogram
+
+WORK = "work"
+WAIT = "wait"
+
+#: Statuses that count as a served outcome for SLO attainment.
+_SERVED_STATUSES = frozenset({"matched", "queued", "deduped"})
+
+#: Classification keyed by the LATER mark of the pair (the mark a duration
+#: is attributed to); pairs not covered here go through ``classify``'s
+#: special cases, and genuinely unknown marks land in other_work/other_wait
+#: so the work+wait identity still holds for novel mark vocabularies.
+_BY_TARGET: dict[str, tuple[str, str]] = {
+    "middleware": ("middleware", WORK),
+    "batch": ("ingress", WORK),
+    "flush": ("batcher_hold", WAIT),
+    "dispatch": ("pipeline_slot_wait", WAIT),
+    "h2d": ("pack_h2d", WORK),
+    "device_step": ("device_step", WORK),
+    "oracle_step": ("oracle_step", WORK),
+    "readback_seal": ("readback_group_wait", WAIT),
+    "collect": ("readback_transfer", WAIT),
+    "publish": ("publish_lag", WAIT),
+    "dedup_replay": ("dedup_replay", WORK),
+    "reject": ("reject", WORK),
+    "shed": ("admission", WORK),
+    "expired": ("admission", WORK),
+    "chaos_drop": ("broker_dwell", WAIT),
+}
+
+#: Marks whose presence means real work happened even when unknown pairs
+#: surround them (conservative fallback kind for unknown TARGETS).
+_KNOWN_WORK_MARKS = frozenset(
+    name for name, (_, kind) in _BY_TARGET.items() if kind == WORK)
+
+
+def classify(prev: str, cur: str) -> tuple[str, str]:
+    """(category, kind) for the duration between marks ``prev`` and
+    ``cur``. Total classification: every pair maps somewhere, so a trace's
+    category durations always sum to its span."""
+    if cur == "consume":
+        return (("broker_dwell", WAIT) if prev == "enqueue"
+                else ("redelivery_wait", WAIT))
+    if cur == "collect" and prev in ("dispatch", "flush"):
+        # Synchronous engines (host oracle, non-pipelined flush) bracket the
+        # whole engine step with dispatch→collect and ship no device marks.
+        return ("engine_step", WORK)
+    got = _BY_TARGET.get(cur)
+    if got is not None:
+        return got
+    return (("other_work", WORK) if cur in _KNOWN_WORK_MARKS
+            else ("other_wait", WAIT))
+
+
+def decompose_marks(
+        marks) -> tuple[list[dict[str, Any]], float, float]:
+    """THE gap walk: classify every adjacent pair of a mark sequence
+    (``[(name, t), ...]`` — tuples or JSON lists) into the taxonomy.
+    Returns (gaps, work_s, wait_s); work + wait telescopes to the span.
+    Shared by ``decompose`` (server side) and the trace_dump ``--gaps``
+    waterfall (CLI side) so the two can never disagree."""
+    gaps: list[dict[str, Any]] = []
+    work_s = 0.0
+    wait_s = 0.0
+    prev_name, prev_t = marks[0]
+    for name, t in marks[1:]:
+        dur = max(0.0, t - prev_t)
+        category, kind = classify(prev_name, name)
+        if kind == WORK:
+            work_s += dur
+        else:
+            wait_s += dur
+        gaps.append({"from": prev_name, "to": name, "category": category,
+                     "kind": kind, "ms": round(dur * 1e3, 3)})
+        prev_name, prev_t = name, t
+    return gaps, work_s, wait_s
+
+
+def decompose(trace) -> dict[str, Any]:
+    """One trace's full wait-vs-work decomposition (JSON-ready): the
+    per-gap waterfall plus work/wait sums that — by telescoping — equal the
+    enqueue→publish span exactly."""
+    gaps, work_s, wait_s = decompose_marks(trace.marks)
+    return {
+        "trace_id": trace.trace_id,
+        "status": trace.status,
+        "total_ms": round(trace.total_s * 1e3, 3),
+        "work_ms": round(work_s * 1e3, 3),
+        "wait_ms": round(wait_s * 1e3, 3),
+        "gaps": gaps,
+    }
+
+
+class _Category:
+    __slots__ = ("kind", "gaps", "traces", "total_s", "hist")
+
+    def __init__(self, kind: str, buckets: tuple[float, ...]):
+        self.kind = kind
+        self.gaps = 0
+        self.traces = 0
+        self.total_s = 0.0
+        self.hist = Histogram(buckets)
+
+
+class _QueueAttribution:
+    __slots__ = ("categories", "work_s", "wait_s", "spans", "total_hist",
+                 "statuses", "slo_good", "slo_total")
+
+    def __init__(self, buckets: tuple[float, ...]):
+        self.categories: dict[str, _Category] = {}
+        self.work_s = 0.0
+        self.wait_s = 0.0
+        self.spans = 0
+        self.total_hist = Histogram(buckets)
+        self.statuses: dict[str, int] = {}
+        self.slo_good = 0
+        self.slo_total = 0
+
+
+class Attribution:
+    """Per-queue wait-vs-work accounting over settled traces, fed by
+    FlightRecorder.complete. All counters are monotone, so deltas between
+    any two scrapes are well-defined (the telemetry ring samples them)."""
+
+    def __init__(self, buckets: tuple[float, ...] | None = None,
+                 slo_target_s: float = 0.0):
+        self.buckets = tuple(buckets or DEFAULT_STAGE_BUCKETS)
+        self.slo_target_s = slo_target_s
+        self._queues: dict[str, _QueueAttribution] = {}
+
+    def _queue(self, q: str) -> _QueueAttribution:
+        qa = self._queues.get(q)
+        if qa is None:
+            qa = self._queues[q] = _QueueAttribution(self.buckets)
+        return qa
+
+    def observe(self, trace) -> None:
+        qa = self._queue(trace.queue)
+        marks = trace.marks
+        touched: set[str] = set()
+        prev_name, prev_t = marks[0]
+        for name, t in marks[1:]:
+            dur = max(0.0, t - prev_t)
+            category, kind = classify(prev_name, name)
+            cat = qa.categories.get(category)
+            if cat is None:
+                cat = qa.categories[category] = _Category(kind, self.buckets)
+            cat.gaps += 1
+            cat.total_s += dur
+            cat.hist.observe(dur)
+            if category not in touched:
+                touched.add(category)
+                cat.traces += 1
+            if kind == WORK:
+                qa.work_s += dur
+            else:
+                qa.wait_s += dur
+            prev_name, prev_t = name, t
+        qa.spans += 1
+        total = trace.total_s
+        qa.total_hist.observe(total)
+        status = trace.status or "unknown"
+        qa.statuses[status] = qa.statuses.get(status, 0) + 1
+        if self.slo_target_s > 0:
+            qa.slo_total += 1
+            if status in _SERVED_STATUSES and total <= self.slo_target_s:
+                qa.slo_good += 1
+
+    # ---- reads -------------------------------------------------------------
+
+    def slo_counts(self, queue: str) -> tuple[int, int]:
+        """(good, total) settled-trace SLO counters for one queue — the
+        cumulative series the burn-rate monitor differences."""
+        qa = self._queues.get(queue)
+        return (qa.slo_good, qa.slo_total) if qa is not None else (0, 0)
+
+    def queue_totals(self, queue: str) -> dict[str, float]:
+        """Monotone per-queue sums for the telemetry ring."""
+        qa = self._queues.get(queue)
+        if qa is None:
+            return {"work_s": 0.0, "wait_s": 0.0, "spans": 0.0}
+        return {"work_s": qa.work_s, "wait_s": qa.wait_s,
+                "spans": float(qa.spans)}
+
+    def snapshot(self, queue: str | None = None) -> dict[str, Any]:
+        queues = [queue] if queue is not None else sorted(self._queues)
+        out: dict[str, Any] = {}
+        for q in queues:
+            qa = self._queues.get(q)
+            if qa is None:
+                continue
+            span_s = qa.work_s + qa.wait_s
+            cats = {
+                name: {
+                    "kind": cat.kind,
+                    "gaps": cat.gaps,
+                    "traces": cat.traces,
+                    "total_s": round(cat.total_s, 6),
+                    "share": round(cat.total_s / span_s, 4) if span_s else 0.0,
+                    "p99_ms": round(cat.hist.percentile(99) * 1e3, 3)
+                    if cat.hist.count else None,
+                }
+                for name, cat in sorted(qa.categories.items())
+            }
+            entry: dict[str, Any] = {
+                "spans": qa.spans,
+                "work_s": round(qa.work_s, 6),
+                "wait_s": round(qa.wait_s, 6),
+                "wait_fraction": round(qa.wait_s / span_s, 4) if span_s else 0.0,
+                "statuses": dict(sorted(qa.statuses.items())),
+                "p99_total_ms": round(qa.total_hist.percentile(99) * 1e3, 3)
+                if qa.total_hist.count else None,
+                "categories": cats,
+            }
+            if self.slo_target_s > 0:
+                entry["slo_good"] = qa.slo_good
+                entry["slo_total"] = qa.slo_total
+                entry["slo_attainment"] = (
+                    round(qa.slo_good / qa.slo_total, 4)
+                    if qa.slo_total else None)
+            out[q] = entry
+        return {"slo_target_ms": round(self.slo_target_s * 1e3, 3),
+                "queues": out}

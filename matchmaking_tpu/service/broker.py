@@ -333,6 +333,20 @@ class InProcBroker:
         q = self._queues.get(name)
         return q.messages.qsize() if q else 0
 
+    def drain_backlog(self, name: str) -> list[Delivery]:
+        """Pop every delivery still buffered on ``name`` (drain handoff:
+        after the queue's consumers are cancelled, these messages would die
+        with the process — the app checkpoints them instead and a successor
+        re-publishes them). Call only after basic_cancel'ing the queue's
+        consumers, or live consumers race the pop."""
+        q = self._queues.get(name)
+        out: list[Delivery] = []
+        if q is None:
+            return out
+        while not q.messages.empty():
+            out.append(q.messages.get_nowait())
+        return out
+
     def handlers_idle(self) -> bool:
         """True when no consumer has a handler task outstanding — i.e. no
         delivery is inside a created-(possibly-unstarted)-handler, which

@@ -16,6 +16,11 @@ SURVEY.md §7 [ENV]). Surfaces:
   ``?id=`` single-trace lookup).
 - ``/debug/events`` — the lifecycle event timeline (breaker trips, probes,
   delegations, re-promotions, revives, chaos faults; ``?queue=``/``?n=``).
+- ``/debug/attribution`` — critical-path attribution (service/attribution):
+  per-queue wait-vs-work decomposition of settled spans, device idle
+  fraction, SLO burn state, and the p99 exemplar's exact gap waterfall.
+- ``/debug/telemetry`` — the continuous telemetry ring
+  (utils/timeseries.py): periodic snapshots with ``?n=``/``?key=`` filters.
 - ``/debug/profile?secs=N`` — a jax.profiler capture of the live serving
   process (returns the trace directory; view with TensorBoard/XProf).
 """
@@ -82,6 +87,31 @@ def build_report(app) -> dict[str, Any]:
     }
     if overload:
         report["overload"] = overload
+    # Device-utilization counters (ISSUE 6): monotone busy/idle seconds +
+    # h2d/step/readback split + effective occupancy per device-engine
+    # queue — idle FRACTION over any interval is a delta of two scrapes.
+    util = {
+        name: rt.engine.util_report()
+        for name, rt in app._runtimes.items()
+        if hasattr(rt.engine, "util_report")
+    }
+    if util:
+        report["device_util"] = util
+    # Critical-path attribution + SLO burn state (ISSUE 6).
+    attribution = getattr(app, "attribution", None)
+    if attribution is not None:
+        report["attribution"] = attribution.snapshot()
+    slo = {
+        name: mon.snapshot()
+        for name, mon in getattr(app, "_slo_monitors", {}).items()
+    }
+    if slo:
+        report["slo"] = slo
+    telemetry = getattr(app, "telemetry", None)
+    if telemetry is not None:
+        latest = telemetry.latest()
+        if latest is not None:
+            report["telemetry_last"] = latest
     return report
 
 
@@ -151,6 +181,29 @@ def _flatten_prom(report: dict[str, Any]) -> str:
         for stat, value in counters.items():
             fams.add(f"matchmaking_engine_{stat}", "counter",
                      {"queue": queue}, value)
+    # Device utilization (monotone counters + one gauge): idle fraction
+    # between any two scrapes is delta(idle) / delta(busy + idle).
+    for queue, u in report.get("device_util", {}).items():
+        fams.add("matchmaking_device_busy_seconds", "counter",
+                 {"queue": queue}, u["device_busy_s"])
+        fams.add("matchmaking_device_idle_seconds", "counter",
+                 {"queue": queue}, u["device_idle_s"])
+        fams.add("matchmaking_device_readback_seconds", "counter",
+                 {"queue": queue}, u["readback_s"])
+        fams.add("matchmaking_device_effective_occupancy", "gauge",
+                 {"queue": queue}, u["effective_occupancy"])
+    # Attribution work/wait: cumulative seconds per queue and per category
+    # (counters — rate() in PromQL gives the live wait-vs-work split).
+    for queue, entry in report.get("attribution", {}).get("queues",
+                                                          {}).items():
+        fams.add("matchmaking_attributed_work_seconds", "counter",
+                 {"queue": queue}, entry["work_s"])
+        fams.add("matchmaking_attributed_wait_seconds", "counter",
+                 {"queue": queue}, entry["wait_s"])
+        for cat, c in entry.get("categories", {}).items():
+            fams.add("matchmaking_attribution_seconds", "counter",
+                     {"queue": queue, "category": cat, "kind": c["kind"]},
+                     c["total_s"])
     # True per-stage latency histograms (the flight recorder's output) as a
     # proper histogram family: cumulative le buckets + _sum + _count.
     for queue, stages in report.get("stage_seconds", {}).items():
@@ -208,7 +261,12 @@ class ObservabilityServer:
             admission = getattr(rt, "admission", None)
             if admission is not None:
                 entry["overload"] = admission.snapshot()
+            monitor = getattr(self.app, "_slo_monitors", {}).get(name)
+            if monitor is not None:
+                entry["slo"] = monitor.snapshot()
             queues[name] = entry
+        burning = [name for name, q in queues.items()
+                   if q.get("slo", {}).get("burning")]
         body = {
             # Degraded ≠ dead: matches still flow on the host path, so the
             # service stays live — operators alert on the field instead.
@@ -217,6 +275,10 @@ class ObservabilityServer:
                 q.get("overload", {}).get("draining") for q in queues.values())
                 else "degraded" if degraded else "ok"),
             "degraded_queues": degraded,
+            # SLO burn is orthogonal to liveness: a burning queue is up
+            # but missing its latency objective — routing/placement acts
+            # on this field, not on status.
+            "slo_burning_queues": burning,
             "queues": queues,
         }
         return web.json_response(body)
@@ -255,6 +317,54 @@ class ObservabilityServer:
             limit = 32
         return web.json_response(
             recorder.snapshot(queue=request.query.get("queue"), limit=limit))
+
+    async def _debug_attribution(self, request) -> "web.Response":
+        """Critical-path attribution (service/attribution.py): per-queue
+        wait-vs-work decomposition of settled enqueue→publish spans —
+        category sums/histogram p99s, the device idle fraction, SLO
+        attainment, and the p99 EXEMPLAR trace's exact decomposition
+        (its gap durations sum to its span by construction, so "X% of the
+        p99 is wait behind the broker" is a number, not an inference).
+        ``?queue=`` filters; ``?p=`` picks the exemplar percentile."""
+        attribution = getattr(self.app, "attribution", None)
+        if attribution is None or not getattr(self.app, "trace_enabled", True):
+            return web.json_response({"error": "attribution disabled"},
+                                     status=404)
+        try:
+            p = min(100.0, max(0.0, float(request.query.get("p", "99"))))
+        except ValueError:
+            p = 99.0
+        body = attribution.snapshot(queue=request.query.get("queue"))
+        from matchmaking_tpu.service.attribution import decompose
+
+        for q, entry in body["queues"].items():
+            rt = self.app._runtimes.get(q)
+            if rt is not None and hasattr(rt.engine, "util_report"):
+                entry["device_util"] = rt.engine.util_report()
+            monitor = getattr(self.app, "_slo_monitors", {}).get(q)
+            if monitor is not None:
+                entry["slo"] = monitor.snapshot()
+            exemplar = self.app.recorder.percentile_exemplar(q, p)
+            if exemplar is not None:
+                entry[f"p{p:g}_exemplar"] = decompose(exemplar)
+        return web.json_response(body)
+
+    async def _debug_telemetry(self, request) -> "web.Response":
+        """The continuous telemetry ring (utils/timeseries.py): ``?n=``
+        tail length, ``?key=`` comma-separated key-prefix filter
+        (``idle_frac`` matches every queue's ``idle_frac[q]`` series)."""
+        telemetry = getattr(self.app, "telemetry", None)
+        if telemetry is None:
+            return web.json_response({"error": "telemetry disabled"},
+                                     status=404)
+        try:
+            limit = int(request.query.get("n", "0"))
+        except ValueError:
+            limit = 0
+        prefixes = tuple(k for k in request.query.get("key", "").split(",")
+                         if k)
+        return web.json_response({
+            "snapshots": telemetry.snapshot(limit=limit, prefixes=prefixes)})
 
     async def _debug_events(self, request) -> "web.Response":
         """Lifecycle event timeline (``?queue=`` filter, ``?n=`` tail)."""
@@ -318,6 +428,8 @@ class ObservabilityServer:
         http_app.router.add_get("/healthz", self._healthz)
         http_app.router.add_get("/metrics", self._metrics)
         http_app.router.add_get("/debug/traces", self._debug_traces)
+        http_app.router.add_get("/debug/attribution", self._debug_attribution)
+        http_app.router.add_get("/debug/telemetry", self._debug_telemetry)
         http_app.router.add_get("/debug/events", self._debug_events)
         http_app.router.add_get("/debug/profile", self._debug_profile)
         self._runner = web.AppRunner(http_app)

@@ -24,6 +24,77 @@ from matchmaking_tpu.service.contract import RequestColumns
 
 FORMAT_VERSION = 1
 
+#: Broker-backlog sidecar format (drain handoff of unconsumed deliveries).
+BACKLOG_VERSION = 1
+
+
+def save_backlog(path: str, per_queue: "dict[str, list]") -> int:
+    """Serialize unconsumed broker deliveries (queue → list of Delivery)
+    to a JSON sidecar next to the pool checkpoints. Bodies are base64
+    (they are arbitrary bytes); properties keep only the wire-meaningful
+    fields (reply_to / correlation_id / headers) — delivery tags and trace
+    contexts are process-local and minted fresh at re-publish. Returns the
+    number of deliveries saved (0 writes an empty file so a restore can
+    distinguish "no backlog" from "no handoff")."""
+    import base64
+
+    rows = {
+        queue: [
+            {
+                "body": base64.b64encode(bytes(d.body)).decode("ascii"),
+                "reply_to": d.properties.reply_to,
+                "correlation_id": d.properties.correlation_id,
+                # Headers are wire-shaped (str/float) by convention; a
+                # non-JSON value must not lose the whole backlog.
+                "headers": {k: (v if isinstance(v, (str, int, float, bool))
+                                else str(v))
+                            for k, v in d.properties.headers.items()},
+                "redelivered": bool(d.redelivered),
+            }
+            for d in deliveries
+        ]
+        for queue, deliveries in per_queue.items()
+    }
+    n = sum(len(v) for v in rows.values())
+    payload = {"version": BACKLOG_VERSION, "saved_at": time.time(),
+               "count": n, "queues": rows}
+    directory = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".json.tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    return n
+
+
+def load_backlog(path: str) -> "dict[str, list[dict]]":
+    """Inverse of save_backlog: queue → rows with decoded ``body`` bytes
+    plus reply_to / correlation_id / headers, ready for broker.publish."""
+    import base64
+
+    with open(path) as f:
+        payload = json.load(f)
+    if payload.get("version") != BACKLOG_VERSION:
+        raise ValueError(
+            f"unsupported backlog version: {payload.get('version')}")
+    out: dict[str, list[dict]] = {}
+    for queue, rows in payload.get("queues", {}).items():
+        out[queue] = [
+            {
+                "body": base64.b64decode(row["body"]),
+                "reply_to": row.get("reply_to", ""),
+                "correlation_id": row.get("correlation_id", ""),
+                "headers": dict(row.get("headers", {})),
+                "redelivered": bool(row.get("redelivered", False)),
+            }
+            for row in rows
+        ]
+    return out
+
 
 def engine_waiting_columns(engine) -> tuple[RequestColumns, np.ndarray, np.ndarray]:
     """Waiting pool as columns + region/mode NAME arrays.

@@ -137,6 +137,11 @@ class FlightRecorder:
         self.slow_threshold_s = slow_threshold_s
         self._recent: dict[str, deque[TraceContext]] = {}
         self._slow: dict[str, deque[TraceContext]] = {}
+        #: Critical-path attribution sink (service/attribution.Attribution),
+        #: attached by the app: every settled trace is decomposed into
+        #: work-vs-wait categories alongside the stage histograms. None =
+        #: attribution off.
+        self.attribution = None
 
     def complete(self, trace: TraceContext) -> None:
         """Settle one trace: derive per-stage durations from adjacent mark
@@ -152,6 +157,8 @@ class FlightRecorder:
                 observe(q, name, max(0.0, t - prev_t))
                 prev_t = t
             observe(q, "total", max(0.0, marks[-1][1] - marks[0][1]))
+        if self.attribution is not None:
+            self.attribution.observe(trace)
         ring = self._recent.get(q)
         if ring is None:
             ring = self._recent[q] = deque(maxlen=self._ring)
@@ -161,6 +168,22 @@ class FlightRecorder:
             if slow is None:
                 slow = self._slow[q] = deque(maxlen=self._slow_ring)
             slow.append(trace)
+
+    def percentile_exemplar(self, queue: str,
+                            p: float = 99.0) -> TraceContext | None:
+        """The settled trace sitting at the p-th percentile of total span
+        among the RECENT ring (nearest rank) — the exemplar whose
+        decomposition /debug/attribution quotes: unlike a histogram-side
+        p99, its per-gap durations sum to its span exactly."""
+        ring = self._recent.get(queue)
+        if not ring:
+            return None
+        by_total = sorted(ring, key=lambda t: t.total_s)
+        import math
+
+        k = min(len(by_total) - 1,
+                max(0, math.ceil(p / 100.0 * len(by_total)) - 1))
+        return by_total[k]
 
     def get(self, trace_id: str) -> TraceContext | None:
         for rings in (self._slow, self._recent):

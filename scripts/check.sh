@@ -12,6 +12,13 @@ cd "$(dirname "$0")/.."
 echo "== matchlint =="
 JAX_PLATFORMS=cpu python -m matchmaking_tpu.analysis
 
+echo "== attribution smoke =="
+# ISSUE 6 fast gate: a seeded 400-player soak must decompose every settled
+# trace into work + wait that sums to its e2e span (telescoping identity),
+# with the histogram-side p99 agreeing within one log bucket.
+JAX_PLATFORMS=cpu python -m pytest tests/test_attribution.py -q \
+    -k 'smoke' --continue-on-collection-errors -p no:cacheprovider
+
 echo "== overload =="
 # The overload-control suite (ISSUE 5) runs by marker first: admission /
 # shed / deadline / drain regressions fail fast and by name before the

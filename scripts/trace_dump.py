@@ -14,7 +14,15 @@ request slow" workflow:
     # recent lifecycle events (breaker trips, probes, chaos faults)
     python scripts/trace_dump.py --events
 
-Pure stdlib (urllib) — usable inside the service container.
+    # wait-vs-work gap waterfall (the ISSUE 6 attribution taxonomy)
+    python scripts/trace_dump.py --queue matchmaking.search --slow --gaps
+
+    # per-queue attribution summary (/debug/attribution)
+    python scripts/trace_dump.py --attribution
+
+Stdlib (urllib) transport — usable inside the service container; the
+``--gaps`` classifier imports matchmaking_tpu.service.attribution, which is
+on the path wherever the service runs.
 """
 
 from __future__ import annotations
@@ -66,6 +74,71 @@ def render_trace(tr: dict, out=sys.stdout) -> None:
     print("", file=out)
 
 
+def render_gaps(tr: dict, out=sys.stdout) -> None:
+    """One trace as a wait-vs-work gap waterfall. Rendering only — the
+    classification comes from attribution.decompose_marks, the SAME walk
+    /debug/attribution uses, so the CLI can never disagree with the
+    server-side decomposition."""
+    from matchmaking_tpu.service.attribution import WAIT, decompose_marks
+
+    marks = tr.get("marks", [])
+    head = (f"{tr.get('trace_id', '?')}  queue={tr.get('queue', '?')} "
+            f"player={tr.get('player_id') or '-'} "
+            f"status={tr.get('status') or '-'} "
+            f"total={tr.get('total_ms', 0):.3f}ms")
+    print(head, file=out)
+    if len(marks) < 2:
+        return
+    gaps, work_s, wait_s = decompose_marks(marks)
+    for gap in gaps:
+        delta = gap["ms"]
+        bar = ("." if gap["kind"] == WAIT
+               else "#") * min(40, max(0, int(delta)))
+        print(f"  +{delta:9.3f}ms  {gap['kind']:<4} {gap['category']:<20} "
+              f"{gap['from']}->{gap['to']:<14} {bar}", file=out)
+    total = work_s + wait_s
+    frac = wait_s / total if total else 0.0
+    print(f"  = work {work_s * 1e3:.3f}ms + wait {wait_s * 1e3:.3f}ms "
+          f"({frac:.0%} waiting)\n", file=out)
+
+
+def render_attribution(body: dict, out=sys.stdout) -> None:
+    """Per-queue attribution summary (/debug/attribution)."""
+    print(f"SLO target: {body.get('slo_target_ms', 0):.1f} ms", file=out)
+    for queue, entry in sorted(body.get("queues", {}).items()):
+        wait_frac = entry.get("wait_fraction", 0.0)
+        print(f"== {queue}: {entry.get('spans', 0)} spans, "
+              f"p99 {entry.get('p99_total_ms')} ms, "
+              f"{wait_frac:.0%} waiting", file=out)
+        util = entry.get("device_util")
+        if util:
+            print(f"   device: idle {util['idle_fraction']:.1%}, "
+                  f"occupancy {util['effective_occupancy']:.1%}, "
+                  f"busy {util['device_busy_s']:.1f}s / "
+                  f"idle {util['device_idle_s']:.1f}s", file=out)
+        slo = entry.get("slo")
+        if slo:
+            print(f"   slo: attainment fast={slo['attainment_fast']} "
+                  f"slow={slo['attainment_slow']} "
+                  f"burn fast={slo['burn_fast']} slow={slo['burn_slow']}"
+                  f"{'  BURNING' if slo.get('burning') else ''}", file=out)
+        for name, cat in sorted(
+                entry.get("categories", {}).items(),
+                key=lambda kv: -kv[1]["total_s"]):
+            print(f"   {cat['kind']:<4} {name:<22} "
+                  f"{cat['total_s'] * 1e3:12.1f}ms total "
+                  f"({cat['share']:6.1%})  p99 {cat['p99_ms']} ms  "
+                  f"[{cat['traces']} traces / {cat['gaps']} gaps]", file=out)
+        exemplar = next((v for k, v in entry.items()
+                         if k.endswith("_exemplar")), None)
+        if exemplar:
+            print(f"   p99 exemplar {exemplar['trace_id']}: "
+                  f"{exemplar['total_ms']:.1f}ms = "
+                  f"work {exemplar['work_ms']:.1f}ms + "
+                  f"wait {exemplar['wait_ms']:.1f}ms", file=out)
+        print("", file=out)
+
+
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--host", default="127.0.0.1")
@@ -77,10 +150,24 @@ def main(argv=None) -> None:
     ap.add_argument("--n", type=int, default=16, help="traces per ring")
     ap.add_argument("--events", action="store_true",
                     help="show the lifecycle event log instead of traces")
+    ap.add_argument("--gaps", action="store_true",
+                    help="render traces as a wait-vs-work gap waterfall "
+                         "(attribution taxonomy) instead of raw stages")
+    ap.add_argument("--attribution", action="store_true",
+                    help="per-queue attribution summary "
+                         "(/debug/attribution)")
     ap.add_argument("--json", action="store_true",
                     help="raw JSON instead of the waterfall rendering")
     args = ap.parse_args(argv)
     base = f"http://{args.host}:{args.port}"
+
+    if args.attribution:
+        body = _get(base, "/debug/attribution", {"queue": args.queue})
+        if args.json:
+            print(json.dumps(body, indent=2))
+        else:
+            render_attribution(body)
+        return
 
     if args.events:
         body = _get(base, "/debug/events",
@@ -93,12 +180,14 @@ def main(argv=None) -> None:
                   + (f" — {ev['detail']}" if ev.get("detail") else ""))
         return
 
+    render = render_gaps if args.gaps else render_trace
+
     if args.id:
         tr = _get(base, "/debug/traces", {"id": args.id})
         if args.json:
             print(json.dumps(tr, indent=2))
         else:
-            render_trace(tr)
+            render(tr)
         return
 
     body = _get(base, "/debug/traces", {"queue": args.queue, "n": args.n})
@@ -111,7 +200,7 @@ def main(argv=None) -> None:
         traces = rings.get(ring, [])
         print(f"== {queue}: {len(traces)} {ring} trace(s)")
         for tr in traces:
-            render_trace(tr)
+            render(tr)
 
 
 if __name__ == "__main__":

@@ -329,3 +329,32 @@ def check(headers, now):
     return raw is not None and now >= float(raw)
 ''', path="matchmaking_tpu/service/fixture.py")
     assert clean == []
+
+
+def test_determinism_covers_snapshot_interval_arithmetic():
+    """ISSUE 6 satellite: the continuous-telemetry sampler added a
+    schedule-shaped surface — next-snapshot / sample-due arithmetic born
+    from time.time() is the same replay hazard as deadline math. The
+    sanctioned shapes are asyncio.sleep cadence (no stored wake time) or
+    time.monotonic(); time.time() stays legal as snapshot DATA."""
+    findings = analyze_source('''
+import time
+
+class Sampler:
+    def schedule(self, interval):
+        self._next_snapshot = time.time() + interval
+        sample_due = time.time() + interval
+        if time.time() >= self._next_snapshot:
+            return True
+''', path="matchmaking_tpu/utils/fixture.py")
+    assert _rules(findings) == ["determinism"] * 3
+    clean = analyze_source('''
+import time
+
+class Sampler:
+    def sample(self, ring):
+        # wall clock as DATA (the ring timestamp), monotonic for cadence
+        ring.append(time.time(), {"x": 1.0})
+        self._next_snapshot = time.monotonic() + 1.0
+''', path="matchmaking_tpu/utils/fixture.py")
+    assert clean == []
