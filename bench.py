@@ -418,7 +418,10 @@ def bench_e2e(args) -> dict:
         )
         from matchmaking_tpu.service.app import MatchmakingApp
         from matchmaking_tpu.service.broker import Properties
+        from matchmaking_tpu.service.loadgen import parse_tier_mix
+        from matchmaking_tpu.service.overload import stamp_tier
 
+        tier_mix = parse_tier_mix(getattr(args, "e2e_tier_mix", ""))
         cfg = Config(
             queues=(QueueConfig(rating_threshold=100.0,
                                 send_queued_ack=False),),
@@ -434,8 +437,21 @@ def bench_e2e(args) -> dict:
             # Overload mode (ISSUE 5): bound the waiting pool so the
             # saturation sweep measures ADMITTED-request latency under an
             # honest shed policy instead of unbounded queueing collapse.
-            overload=(OverloadConfig(max_waiting=args.e2e_max_waiting)
-                      if args.e2e_max_waiting > 0 else OverloadConfig()),
+            # Tiered mode (ISSUE 7, --e2e-tier-mix): priority classes +
+            # EDF window cutting + lowest-tier-first eviction, so the
+            # sweep rows show PER-TIER p99/shed under overload.
+            overload=(OverloadConfig(
+                max_waiting=args.e2e_max_waiting,
+                # max+1, not len: a sparse mix ("0:0.5,3:0.5") must
+                # configure enough tiers that x-tier 3 isn't clamped into
+                # a higher-priority class (and its shed_requests_t3
+                # counter actually exists to read).
+                tiers=(max(tier_mix) + 1 if tier_mix else 1),
+                edf=bool(tier_mix),
+                shed_policy=("oldest" if tier_mix else "reject"))
+                if args.e2e_max_waiting > 0 else
+                OverloadConfig(tiers=(max(tier_mix) + 1 if tier_mix else 1),
+                               edf=bool(tier_mix))),
             # Continuous telemetry + SLO monitoring (ISSUE 6): the BENCH
             # json records attainment and idle-fraction TRAJECTORIES, not
             # just the headline throughput rows. Short burn windows so a
@@ -471,6 +487,11 @@ def bench_e2e(args) -> dict:
         app.broker.declare_queue(reply_q)
         lat_ms: list[float] = []
         match_ids: set[str] = set()
+        #: Tiered mode: correlation id → assigned tier (the loadgen-side
+        #: truth — no tier echo needed from the service) + per-tier
+        #: matched latencies for the phase's per-tier p99 rows.
+        tier_of_corr: dict[str, int] = {}
+        tier_lat: dict[int, list[float]] = {t: [] for t in (tier_mix or ())}
 
         async def on_reply(delivery) -> None:
             d = json.loads(delivery.body)
@@ -481,6 +502,10 @@ def bench_e2e(args) -> dict:
             if (d.get("status") == "matched"
                     and str(d.get("player_id", "")).startswith("e")):
                 lat_ms.append(float(d.get("latency_ms", 0.0)))
+                if tier_mix:
+                    t = tier_of_corr.get(delivery.properties.correlation_id)
+                    if t is not None:
+                        tier_lat[t].append(float(d.get("latency_ms", 0.0)))
                 # Distinct matches, not replies/2: most matches pair one
                 # measured arrival with a prefilled (reply-less) player and
                 # produce exactly ONE counted reply — halving reply count
@@ -529,14 +554,26 @@ def bench_e2e(args) -> dict:
             isn't woken per message on this 1-core host."""
             lat_ms.clear()
             match_ids.clear()
+            tier_of_corr.clear()
+            for rows in tier_lat.values():
+                rows.clear()
             # Per-PHASE shed accounting: the counters are app-lifetime
             # monotone and every sweep row shares this app — absolute
             # reads would fold the headline + earlier rows' sheds into
             # each later row.
             shed0 = app.metrics.counters.get("shed_requests")
             expired0 = app.metrics.counters.get("expired_requests")
+            tier_base = {
+                t: (app.metrics.counters.get(f"shed_requests_t{t}"),
+                    app.metrics.counters.get(f"expired_requests_t{t}"))
+                for t in (tier_mix or ())}
             ratings = rng.normal(1500.0, 300.0,
                                  size=int(rate * duration * 2) + 16)
+            tiers = (rng.choice(
+                np.fromiter(tier_mix, np.int64, len(tier_mix)),
+                size=ratings.size,
+                p=np.fromiter(tier_mix.values(), np.float64, len(tier_mix)))
+                if tier_mix else None)
             gaps = rng.exponential(1.0 / rate, size=ratings.size)
             t0 = time.perf_counter()
             sched = np.cumsum(gaps)
@@ -548,11 +585,15 @@ def bench_e2e(args) -> dict:
                     pid = f"e{tag}_{i}"
                     body = (f'{{"id":"{pid}","rating":{ratings[i]:.2f}}}'
                             ).encode()
+                    headers = {"x-first-received": f"{time.time():.6f}"}
+                    if tiers is not None:
+                        t = int(tiers[i])
+                        stamp_tier(headers, t)
+                        tier_of_corr[pid] = t
                     app.broker.publish(
                         cfg.broker.request_queue, body,
                         Properties(reply_to=reply_q, correlation_id=pid,
-                                   headers={"x-first-received":
-                                            f"{time.time():.6f}"}))
+                                   headers=headers))
                     i += 1
                 if i < ratings.size and sched[i] > now_rel:
                     await asyncio.sleep(min(sched[i] - now_rel, 0.005))
@@ -595,6 +636,25 @@ def bench_e2e(args) -> dict:
                     app.metrics.counters.get("shed_requests") - shed0)
                 row["e2e_expired"] = int(
                     app.metrics.counters.get("expired_requests") - expired0)
+            if tier_mix:
+                # Per-tier p99/shed/expired (ISSUE 7): the row that shows
+                # ordered degradation — tier 0 holding while the lowest
+                # tier absorbs the shedding.
+                row["e2e_tiers"] = {
+                    str(t): {
+                        "offered": (int((tiers[:i] == t).sum())
+                                    if tiers is not None else 0),
+                        "matched": len(tier_lat[t]),
+                        "p99_ms": (round(float(np.percentile(
+                            np.asarray(tier_lat[t]), 99)), 3)
+                            if tier_lat[t] else None),
+                        "shed": int(app.metrics.counters.get(
+                            f"shed_requests_t{t}") - tier_base[t][0]),
+                        "expired": int(app.metrics.counters.get(
+                            f"expired_requests_t{t}") - tier_base[t][1]),
+                    }
+                    for t in sorted(tier_mix)
+                }
             return row
 
         headline = await poisson(float(args.e2e_rate),
@@ -1000,6 +1060,12 @@ def main() -> None:
                         "(OverloadConfig.max_waiting) so the saturation "
                         "sweep measures admitted-request latency under "
                         "explicit shedding (0 = unbounded, the default)")
+    p.add_argument("--e2e-tier-mix", default="",
+                   help="tiered QoS mode: per-class offered mix, e.g. "
+                        "'0:0.2,1:0.5,2:0.3' — stamps seeded x-tier "
+                        "headers, enables EDF cutting + lowest-tier-first "
+                        "shedding, and emits per-tier p99/shed/expired "
+                        "rows (e2e_tiers) in the BENCH json ('' = off)")
     p.add_argument("--e2e-sweep-seconds", type=float, default=4.0,
                    help="duration of each saturation-sweep step")
     p.add_argument("--e2e-slo-ms", type=float, default=250.0,

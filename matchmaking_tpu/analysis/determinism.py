@@ -29,6 +29,12 @@ silently reintroduce the flake class PR 2 eliminated:
   those tokens too: the sanctioned shapes are ``asyncio.sleep(interval)``
   cadence (no stored wake time at all) or ``time.monotonic()``;
   ``time.time()`` remains fine as snapshot DATA (the ring's timestamps).
+  The tiered-QoS scheduler (ISSUE 7) added ordering-key surfaces —
+  EDF window-cut keys and tier ranks (``edf_key``/``cut_key``/
+  ``sort_key``/``tier_key`` tokens): a cut key born from ``time.time()``
+  makes window COMPOSITION depend on scheduler jitter, so keys must be
+  pure functions of the message (the stamped ``x-deadline`` header via
+  ``overload.deadline_of`` + the admission-cached ``delivery.tier``).
 """
 
 from __future__ import annotations
@@ -77,10 +83,16 @@ def _contains_time_time(node: ast.AST) -> ast.Call | None:
 #: Name substrings that mark a value as schedule-like: wall-clock
 #: arithmetic INTO one of these is the replay hazard. "deadline" covers
 #: the overload subsystem; the snapshot/sample/scrape tokens cover the
-#: telemetry sampler's next-tick shapes (ISSUE 6).
+#: telemetry sampler's next-tick shapes (ISSUE 6); the edf/sort-key
+#: tokens cover the tiered-QoS window-cut ordering (ISSUE 7) — an EDF
+#: key computed from ``time.time()`` would make window COMPOSITION a
+#: function of scheduler jitter, so the sanctioned shapes are the stamped
+#: ``x-deadline`` header (``overload.deadline_of``) and the cached
+#: ``delivery.tier``, both pure functions of the message.
 _CLOCKLIKE_TOKENS = ("deadline", "next_snapshot", "snapshot_due",
                      "next_sample", "sample_due", "next_scrape",
-                     "scrape_due")
+                     "scrape_due", "edf_key", "edf", "cut_key", "sort_key",
+                     "tier_key", "tier_rank")
 
 
 def _clocklike(text: str) -> bool:

@@ -35,6 +35,27 @@ with`` on that lock, or in a method that is ``__init__``, ends with
 line. Calls to ``self.<m>()`` where ``m`` is a lock-holding method are
 checked the same way, so the caller-holds-lock convention is enforced one
 level deep instead of trusted.
+
+**cross-class mode** — a class whose WHOLE public surface is serialized by
+a lock its CALLER owns (TpuEngine: "this engine has NO internal locks and
+must only be driven with the owning queue runtime's ``_engine_lock``
+held") declares the contract on the class itself::
+
+    # externally-serialized-by: _engine_lock
+    # lock-free: pool_size, inflight, util_report
+    class TpuEngine(Engine):
+
+When any class declares ``externally-serialized-by: L``, every METHOD CALL
+through an attribute guarded by ``L`` (``self.engine.search_async(...)``
+where ``self.engine`` carries ``# guarded-by: _engine_lock``) is checked
+like a mutation: the call site must hold ``L`` (lexically, or via a
+``*_locked``/``holds-lock`` method). ``lock-free:`` names the read-only
+methods exempt from the contract (point reads safe off-lock — pool_size
+for admission, inflight for backpressure); the exemption set is the UNION
+across declaring classes, since the static checker binds by lock name, not
+by type. This closes the PR 4 gap where the contract lived in a docstring
+and only attribute STORES through the engine were checked — a new
+``self.engine.remove(...)`` call off-lock was invisible.
 """
 
 from __future__ import annotations
@@ -54,8 +75,9 @@ GUARD_RULE = "guarded-by"
 
 #: Awaited callables allowed inside a lock body (dotted suffix match).
 ALLOWED_AWAIT_CALLS = ("asyncio.to_thread",)
-#: Methods designed to run with the lock held (awaitable helpers).
-ALLOWED_AWAIT_METHODS = ("_drain_engine",)
+#: Methods designed to run with the lock held (awaitable helpers whose
+#: own awaits are all ``asyncio.to_thread``).
+ALLOWED_AWAIT_METHODS = ("_drain_engine", "_pay_debt_locked")
 
 #: Container/set/dict methods that mutate their receiver.
 MUTATORS = frozenset({
@@ -65,6 +87,51 @@ MUTATORS = frozenset({
 
 _GUARD_RE = re.compile(r"#\s*guarded-by:\s*(\w+)")
 _HOLDS_RE = re.compile(r"#\s*holds-lock:\s*(\w+)")
+_EXT_RE = re.compile(r"#\s*externally-serialized-by:\s*(\w+)")
+_LOCKFREE_RE = re.compile(r"#\s*lock-free:\s*([\w\s,]+)")
+
+
+class ExternalContracts:
+    """Cross-class registry: which locks have an externally-serialized
+    class declared against them, and which method names those classes
+    exempt as lock-free reads. Collected in one pass over ALL sources
+    (the declaring class and its callers live in different files)."""
+
+    def __init__(self) -> None:
+        self.locks: set[str] = set()
+        self.lockfree: dict[str, set[str]] = {}
+        #: lock -> class names declaring it (for messages).
+        self.classes: dict[str, list[str]] = {}
+
+
+def collect_external(sources: list[SourceFile]) -> ExternalContracts:
+    ec = ExternalContracts()
+    for sf in sources:
+        if not in_package(sf):
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            lock = None
+            free: set[str] = set()
+            # The contract comments sit directly above the class line
+            # (decorators would shift lineno to the decorator — these
+            # classes carry none; a 4-line window tolerates both comment
+            # lines plus blank spacing).
+            for ln in range(max(1, node.lineno - 4), node.lineno + 1):
+                line = sf.line_at(ln)
+                m = _EXT_RE.search(line)
+                if m:
+                    lock = m.group(1)
+                m = _LOCKFREE_RE.search(line)
+                if m:
+                    free.update(x.strip() for x in m.group(1).split(",")
+                                if x.strip())
+            if lock:
+                ec.locks.add(lock)
+                ec.lockfree.setdefault(lock, set()).update(free)
+                ec.classes.setdefault(lock, []).append(node.name)
+    return ec
 
 
 def _is_lock_expr(node: ast.AST) -> str | None:
@@ -187,10 +254,12 @@ class _GuardedByClass:
     """Per-class analysis: collect declarations, then check every method."""
 
     def __init__(self, sf: SourceFile, cls: ast.ClassDef,
-                 findings: list[Finding]):
+                 findings: list[Finding],
+                 external: "ExternalContracts | None" = None):
         self.sf = sf
         self.cls = cls
         self.findings = findings
+        self.external = external
         self.guarded: dict[str, str] = {}   # attr -> lock
         self.methods: dict[str, _MethodInfo] = {}
         self._collect()
@@ -311,6 +380,29 @@ class _MethodChecker(ast.NodeVisitor):
             # self.X.pop(...) / self.X[...].append(...): receiver mutation.
             if func.attr in MUTATORS:
                 self._check_target(node, func.value, f"{func.attr}()")
+            elif self.owner.external is not None:
+                # Cross-class mode: ANY method call through an attribute
+                # guarded by a lock some class declares itself
+                # externally-serialized-by is a use of that class's
+                # contract — the caller must hold the lock unless the
+                # method is on the declared lock-free read list.
+                root = _root_self_attr(func.value)
+                if root is not None:
+                    lock = self.owner.guarded.get(root)
+                    ext = self.owner.external
+                    if (lock is not None and lock in ext.locks
+                            and func.attr not in ext.lockfree.get(lock, ())
+                            and not self._ok(lock)):
+                        who = "/".join(ext.classes.get(lock, ())) or "?"
+                        self.owner.findings.append(Finding(
+                            GUARD_RULE, self.owner.sf.path, node.lineno,
+                            f"call {root}.{func.attr}() outside {lock}: "
+                            f"{who} is externally-serialized-by {lock} — "
+                            f"hold the lock, move the call into a "
+                            f"*_locked/holds-lock method, or add "
+                            f"{func.attr!r} to the class's lock-free list "
+                            f"if it is a safe point read",
+                            f"{self.owner.cls.name}.{self.method}"))
             # self.M(...) where M is a lock-holding method: the callee
             # assumes the lock; verify this caller actually provides it.
             attr = _self_attr(func)
@@ -331,6 +423,9 @@ class _MethodChecker(ast.NodeVisitor):
 
 def check(sources: list[SourceFile]) -> list[Finding]:
     findings: list[Finding] = []
+    # Pass 1: cross-class contracts (the declaring class and its callers
+    # live in different files, so the registry spans all sources).
+    external = collect_external(sources)
     for sf in sources:
         if not in_package(sf):
             continue
@@ -339,5 +434,6 @@ def check(sources: list[SourceFile]) -> list[Finding]:
         findings.extend(v.findings)
         for node in ast.walk(sf.tree):
             if isinstance(node, ast.ClassDef):
-                _GuardedByClass(sf, node, findings).check()
+                _GuardedByClass(sf, node, findings,
+                                external=external).check()
     return findings

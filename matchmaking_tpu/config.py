@@ -53,6 +53,12 @@ class QueueConfig:
     #: seconds is answered with the cached response instead of re-entering
     #: the pool (prevents one player landing in two matches).
     dedup_ttl_s: float = 30.0
+    #: Default QoS priority tier for requests arriving WITHOUT an
+    #: ``x-tier`` header on this queue (service/overload.py: tier 0 is the
+    #: most latency-critical; higher numbers shed first). Only meaningful
+    #: when ``OverloadConfig.tiers > 1`` — a ranked queue defaults to 0, a
+    #: bot-fill queue to the lowest configured tier.
+    default_tier: int = 0
     #: Periodic rescan of the longest-waiting players (seconds; 0 = off).
     #: Matching is otherwise arrival-triggered (reference semantics), so two
     #: waiting players whose thresholds WIDENED into compatibility would
@@ -335,6 +341,35 @@ class OverloadConfig:
     #: publish (``MatchmakingClient.submit(deadline_s=...)``), which is
     #: immune: publish-time headers do survive the wire and redelivery.
     default_deadline_ms: float = 0.0
+    #: QoS priority classes (Nitsum admission tiers): requests carry an
+    #: ``x-tier`` header (0 = most latency-critical; missing header → the
+    #: queue's ``default_tier``), and admission partitions every cap into a
+    #: nested ladder so graceful degradation is ORDERED — the lowest tier
+    #: absorbs shedding and queueing first, and tier 0 is untouched until
+    #: every lower tier is exhausted. 1 = untiered (exactly the pre-tier
+    #: behavior; zero per-delivery overhead beyond one header default).
+    tiers: int = 1
+    #: Fraction of each cap tier ``t`` may reach counting only SAME-OR-
+    #: HIGHER-priority usage (tiers ``<= t``): tier t is shed once
+    #: occupancy(tiers 0..t) >= cap * tier_shares[t]. Element 0 is forced
+    #: to 1.0 (tier 0 may use the whole cap); () → the equal ladder
+    #: ((tiers-t)/tiers). Monotone non-increasing by construction of the
+    #: check: a LOWER tier stops admitting strictly earlier, which is what
+    #: makes adaptive tightening consume tier-2 first — every cap scales
+    #: by the credit fraction and the smallest slice binds first.
+    tier_shares: tuple[float, ...] = ()
+    #: Earliest-deadline-first window cutting: the batcher and the columnar
+    #: flush order window candidates by (tier, absolute x-deadline) instead
+    #: of arrival order, so a near-deadline tier-0 request dispatches in
+    #: the next device window instead of behind the backlog. Stable within
+    #: equal keys (FIFO preserved for untiered/undeadlined traffic).
+    edf: bool = False
+    #: Pool-resident deadline expiry: sweep the per-slot ``x-deadline``
+    #: column of every waiting pool this often (ms; 0 = off) and cancel
+    #: expired waiters EXACTLY at their deadline — ``timeout`` response,
+    #: ``expired`` trace mark, no dispatch — instead of the coarse
+    #: ``request_timeout_s`` sweeper granularity.
+    deadline_sweep_ms: float = 0.0
     #: Adaptive shedding: tighten the credit limit from live signals
     #: (pipeline occupancy, batch fill, per-stage p99) so the limiter
     #: reacts BEFORE the circuit breaker trips.
@@ -358,10 +393,13 @@ class OverloadConfig:
         hot path pays zero per-delivery overhead when False.
         ``drain_checkpoint_dir`` alone counts: the drain sequence needs a
         controller to flip into shed-everything mode (and /healthz needs
-        it to report ``draining``) even when no cap is set."""
+        it to report ``draining``) even when no cap is set. ``tiers > 1``
+        and ``deadline_sweep_ms`` count too: tier parsing/accounting and
+        the per-slot deadline sweep ride the controller."""
         return bool(self.max_inflight > 0 or self.max_waiting > 0
                     or self.default_deadline_ms > 0 or self.adaptive
-                    or self.drain_checkpoint_dir)
+                    or self.drain_checkpoint_dir or self.tiers > 1
+                    or self.deadline_sweep_ms > 0)
 
 
 @dataclass(frozen=True)

@@ -137,6 +137,22 @@ class PlayerPool:
         self.m_enqueued = np.zeros(self.capacity, np.float64)
         self.m_reply = np.full(self.capacity, "", dtype=object)
         self.m_corr = np.full(self.capacity, "", dtype=object)
+        #: QoS priority tier per slot (service/overload.py; 0 = untiered
+        #: default) and absolute x-deadline per slot (wall-clock seconds;
+        #: 0.0 = none). Host-mirror-only columns — the device kernels never
+        #: see them: priority ordering happens at admission/window-cut time
+        #: and expiry is a host sweep + batched device eviction.
+        self.m_tier = np.zeros(self.capacity, np.int32)
+        self.m_deadline = np.zeros(self.capacity, np.float64)
+        #: Incremental per-tier occupancy counts (tier → waiting players):
+        #: admission's partition check reads this per delivery, and an
+        #: O(pool) bincount per delivery would put a 100k scan on the
+        #: ingress hot path.
+        self._tier_n: dict[int, int] = {}
+        #: Waiting players carrying a nonzero deadline — the O(1) gate the
+        #: sweep loop checks per tick so deadline-less traffic never pays
+        #: a pipeline drain for an empty sweep.
+        self._deadline_n = 0
         # Declared role sets (config #5 device path); None for the columnar
         # 1v1 ingress, which never carries roles.
         self.m_roles = np.full(self.capacity, None, dtype=object)
@@ -172,6 +188,8 @@ class PlayerPool:
             reply_to=self.m_reply[slot],
             correlation_id=self.m_corr[slot],
             enqueued_at=float(self.m_enqueued[slot]),
+            tier=int(self.m_tier[slot]),
+            deadline_at=float(self.m_deadline[slot]),
         )
 
     def waiting(self) -> list[SearchRequest]:
@@ -180,6 +198,19 @@ class PlayerPool:
 
     def waiting_slots(self) -> np.ndarray:
         return np.fromiter(self._slot_of.values(), np.int32, len(self._slot_of))
+
+    def deadline_count(self) -> int:
+        """Waiting players with a stamped deadline (O(1); incremental)."""
+        return self._deadline_n
+
+    def tier_counts(self, n_tiers: int) -> list[int]:
+        """Waiting players per QoS tier (len ``n_tiers``; out-of-range
+        tiers are clamped into the last bucket). O(n_tiers) — maintained
+        incrementally by allocate/release, never scanned."""
+        out = [0] * max(1, n_tiers)
+        for t, n in self._tier_n.items():
+            out[min(max(t, 0), len(out) - 1)] += n
+        return out
 
     # ---- mutation (single writer) -----------------------------------------
 
@@ -223,6 +254,24 @@ class PlayerPool:
         self.m_reply[slots] = "" if cols.reply_to is None else cols.reply_to
         self.m_corr[slots] = ("" if cols.correlation_id is None
                               else cols.correlation_id)
+        # QoS columns: unconditional stores (missing columns must clear a
+        # recycled slot, or a stale tier/deadline would misclassify the
+        # new occupant) + the incremental per-tier occupancy counts.
+        if cols.tier is None:
+            self.m_tier[slots] = 0
+            self._tier_n[0] = self._tier_n.get(0, 0) + n
+        else:
+            self.m_tier[slots] = cols.tier
+            for t, c in zip(*np.unique(np.asarray(cols.tier, np.int64),
+                                       return_counts=True)):
+                self._tier_n[int(t)] = self._tier_n.get(int(t), 0) + int(c)
+        if cols.deadline is None:
+            self.m_deadline[slots] = 0.0
+        else:
+            dl = np.nan_to_num(np.asarray(cols.deadline, np.float64),
+                               nan=0.0)
+            self.m_deadline[slots] = dl
+            self._deadline_n += int((dl != 0.0).sum())
         self._slot_of.update(zip(ids, slots.tolist()))
         return slots
 
@@ -253,6 +302,11 @@ class PlayerPool:
             return
         for pid in ids[occupied].tolist():
             del self._slot_of[pid]
+        # Per-tier/deadline occupancy bookkeeping BEFORE clearing slots.
+        for t, c in zip(*np.unique(self.m_tier[arr], return_counts=True)):
+            self._tier_n[int(t)] = self._tier_n.get(int(t), 0) - int(c)
+        self._deadline_n -= int((self.m_deadline[arr] != 0.0).sum())
+        self.m_deadline[arr] = 0.0
         self.m_id[arr] = None
         self.m_roles[arr] = None
         if self._band_edges is not None:

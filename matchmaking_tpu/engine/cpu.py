@@ -23,12 +23,22 @@ from matchmaking_tpu.engine.interface import Engine, Match, SearchOutcome
 from matchmaking_tpu.service.contract import ANY, SearchRequest, new_match_id
 
 
+# Same external-serialization contract as TpuEngine (the service binds
+# either behind the same _engine_lock); the insertion-ordered lists here
+# are just as unsynchronized as the device mirror.
+# externally-serialized-by: _engine_lock
+# lock-free: pool_size, inflight, pool_tier_counts, deadline_count, util_report, span_report
 class CpuEngine(Engine):
     def __init__(self, cfg: Config, queue: QueueConfig):
         super().__init__(cfg, queue)
         # Waiting pool: insertion-ordered parallel lists (the ETS table analog).
         self._entries: list[SearchRequest] = []
         self._by_id: dict[str, int] = {}  # player id -> index in _entries
+        # Incremental per-tier occupancy (QoS admission partitions read
+        # this per delivery — see Engine.pool_tier_counts) + the count of
+        # deadline-carrying waiters (sweep-loop gate).
+        self._tier_n: dict[int, int] = {}
+        self._deadline_n = 0
         # Role/party fast path (roles.try_party_match focus): sound only
         # under the greedy invariant; restore() breaks it (a checkpoint can
         # hold latent matches), so scans run unfocused until quiescent.
@@ -117,9 +127,21 @@ class CpuEngine(Engine):
 
     # ---- internals --------------------------------------------------------
 
+    def pool_tier_counts(self, n_tiers: int) -> list[int]:
+        out = [0] * max(1, n_tiers)
+        for t, n in self._tier_n.items():
+            out[min(max(t, 0), len(out) - 1)] += n
+        return out
+
+    def deadline_count(self) -> int:
+        return self._deadline_n
+
     def _insert(self, req: SearchRequest) -> None:
         self._by_id[req.id] = len(self._entries)
         self._entries.append(req)
+        self._tier_n[req.tier] = self._tier_n.get(req.tier, 0) + 1
+        if req.deadline_at:
+            self._deadline_n += 1
 
     def _evict(self, idx: int) -> SearchRequest:
         """Remove entry idx; swap-with-last keeps removal O(1). Note: this
@@ -129,6 +151,9 @@ class CpuEngine(Engine):
         req = self._entries[idx]
         last = self._entries.pop()
         del self._by_id[req.id]
+        self._tier_n[req.tier] = self._tier_n.get(req.tier, 0) - 1
+        if req.deadline_at:
+            self._deadline_n -= 1
         if idx < len(self._entries):
             self._entries[idx] = last
             self._by_id[last.id] = idx

@@ -172,6 +172,40 @@ class Engine(abc.ABC):
         on an unhealthy backend. Default: host engines have no device path
         to check, so they are always healthy."""
 
+    def pool_tier_counts(self, n_tiers: int) -> "list[int] | None":
+        """Waiting players per QoS tier (len ``n_tiers``), or None when
+        this engine does not track tiers — admission then counts every
+        pool occupant against every tier (the conservative read). Called
+        once per delivery on tiered queues, so implementations must be
+        O(n_tiers), never O(pool): both backends maintain the counts
+        incrementally."""
+        return None
+
+    def deadline_count(self) -> int:
+        """Waiting players carrying a stamped ``x-deadline`` — the O(1)
+        gate the sweep loop checks per tick: deadline-less traffic must
+        not pay a pipeline drain for an empty sweep. -1 = unknown (the
+        sweep then runs unconditionally); both backends track the count
+        incrementally."""
+        return -1
+
+    def expire_deadlines(self, now: float) -> list[SearchRequest]:
+        """Evict every waiting request whose propagated ``x-deadline``
+        (SearchRequest.deadline_at; 0 = none) has passed, and return them —
+        the pool-resident deadline sweep (OverloadConfig.deadline_sweep_ms):
+        exact to each request's own deadline, unlike the coarse
+        ``request_timeout_s`` sweeper. Default: object-path scan (fine for
+        the oracle's small pools); TpuEngine overrides with a vectorized
+        sweep over the mirror's deadline column."""
+        expired = [r for r in self.waiting()
+                   if r.deadline_at and now >= r.deadline_at]
+        out: list[SearchRequest] = []
+        for req in expired:
+            removed = self.remove(req.id)
+            if removed is not None:
+                out.append(removed)
+        return out
+
     def expire(self, now: float, timeout: float) -> list[SearchRequest]:
         """Evict every waiting request older than ``timeout`` and return
         them (the timeout sweeper's one call). Default: object-path scan —

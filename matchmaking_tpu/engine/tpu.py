@@ -152,6 +152,14 @@ class _Pending:
     marks: list[tuple[str, float]] = field(default_factory=list)
 
 
+# The module docstring's concurrency contract, machine-checkable (PR 4
+# carry-over): this engine has NO internal locks — every public entry must
+# be driven with the owning queue runtime's _engine_lock held. The
+# lock-free list names the safe point reads (single attribute/len reads
+# under the GIL, no mirror mutation) the service uses off-lock: admission
+# occupancy, backpressure polling, /metrics scrapes.
+# externally-serialized-by: _engine_lock
+# lock-free: pool_size, inflight, pool_tier_counts, deadline_count, util_report, span_report
 class TpuEngine(Engine):
     def __init__(self, cfg: Config, queue: QueueConfig):
         super().__init__(cfg, queue)
@@ -917,6 +925,51 @@ class TpuEngine(Engine):
             ev[:chunk.size] = chunk
             self._dev_pool = self.kernels.evict(self._dev_pool, jnp.asarray(ev))
         return reqs
+
+    def expire_deadlines(self, now: float) -> list[SearchRequest]:
+        """Pool-resident deadline expiry (OverloadConfig.deadline_sweep_ms):
+        vectorized sweep over the mirror's per-slot ``x-deadline`` column —
+        O(expired) object materialization, one batched device eviction per
+        evict_bucket chunk, exact to each waiter's own deadline instead of
+        the coarse ``request_timeout_s`` granularity. Zero device work is
+        spent matching an expired waiter: the sweep runs on host mirror
+        columns and the only device call is the eviction scatter."""
+        if self._team_delegate is not None:
+            out = self._team_delegate.expire_deadlines(now)
+            # Expiry may drain the last wildcard — same re-promotion
+            # opportunity as the coarse timeout sweep (expire()).
+            self._maybe_repromote_team(now)
+            return out
+        assert self._open == 0, (
+            "expire_deadlines() with windows in flight — collect with "
+            "flush() first"
+        )
+        slots = self.pool.waiting_slots()
+        if slots.size == 0:
+            return []
+        dl = self.pool.m_deadline[slots]
+        expired_slots = slots[(dl != 0.0) & (now >= dl)]
+        if expired_slots.size == 0:
+            return []
+        reqs = [self.pool.request_at(int(s)) for s in expired_slots]
+        self.pool.release(expired_slots)
+        eb = self.kernels.evict_bucket
+        for start in range(0, expired_slots.size, eb):
+            chunk = expired_slots[start:start + eb]
+            ev = np.full(eb, self.kernels.capacity, np.int32)
+            ev[:chunk.size] = chunk
+            self._dev_pool = self.kernels.evict(self._dev_pool, jnp.asarray(ev))
+        return reqs
+
+    def pool_tier_counts(self, n_tiers: int) -> list[int]:
+        if self._team_delegate is not None:
+            return self._team_delegate.pool_tier_counts(n_tiers)
+        return self.pool.tier_counts(n_tiers)
+
+    def deadline_count(self) -> int:
+        if self._team_delegate is not None:
+            return self._team_delegate.deadline_count()
+        return self.pool.deadline_count()
 
     def pool_size(self) -> int:
         if self._team_delegate is not None:

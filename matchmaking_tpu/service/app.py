@@ -20,6 +20,7 @@ Run a self-contained demo with ``python -m matchmaking_tpu.service.app --demo``.
 from __future__ import annotations
 
 import asyncio
+import dataclasses
 import logging
 import time
 
@@ -85,8 +86,15 @@ class _QueueRuntime:
             CircuitBreaker(app.cfg.engine)
             if app.cfg.engine.backend == "tpu" else None)
         self._publish_breaker_gauges()
-        self.batcher: Batcher = Batcher(app.cfg.batcher, self._flush,
-                                        observe_window=self._observe_window)
+        self.batcher: Batcher = Batcher(
+            app.cfg.batcher, self._flush,
+            observe_window=self._observe_window,
+            # EDF window cutting (OverloadConfig.edf): windows are cut by
+            # (tier, absolute deadline) instead of arrival order, so a
+            # near-deadline tier-0 request dispatches in the next device
+            # window. The key is a pure function of the delivery's cached
+            # tier + stamped header — no clock reads (determinism rule).
+            sort_key=self._edf_key if app.cfg.overload.edf else None)
         # Serializes ALL engine access (window flushes vs the timeout
         # sweeper): engines are single-writer objects with no internal locks.
         # Attributes below marked ``guarded-by: _engine_lock`` are checked
@@ -122,7 +130,8 @@ class _QueueRuntime:
         #: OverloadConfig knob is set — the ingress path then pays nothing.
         self.admission: AdmissionController | None = (
             AdmissionController(app.cfg.overload, queue_cfg.name,
-                                app.metrics, app.events)
+                                app.metrics, app.events,
+                                default_tier=queue_cfg.default_tier)
             if app.cfg.overload.enabled() else None)
         #: Previous "total"-stage histogram snapshot (counts, overflow,
         #: count) for the adaptive limiter's per-window DELTA p99 — the
@@ -148,8 +157,15 @@ class _QueueRuntime:
             batch_hint=app.cfg.auth.mode != "rpc",
         )
         self._sweeper: asyncio.Task | None = None
-        if queue_cfg.request_timeout_s is not None:
-            self._sweeper = asyncio.create_task(self._sweep_timeouts())
+        if (queue_cfg.request_timeout_s is not None
+                or (self.admission is not None
+                    and app.cfg.overload.deadline_sweep_ms > 0)):
+            # One sweep loop serves both evictions: the coarse
+            # request_timeout_s timeout AND the per-slot x-deadline expiry
+            # (OverloadConfig.deadline_sweep_ms) — they share the drain +
+            # engine-lock discipline, so two timers would double the lock
+            # contention for nothing.
+            self._sweeper = asyncio.create_task(self._sweep_loop())
         self._rescanner: asyncio.Task | None = None
         if queue_cfg.rescan_interval_s > 0:
             # 1v1 queues AND device team queues support rescan (team window
@@ -422,16 +438,20 @@ class _QueueRuntime:
         assert self.admission is not None
         tr = self._trace(delivery)
         if tr is not None:
+            tr.tier = delivery.tier
             tr.mark("shed")
         self.admission.record_shed(
             f"inflight={self.admission.inflight()} "
-            f"pool={self.engine.pool_size()}")
+            f"pool={self.engine.pool_size()}", tier=delivery.tier)
+        tiered = self.admission.tiers > 1
         self._respond_raw(
             delivery.properties.reply_to, delivery.properties.correlation_id,
             SearchResponse(
                 status="shed", player_id="",
                 retry_after_ms=self.app.cfg.overload.retry_after_ms,
-                trace_id=tr.trace_id if tr is not None else ""))
+                trace_id=tr.trace_id if tr is not None else "",
+                tier=delivery.tier if tiered else None),
+            trace=tr)
         self._ack(delivery)
         if tr is not None:
             self._settle_trace(delivery, "shed")
@@ -445,14 +465,19 @@ class _QueueRuntime:
         if tr is not None:
             if player_id:
                 tr.player_id = player_id
+            tr.tier = delivery.tier
             tr.mark("expired", now)
+        tiered = self.admission is not None and self.admission.tiers > 1
         if self.admission is not None:
             self.admission.record_expired(
-                f"player={player_id or '?'} tag={delivery.delivery_tag}")
+                f"player={player_id or '?'} tag={delivery.delivery_tag}",
+                tier=delivery.tier)
         self._respond_raw(
             delivery.properties.reply_to, delivery.properties.correlation_id,
             SearchResponse(status="timeout", player_id=player_id,
-                           trace_id=tr.trace_id if tr is not None else ""))
+                           trace_id=tr.trace_id if tr is not None else "",
+                           tier=delivery.tier if tiered else None),
+            trace=tr)
         self._ack(delivery)
         if tr is not None:
             self._settle_trace(delivery, "expired")
@@ -463,8 +488,30 @@ class _QueueRuntime:
         zero header lookups per delivery."""
         if self.admission is None:
             return False
-        deadline = deadline_of(delivery.properties.headers)
-        return deadline is not None and now >= deadline
+        deadline = self._delivery_deadline(delivery)
+        return deadline > 0.0 and now >= deadline
+
+    @staticmethod
+    def _delivery_deadline(delivery: Delivery) -> float:
+        """The delivery's absolute deadline (0.0 = none), from the cache
+        admission filled — parsed from the stamped header at most once
+        per delivery (lazy fallback for paths that bypass admission)."""
+        dl = delivery.deadline
+        if dl < 0.0:
+            dl = deadline_of(delivery.properties.headers) or 0.0
+            delivery.deadline = dl
+        return dl
+
+    @staticmethod
+    def _edf_key(item: "tuple[SearchRequest | None, Delivery]"):
+        """Window-cut ordering key (OverloadConfig.edf): (tier, absolute
+        x-deadline, no-deadline-last). Pure function of the delivery —
+        tier and deadline were cached at admission — so two identical
+        ingress sequences cut identical windows. Stable sort keeps FIFO
+        within equal keys."""
+        _, delivery = item
+        deadline = _QueueRuntime._delivery_deadline(delivery)
+        return (delivery.tier, deadline if deadline else float("inf"))
 
     # ---- ingress ----------------------------------------------------------
 
@@ -476,9 +523,18 @@ class _QueueRuntime:
         if self.admission is not None:
             # Admission runs FIRST — before decode and before any auth RPC
             # round trip: an overloaded queue must not spend middleware
-            # work on a request it is about to shed.
+            # work on a request it is about to shed. Tiered queues also
+            # hand the per-tier pool composition in, so the nested-ladder
+            # partition check can count only same-or-higher-priority
+            # occupancy (and oldest-policy preemption knows whether a
+            # lower-priority victim exists).
+            pool_tiers = (self.engine.pool_tier_counts(self.admission.tiers)
+                          if self.admission.tiers > 1 else None)
             decision = self.admission.decide(delivery, ctx.received_at,
-                                             self.engine.pool_size())
+                                             self.engine.pool_size(),
+                                             pool_tiers)
+            if tr is not None:
+                tr.tier = delivery.tier
             if decision is EXPIRED and delivery.redelivered:
                 # A REDELIVERED expired copy may belong to a player who
                 # already reached a terminal state (its matched response
@@ -493,7 +549,7 @@ class _QueueRuntime:
                 else:
                     self._shed_delivery(delivery)
                 return
-            self.admission.admit(delivery.delivery_tag)
+            self.admission.admit(delivery.delivery_tag, delivery.tier)
         try:
             await self.pipeline.run(ctx)
         except MiddlewareReject as e:
@@ -568,8 +624,18 @@ class _QueueRuntime:
         # already reached a terminal state must not re-enter the pool (the
         # player could end up in two matches); replay the cached response.
         self._prune_recent(now)
+        # QoS metadata rides the frozen request object from here on: the
+        # pool mirror (tier column for priority-aware eviction, deadline
+        # column for the per-slot expiry sweep) is populated from request
+        # fields, and headers are gone once the delivery settles.
+        stamp_qos = self.admission is not None
         fresh: list[tuple[SearchRequest, Delivery]] = []
         for req, delivery in window:
+            if stamp_qos:
+                deadline = self._delivery_deadline(delivery)
+                if delivery.tier or deadline:
+                    req = dataclasses.replace(
+                        req, tier=delivery.tier, deadline_at=deadline)
             tr = delivery.trace
             if tr is not None:
                 tr.player_id = req.id
@@ -584,7 +650,8 @@ class _QueueRuntime:
                 # an already-matched player must replay "matched", not
                 # contradict it with a post-deadline "timeout".
                 self.app.metrics.counters.inc("deduped_replays")
-                self._publish_body(req.reply_to, req.correlation_id, cached[0])
+                self._publish_body(req.reply_to, req.correlation_id,
+                                   cached[0], trace=tr)
                 self._ack(delivery)
                 if tr is not None:
                     tr.mark("dedup_replay")
@@ -609,6 +676,7 @@ class _QueueRuntime:
             def dispatch(drop: set[str]):
                 reqs = ([r for r in requests if r.id not in drop]
                         if drop else requests)
+                # matchlint: ignore[guarded-by] closure runs under _engine_lock inside _dispatch_pipelined (via to_thread)
                 tok, _ = self.engine.search_async(reqs, now)
                 return tok
 
@@ -616,10 +684,6 @@ class _QueueRuntime:
                 dispatch, [(r.id, d) for r, d in window], now)
             return
 
-        t_disp = time.time()
-        for delivery in deliveries_in:
-            if delivery.trace is not None:
-                delivery.trace.mark("dispatch", t_disp)
         try:
             # Engine.search blocks (host work + device step); keep the event
             # loop responsive for other queues. The lock serializes against
@@ -628,13 +692,30 @@ class _QueueRuntime:
                 if self.admission is not None:
                     # shed_policy="oldest" debt from actual occupancy
                     # (synchronous engines have no windows in flight, so
-                    # eviction is legal here).
+                    # eviction is legal here). Tiered queues settle the
+                    # debt across pool ∪ window, lowest priority first.
                     debt = self.admission.eviction_debt(
                         len(requests), self.engine.pool_size())
-                    if debt:
-                        evicted = await asyncio.to_thread(
-                            self._evict_oldest, debt, now)
-                        self._publish_shed_evictions(evicted, now)
+                    drop = await self._pay_debt_locked(
+                        [(r.id, r.tier, r.enqueued_at, d)
+                         for r, d in window], debt, now)
+                    if drop:
+                        window = [(r, d) for r, d in window
+                                  if r.id not in drop]
+                        if not window:
+                            return
+                        requests = [r for r, _ in window]
+                        deliveries_in = [d for _, d in window]
+                # Dispatch mark AFTER debt settlement: a window entrant
+                # shed as a debt victim must not carry a dispatch mark —
+                # the mark is the audit convention for "engine work was
+                # spent" (the pipelined/columnar twins order it the same
+                # way). Lock wait lands in flush→dispatch, which is the
+                # pipeline_slot_wait category's definition.
+                t_disp = time.time()
+                for delivery in deliveries_in:
+                    if delivery.trace is not None:
+                        delivery.trace.mark("dispatch", t_disp)
                 outcome = await asyncio.to_thread(self.engine.search, requests, now)
         except Exception:
             log.exception("engine step crashed; reviving engine from mirror")
@@ -651,7 +732,8 @@ class _QueueRuntime:
             if delivery.trace is not None:
                 delivery.trace.mark("collect", t_col)
         self._publish_outcome(outcome, now,
-                              trace_ids=self._trace_id_map(deliveries_in))
+                              trace_ids=self._trace_id_map(deliveries_in),
+                              traces=self._trace_map(deliveries_in))
         for delivery in deliveries_in:
             self._ack(delivery)
         self._settle_outcome_traces(outcome, deliveries_in)
@@ -728,7 +810,12 @@ class _QueueRuntime:
         bodies = [bytes(d.body) for d in deliveries]
         native = codec.decode_batch(bodies) if codec.available() else None
 
-        lanes: list[tuple[str, float, float, float, str, str, float, Delivery]] = []
+        # Lane rows: (id, rating, rd, thr, region, mode, first_received,
+        # delivery, tier, deadline) — QoS metadata resolved ONCE per lane
+        # here (tier was cached on the delivery at admission; the deadline
+        # is the stamped header) and mirrored into the pool columns below.
+        stamp_qos = self.admission is not None
+        lanes: list[tuple] = []
         for i, delivery in enumerate(deliveries):
             if delivery.trace is not None:
                 delivery.trace.mark("flush", now)
@@ -738,7 +825,10 @@ class _QueueRuntime:
                     native[5], native[6])
                 row = (ids[i], float(rating[i]), float(rd[i]), float(thr[i]),
                        regions[i], modes[i],
-                       self._first_received(delivery, now), delivery)
+                       self._first_received(delivery, now), delivery,
+                       delivery.tier,
+                       self._delivery_deadline(delivery)
+                       if stamp_qos else 0.0)
             elif native is not None and native[6][i] not in (codec.OK,
                                                              codec.NEEDS_PYTHON):
                 self.app.metrics.counters.inc("rejected_by_middleware")
@@ -769,9 +859,13 @@ class _QueueRuntime:
                         else req.rating_threshold),
                        "" if req.region == "*" else req.region,
                        "" if req.game_mode == "*" else req.game_mode,
-                       req.enqueued_at, delivery)
+                       req.enqueued_at, delivery,
+                       delivery.tier,
+                       self._delivery_deadline(delivery)
+                       if stamp_qos else 0.0)
             if delivery.trace is not None:
                 delivery.trace.player_id = row[0]
+                delivery.trace.tier = delivery.tier
             # At-least-once dedup: replay terminal responses.
             cached = self._recent.get(row[0])
             if cached is not None and cached[1] <= now:
@@ -784,7 +878,7 @@ class _QueueRuntime:
                 self.app.metrics.counters.inc("deduped_replays")
                 self._publish_body(delivery.properties.reply_to,
                                    delivery.properties.correlation_id,
-                                   cached[0])
+                                   cached[0], trace=delivery.trace)
                 self._ack(delivery)
                 if delivery.trace is not None:
                     delivery.trace.mark("dedup_replay")
@@ -799,6 +893,14 @@ class _QueueRuntime:
 
         if not lanes:
             return
+        if self.app.cfg.overload.edf and len(lanes) > 1:
+            # EDF, flush side: the batcher already cut by (tier, deadline),
+            # but dedup/expiry/reject filtering just rewrote the lane set —
+            # re-establish the order so when this window splits into bucket
+            # CHUNKS, the near-deadline tier-0 lanes ride the first chunk
+            # (one chunk = one device step; chunk order is dispatch order).
+            # Stable: FIFO within equal keys, pure function of lane rows.
+            lanes.sort(key=lambda r: (r[8], r[9] if r[9] else float("inf")))
         n = len(lanes)
         interner_r = self.engine.pool.regions.code
         interner_m = self.engine.pool.modes.code
@@ -818,6 +920,13 @@ class _QueueRuntime:
                 (r[7].properties.reply_to for r in lanes), object, n),
             correlation_id=np.fromiter(
                 (r[7].properties.correlation_id for r in lanes), object, n),
+            # QoS mirror columns (priority-aware eviction + the per-slot
+            # deadline sweep); None when overload control is off so the
+            # pool stores plain zeros without per-lane work.
+            tier=(np.fromiter((r[8] for r in lanes), np.int32, n)
+                  if stamp_qos else None),
+            deadline=(np.fromiter((r[9] for r in lanes), np.float64, n)
+                      if stamp_qos else None),
         )
         by_id = {r[0]: r[7] for r in lanes}
 
@@ -829,8 +938,9 @@ class _QueueRuntime:
                 # compilation and per-window pack/H2D host work would
                 # otherwise freeze every other queue's consumers, sweepers,
                 # and auth RPC deadlines.
+                # matchlint: ignore[guarded-by] closure runs under _engine_lock below (via to_thread)
                 self.engine.search_columns_async(cols, now)
-                return self.engine.flush()
+                return self.engine.flush()  # matchlint: ignore[guarded-by] same lock-held closure
 
             try:
                 async with self._engine_lock:
@@ -843,10 +953,19 @@ class _QueueRuntime:
                         # requires _open == 0).
                         evict_debt = self.admission.eviction_debt(
                             len(lanes), self.engine.pool_size())
-                        if evict_debt:
-                            evicted = await asyncio.to_thread(
-                                self._evict_oldest, evict_debt, now)
-                            self._publish_shed_evictions(evicted, now)
+                        drop = await self._pay_debt_locked(
+                            [(r[0], r[8], r[6], r[7]) for r in lanes],
+                            evict_debt, now)
+                        if drop:
+                            keep = np.fromiter(
+                                (pid not in drop
+                                 for pid in cols.ids.tolist()),
+                                bool, len(cols))
+                            cols = cols.take(keep)
+                            deliveries_in = [r[7] for r in lanes
+                                             if r[0] not in drop]
+                            if not len(cols):
+                                return
                     outs = await asyncio.to_thread(run_engine)
                     # Error check + failed-token bookkeeping stay INSIDE
                     # the lock: a breaker demotion parked on it must not
@@ -879,6 +998,7 @@ class _QueueRuntime:
                 keep = np.fromiter((i not in drop for i in c.ids.tolist()),
                                    bool, len(c))
                 c = c.take(keep)
+            # matchlint: ignore[guarded-by] closure runs under _engine_lock inside _dispatch_pipelined (via to_thread)
             return self.engine.search_columns_async(c, now)
 
         await self._dispatch_pipelined(
@@ -908,7 +1028,8 @@ class _QueueRuntime:
             stale.add(pid)
             self.app.metrics.counters.inc("deduped_replays")
             self._publish_body(delivery.properties.reply_to,
-                               delivery.properties.correlation_id, cached[0])
+                               delivery.properties.correlation_id, cached[0],
+                               trace=delivery.trace)
             self._ack(delivery)
             if delivery.trace is not None:
                 delivery.trace.mark("dedup_replay")
@@ -936,12 +1057,17 @@ class _QueueRuntime:
 
     # holds-lock: _engine_lock
     def _evict_oldest(self, k: int, now: float) -> list[SearchRequest]:
-        """shed_policy="oldest": evict the k longest-waiting pool players
-        (freshness-biased shedding). Runs in a worker thread with the
+        """shed_policy="oldest": evict the k longest-waiting pool players,
+        LOWEST-PRIORITY TIER FIRST (oldest within a tier) — under tiered
+        QoS the eviction order is what makes degradation ordered: tier-2
+        waiters absorb every eviction and a tier-0 waiter is touched only
+        once no lower tier remains. Untiered pools (all tier 0) keep the
+        plain oldest-first semantics. Runs in a worker thread with the
         engine lock held and no windows in flight (remove() requires it).
         O(pool) object materialization — acceptable: it only runs while
         the queue is at its occupancy cap, which the cap keeps small."""
-        waiting = sorted(self.engine.waiting(), key=lambda r: r.enqueued_at)
+        waiting = sorted(self.engine.waiting(),
+                         key=lambda r: (-r.tier, r.enqueued_at))
         out: list[SearchRequest] = []
         for req in waiting[:k]:
             removed = self.engine.remove(req.id)
@@ -949,19 +1075,91 @@ class _QueueRuntime:
                 out.append(removed)
         return out
 
+    # holds-lock: _engine_lock
+    def _remove_ids(self, ids: list[str]) -> list[SearchRequest]:
+        """Evict the named pool players (worker thread, lock held, no
+        windows in flight — remove() requires it)."""
+        out: list[SearchRequest] = []
+        for pid in ids:
+            removed = self.engine.remove(pid)
+            if removed is not None:
+                out.append(removed)
+        return out
+
+    # holds-lock: _engine_lock
+    async def _pay_debt_locked(self, entering: "list[tuple[str, int, float, Delivery]]",
+                               debt: int, now: float) -> set[str]:
+        """Settle the occupancy debt for one dispatching window. Untiered:
+        evict the ``debt`` longest-waiting pool players (the pre-tier
+        semantics, byte for byte). Tiered: pick the ``debt`` LOWEST-
+        PRIORITY candidates across pool ∪ window (tier descending, oldest
+        first within a tier — stable on consume order, so replays are
+        bit-identical): pool victims are evicted with shed-by-name
+        responses, WINDOW victims are shed before dispatch — a tier-1
+        entrant must absorb the shed itself, never displace a tier-0 pool
+        member the admission ladder already protected. Returns the window
+        pids to drop from the dispatch (settled here: shed response, ack,
+        trace)."""
+        if debt <= 0:
+            return set()
+        ac = self.admission
+        if ac is None or ac.tiers <= 1:
+            evicted = await asyncio.to_thread(self._evict_oldest, debt, now)
+            self._publish_shed_evictions(evicted, now)
+            return set()
+        waiting = await asyncio.to_thread(self.engine.waiting)
+        # kind 0 = pool, 1 = entering; construction order (pool in mirror
+        # order, entrants in window order) is the stable tiebreak for
+        # equal (tier, enqueued_at) — both are deterministic sequences.
+        cands: list[tuple[int, float, int, str, Delivery | None]] = [
+            (-r.tier, r.enqueued_at, 0, r.id, None) for r in waiting]
+        cands.extend((-t, enq, 1, pid, d) for pid, t, enq, d in entering)
+        cands.sort(key=lambda c: (c[0], c[1]))
+        victims = cands[:debt]
+        pool_ids = [pid for _, _, kind, pid, _ in victims if kind == 0]
+        if pool_ids:
+            evicted = await asyncio.to_thread(self._remove_ids, pool_ids)
+            self._publish_shed_evictions(evicted, now)
+        drop: set[str] = set()
+        for _, _, kind, pid, delivery in victims:
+            if kind != 1:
+                continue
+            assert delivery is not None
+            drop.add(pid)
+            tr = delivery.trace
+            if tr is not None:
+                tr.mark("shed")
+            ac.record_shed(f"window debt {pid}", tier=delivery.tier)
+            self._respond_raw(
+                delivery.properties.reply_to,
+                delivery.properties.correlation_id,
+                SearchResponse(
+                    status="shed", player_id=pid,
+                    retry_after_ms=self.app.cfg.overload.retry_after_ms,
+                    trace_id=tr.trace_id if tr is not None else "",
+                    tier=delivery.tier),
+                trace=tr)
+            self._ack(delivery)
+            if tr is not None:
+                self._settle_trace(delivery, "shed")
+        return drop
+
     def _publish_shed_evictions(self, evicted: list[SearchRequest],
                                 now: float) -> None:
         """Shed responses for pool players evicted under the "oldest"
         policy. Remembered in the dedup cache: a redelivered copy of an
         evicted player must replay the shed, not silently re-enter."""
+        tiered = self.admission is not None and self.admission.tiers > 1
         for req in evicted:
             if self.admission is not None:
-                self.admission.record_shed(f"evicted oldest {req.id}")
+                self.admission.record_shed(f"evicted oldest {req.id}",
+                                           tier=req.tier)
             body = encode_response(SearchResponse(
                 status="shed", player_id=req.id,
                 retry_after_ms=self.app.cfg.overload.retry_after_ms,
                 latency_ms=((now - req.enqueued_at) * 1e3
-                            if req.enqueued_at else 0.0)))
+                            if req.enqueued_at else 0.0),
+                tier=req.tier if tiered else None))
             self._remember(req.id, body, now)
             self._publish_body(req.reply_to, req.correlation_id, body)
 
@@ -1018,9 +1216,17 @@ class _QueueRuntime:
                                 and self.engine.inflight() > 0)
                         if not busy or debt >= self.app.cfg.batcher.max_batch:
                             await self._drain_engine(now)
-                            evicted = await asyncio.to_thread(
-                                self._evict_oldest, debt, now)
-                            self._publish_shed_evictions(evicted, now)
+                            drop = await self._pay_debt_locked(
+                                [(pid, d.tier,
+                                  self._first_received(d, now), d)
+                                 for pid, d in pairs], debt, now)
+                            if drop:
+                                stale |= drop
+                                pairs = [(p, d) for p, d in pairs
+                                         if p not in drop]
+                                deliveries_in = [d for _, d in pairs]
+                                if not pairs:
+                                    return
                 tok = await asyncio.to_thread(dispatch, stale)
                 self._inflight_meta[tok] = (dict(pairs), deliveries_in)
                 recorded = True
@@ -1062,8 +1268,15 @@ class _QueueRuntime:
         meta = self._inflight_meta.pop(tok, None)
         if meta is None:
             # Not a delivery-backed window (rescan tick / already-settled):
-            # still pop its window marks so the hand-off dict stays small.
-            self._merge_window_marks(tok, [])
+            # pop its window marks — and when it IS a rescan tick, feed
+            # them to the per-queue rescan attribution bucket (PR 6
+            # carry-over: rescan device time was counted in busy/idle but
+            # merged into no trace, a blind spot in the work/wait story).
+            wm = getattr(self.engine, "window_marks", None)
+            marks = wm.pop(tok, None) if wm is not None else None
+            if marks and tok in getattr(self.engine, "rescan_tokens", ()):
+                self.app.attribution.observe_rescan(self.queue_cfg.name,
+                                                    marks)
             # Rescan ticks flow through the shared collector now that they
             # overlap the pipeline.
             if tok in getattr(self.engine, "rescan_tokens", ()):
@@ -1119,12 +1332,22 @@ class _QueueRuntime:
         return {d.trace.player_id: d.trace.trace_id for d in deliveries
                 if d.trace is not None and d.trace.player_id}
 
+    def _trace_map(self, deliveries: list[Delivery]) -> "dict[str, Any]":
+        """player id → live TraceContext for this window's traced
+        deliveries — the publish paths mark "respond" on these at the
+        moment the response publish starts (attribution's publish_lag /
+        respond split)."""
+        return {d.trace.player_id: d.trace for d in deliveries
+                if d.trace is not None and d.trace.player_id}
+
     def _handle_columnar_out(self, out, by_id: dict[str, Delivery],
                              deliveries: list[Delivery], now: float) -> None:
         """Publish one collected window's outcome and ack its deliveries."""
         m = self.app.metrics
         trace_ids = self._trace_id_map(deliveries)
-        self._publish_columnar_matches(out, now, trace_ids=trace_ids)
+        traces = self._trace_map(deliveries)
+        self._publish_columnar_matches(out, now, trace_ids=trace_ids,
+                                       traces=traces)
         if self.queue_cfg.send_queued_ack:
             for pid in out.q_ids:
                 d = by_id.get(pid)
@@ -1132,7 +1355,8 @@ class _QueueRuntime:
                     self._respond_raw(
                         d.properties.reply_to, d.properties.correlation_id,
                         SearchResponse(status="queued", player_id=pid,
-                                       trace_id=trace_ids.get(pid, "")))
+                                       trace_id=trace_ids.get(pid, "")),
+                        trace=d.trace)
         for pid, code in out.rejected:
             m.counters.inc("rejected_by_engine")
             d = by_id.get(pid)
@@ -1162,7 +1386,8 @@ class _QueueRuntime:
         queues) and ack its deliveries — _publish_outcome covers matches,
         queued acks, rejections, and timeouts."""
         self._publish_outcome(out, now,
-                              trace_ids=self._trace_id_map(deliveries))
+                              trace_ids=self._trace_id_map(deliveries),
+                              traces=self._trace_map(deliveries))
         for d in deliveries:
             self._ack(d)
         self._settle_outcome_traces(out, deliveries)
@@ -1236,6 +1461,7 @@ class _QueueRuntime:
 
     def _publish_columnar_matches(self, out, now: float,
                                   trace_ids: dict[str, str] | None = None,
+                                  traces: "dict[str, Any] | None" = None,
                                   ) -> None:
         """Matched responses for one ColumnarOutcome (window flush AND
         rescan both come through here). Bodies are built by the native
@@ -1278,6 +1504,7 @@ class _QueueRuntime:
             ids_a, ids_b = out.m_id_a.tolist(), out.m_id_b.tolist()
             reply_a, reply_b = out.m_reply_a.tolist(), out.m_reply_b.tolist()
             corr_a, corr_b = out.m_corr_a.tolist(), out.m_corr_b.tolist()
+            traces = traces or {}
             for j in range(n):
                 body_a, body_b = bodies[2 * j], bodies[2 * j + 1]
                 if trace_ids:
@@ -1289,10 +1516,13 @@ class _QueueRuntime:
                         body_b = _body_with_trace_id(body_b, tid)
                 self._remember(ids_a[j], body_a, now)
                 self._remember(ids_b[j], body_b, now)
-                self._publish_body(reply_a[j], corr_a[j], body_a)
-                self._publish_body(reply_b[j], corr_b[j], body_b)
+                self._publish_body(reply_a[j], corr_a[j], body_a,
+                                   trace=traces.get(ids_a[j]))
+                self._publish_body(reply_b[j], corr_b[j], body_b,
+                                   trace=traces.get(ids_b[j]))
             return
         trace_ids = trace_ids or {}
+        traces = traces or {}
         for j in range(n):
             id_a, id_b = out.m_id_a[j], out.m_id_b[j]
             result = MatchResult(
@@ -1302,14 +1532,16 @@ class _QueueRuntime:
             )
             self._publish_matched(id_a, out.m_reply_a[j], out.m_corr_a[j],
                                   float(out.m_enq_a[j]), result, now,
-                                  trace_id=trace_ids.get(id_a, ""))
+                                  trace_id=trace_ids.get(id_a, ""),
+                                  trace=traces.get(id_a))
             self._publish_matched(id_b, out.m_reply_b[j], out.m_corr_b[j],
                                   float(out.m_enq_b[j]), result, now,
-                                  trace_id=trace_ids.get(id_b, ""))
+                                  trace_id=trace_ids.get(id_b, ""),
+                                  trace=traces.get(id_b))
 
     def _publish_matched(self, pid: str, reply_to: str, correlation_id: str,
                          enqueued_at: float, result, now: float,
-                         trace_id: str = "") -> None:
+                         trace_id: str = "", trace=None) -> None:
         """One matched player's response + metrics + dedup memory — the
         slow-path builder (object flush; the columnar flush uses the native
         batch encoder when available and only falls back here)."""
@@ -1323,19 +1555,26 @@ class _QueueRuntime:
             latency_ms=(now - enqueued_at) * 1e3 if enqueued_at else 0.0,
             trace_id=trace_id))
         self._remember(pid, body, now)
-        self._publish_body(reply_to, correlation_id, body)
+        self._publish_body(reply_to, correlation_id, body, trace=trace)
 
     def _respond_raw(self, reply_to: str, correlation_id: str,
-                     resp: SearchResponse) -> None:
+                     resp: SearchResponse, trace=None) -> None:
         if not reply_to:
-            return
-        self.app.broker.publish(reply_to, encode_response(resp),
-                                Properties(correlation_id=correlation_id))
+            return  # before encode: replyless requests pay nothing
+        self._publish_body(reply_to, correlation_id, encode_response(resp),
+                           trace=trace)
 
     def _publish_body(self, reply_to: str, correlation_id: str,
-                      body: bytes) -> None:
+                      body: bytes, trace=None) -> None:
+        """THE response-publish seam (every respond helper funnels here).
+        ``trace`` gets the "respond" mark at the moment the actual broker
+        publish starts — splitting publish_lag (outcome-handling queueing
+        on the loop, collect→respond) from the publish itself
+        (respond→publish) in the attribution taxonomy (PR 6 carry-over)."""
         if not reply_to:
             return
+        if trace is not None:
+            trace.mark("respond")
         self.app.broker.publish(reply_to, body,
                                 Properties(correlation_id=correlation_id))
 
@@ -1370,9 +1609,11 @@ class _QueueRuntime:
     # ---- egress -----------------------------------------------------------
 
     def _publish_outcome(self, outcome: SearchOutcome, now: float,
-                         trace_ids: dict[str, str] | None = None) -> None:
+                         trace_ids: dict[str, str] | None = None,
+                         traces: "dict[str, Any] | None" = None) -> None:
         m = self.app.metrics
         tids = trace_ids or {}
+        trs = traces or {}
         if self._invariants is not None:
             self._invariants.observe_outcome(outcome)
         for match in outcome.matches:
@@ -1380,25 +1621,28 @@ class _QueueRuntime:
             for req in match.requests():
                 self._publish_matched(req.id, req.reply_to, req.correlation_id,
                                       req.enqueued_at, result, now,
-                                      trace_id=tids.get(req.id, ""))
+                                      trace_id=tids.get(req.id, ""),
+                                      trace=trs.get(req.id))
         if self.queue_cfg.send_queued_ack:
             for req in outcome.queued:
                 self._respond(req, SearchResponse(
                     status="queued", player_id=req.id,
-                    trace_id=tids.get(req.id, "")))
+                    trace_id=tids.get(req.id, "")),
+                    trace=trs.get(req.id))
         for req, code in outcome.rejected:
             m.counters.inc("rejected_by_engine")
             self._respond(req, SearchResponse(
                 status="error", player_id=req.id, error_code=code,
                 error_reason=f"engine rejected request: {code}",
                 trace_id=tids.get(req.id, ""),
-            ))
+            ), trace=trs.get(req.id))
         for req in outcome.timed_out:
             body = encode_response(SearchResponse(
                 status="timeout", player_id=req.id,
                 trace_id=tids.get(req.id, "")))
             self._remember(req.id, body, now)
-            self._publish_body(req.reply_to, req.correlation_id, body)
+            self._publish_body(req.reply_to, req.correlation_id, body,
+                               trace=trs.get(req.id))
 
     def _remember(self, player_id: str, body: bytes, now: float) -> None:
         self._recent[player_id] = (body, now + self.queue_cfg.dedup_ttl_s)
@@ -1418,13 +1662,10 @@ class _QueueRuntime:
             self._recent = {k: v for k, v in self._recent.items() if v[1] > now}
             self._next_prune = now + self.queue_cfg.dedup_ttl_s / 2.0
 
-    def _respond(self, req: SearchRequest, resp: SearchResponse) -> None:
-        if not req.reply_to:
-            return
-        self.app.broker.publish(
-            req.reply_to, encode_response(resp),
-            Properties(correlation_id=req.correlation_id),
-        )
+    def _respond(self, req: SearchRequest, resp: SearchResponse,
+                 trace=None) -> None:
+        self._respond_raw(req.reply_to, req.correlation_id, resp,
+                          trace=trace)
 
     def _respond_error(self, delivery: Delivery, code: str, reason: str) -> None:
         if not delivery.properties.reply_to:
@@ -1690,15 +1931,44 @@ class _QueueRuntime:
             "engine restored (%d waiting players transferred)",
             self.queue_cfg.name, transferred)
 
-    # ---- timeout sweeper --------------------------------------------------
+    # ---- timeout + deadline sweeper ---------------------------------------
 
-    async def _sweep_timeouts(self) -> None:
+    async def _sweep_loop(self) -> None:
+        """One loop, two evictions: the coarse ``request_timeout_s``
+        timeout sweep (engine.expire) and the pool-resident per-slot
+        ``x-deadline`` expiry (engine.expire_deadlines, gated on
+        OverloadConfig.deadline_sweep_ms) — a waiting player whose client
+        stamped a deadline is cancelled EXACTLY at it, not at the next
+        multiple of the queue timeout. Both run under the engine lock on a
+        drained pipeline; deadline expiry costs zero device matching work
+        (a host-mirror column scan + the eviction scatter)."""
         timeout = self.queue_cfg.request_timeout_s
-        assert timeout is not None
-        interval = max(0.05, timeout / 4.0)
+        sweep_ms = self.app.cfg.overload.deadline_sweep_ms
+        deadline_sweep = self.admission is not None and sweep_ms > 0
+        timeout_interval = (max(0.05, timeout / 4.0)
+                            if timeout is not None else None)
+        dl_interval = max(0.01, sweep_ms / 1e3) if deadline_sweep else None
+        interval = min(x for x in (timeout_interval, dl_interval) if x)
+        # Independent cadences (monotonic — wall clocks step): the
+        # deadline sweep may tick at 10 ms without dragging the O(pool)
+        # timeout expire along at the same rate.
+        next_timeout = (time.monotonic() + timeout_interval
+                        if timeout_interval else None)
         while True:
             await asyncio.sleep(interval)
             now = time.time()
+            run_timeout = (next_timeout is not None
+                           and time.monotonic() >= next_timeout)
+            if run_timeout:
+                next_timeout = time.monotonic() + timeout_interval
+            # O(1) gate: a tick with no deadline-carrying waiter (and no
+            # timeout sweep due) must not take the engine lock or drain
+            # the pipeline — deadline-less traffic pays nothing for the
+            # sweep being configured. deadline_count() is a lock-free
+            # point read; -1 (unknown engine) always sweeps.
+            run_dl = deadline_sweep and self.engine.deadline_count() != 0
+            if not run_timeout and not run_dl:
+                continue
             # The lock keeps evictions from racing an in-flight window's
             # engine.search (engines have no internal locking). expire() is
             # O(expired) on the columnar mirror (TpuEngine) and runs off
@@ -1708,11 +1978,16 @@ class _QueueRuntime:
             # so failures revive the engine like the flush/rescan paths.
             try:
                 async with self._engine_lock:
-                    # expire() requires _open == 0 (same re-admission hazard
-                    # as rescan) — collect in-flight windows first.
+                    # expire()/expire_deadlines() require _open == 0 (same
+                    # re-admission hazard as rescan) — collect in-flight
+                    # windows first.
                     await self._drain_engine(now)
-                    expired = await asyncio.to_thread(
+                    expired = (await asyncio.to_thread(
                         self.engine.expire, now, timeout)
+                        if run_timeout else [])
+                    dl_expired = (await asyncio.to_thread(
+                        self.engine.expire_deadlines, now)
+                        if run_dl else [])
             except Exception:
                 log.exception("timeout sweep failed; reviving engine from mirror")
                 self._record_engine_crash(now)
@@ -1729,6 +2004,43 @@ class _QueueRuntime:
                 self._remember(removed.id, body, now)
                 self._publish_body(removed.reply_to, removed.correlation_id,
                                    body)
+            for removed in dl_expired:
+                self._publish_pool_expiry(removed, now)
+
+    def _publish_pool_expiry(self, removed: SearchRequest,
+                             now: float) -> None:
+        """Settle one pool waiter the deadline sweep cancelled: explicit
+        ``timeout`` response (remembered — a redelivered copy replays it
+        instead of re-entering), expired/tier accounting, and a fresh
+        settled trace whose marks are enqueue → expired → publish with NO
+        dispatch mark — the auditable proof the expiry itself spent no
+        device matching work. (The player's ORIGINAL trace settled as
+        "queued" when its admit window collected; expiry is a new
+        lifecycle event, so it gets its own trace.)"""
+        tiered = self.admission is not None and self.admission.tiers > 1
+        if self.admission is not None:
+            self.admission.record_expired(
+                f"pool waiter {removed.id} deadline", tier=removed.tier)
+        tr = None
+        if self.app.trace_enabled:
+            tr = TraceContext(self.queue_cfg.name, removed.correlation_id,
+                              t=removed.enqueued_at or now)
+            tr.player_id = removed.id
+            tr.tier = removed.tier
+            tr.mark("expired", now)
+        body = encode_response(SearchResponse(
+            status="timeout", player_id=removed.id,
+            latency_ms=((now - removed.enqueued_at) * 1e3
+                        if removed.enqueued_at else 0.0),
+            trace_id=tr.trace_id if tr is not None else "",
+            tier=removed.tier if tiered else None))
+        self._remember(removed.id, body, now)
+        self._publish_body(removed.reply_to, removed.correlation_id, body,
+                           trace=tr)
+        if tr is not None:
+            tr.status = "expired"
+            tr.mark("publish")
+            self.app.recorder.complete(tr)
 
     async def close(self) -> None:
         if self._sweeper is not None:
@@ -1776,7 +2088,8 @@ class MatchmakingApp:
         #: /debug/attribution and the SLO good/total counters.
         self.attribution = Attribution(
             buckets=obs.stage_buckets or None,
-            slo_target_s=obs.slo_target_ms / 1e3)
+            slo_target_s=obs.slo_target_ms / 1e3,
+            tiers=max(1, self.cfg.overload.tiers))
         self.recorder.attribution = self.attribution
         #: Continuous telemetry ring (utils/timeseries.py): periodic
         #: snapshots of per-queue load/SLO/idle signals with delta/rate
@@ -1823,14 +2136,27 @@ class MatchmakingApp:
                 rt.engine.warmup()
         obs = self.cfg.observability
         if obs.slo_target_ms > 0:
-            for name in self._runtimes:
-                self._slo_monitors[name] = SloMonitor(
-                    name, target_ms=obs.slo_target_ms,
+            def _monitor(key: str) -> SloMonitor:
+                return SloMonitor(
+                    key, target_ms=obs.slo_target_ms,
                     objective=obs.slo_objective,
                     fast_window_s=obs.slo_fast_window_s,
                     slow_window_s=obs.slo_slow_window_s,
                     burn_threshold=obs.slo_burn_threshold,
                     events=self.events, metrics=self.metrics)
+
+            for name in self._runtimes:
+                self._slo_monitors[name] = _monitor(name)
+                # Tiered QoS: one burn monitor PER TIER on top of the
+                # aggregate — "tier-0 holds its SLO while tier-2 burns" is
+                # the whole point of ordered degradation, and an aggregate
+                # monitor would average the two into a lie. Keyed
+                # "queue@tN" (the telemetry ring's slo_good[queue@tN]
+                # series); /healthz surfaces which tier is burning.
+                if self.cfg.overload.tiers > 1:
+                    for t in range(self.cfg.overload.tiers):
+                        key = f"{name}@t{t}"
+                        self._slo_monitors[key] = _monitor(key)
         if obs.snapshot_interval_s > 0:
             self._telemetry_task = asyncio.create_task(self._telemetry_loop())
         elif self._slo_monitors:
@@ -1955,6 +2281,12 @@ class MatchmakingApp:
                 vals[f"shed_total[{name}]"] = float(rt.admission.shed_total)
                 vals[f"expired_total[{name}]"] = float(
                     rt.admission.expired_total)
+                if rt.admission.tiers > 1:
+                    for t in range(rt.admission.tiers):
+                        vals[f"shed_total[{name}@t{t}]"] = float(
+                            rt.admission.shed_by_tier[t])
+                        vals[f"expired_total[{name}@t{t}]"] = float(
+                            rt.admission.expired_by_tier[t])
             hist = self.metrics.stages.get(name, {}).get("total")
             if hist is not None and hist.count:
                 vals[f"stage_total_p99_ms[{name}]"] = round(
@@ -1965,6 +2297,14 @@ class MatchmakingApp:
             good, total = self.attribution.slo_counts(name)
             vals[f"slo_good[{name}]"] = float(good)
             vals[f"slo_total[{name}]"] = float(total)
+            if self.cfg.overload.tiers > 1:
+                # Per-tier SLO series (slo_good[queue@tN]) — what the
+                # per-tier burn monitors difference: tier-0 attainment must
+                # be readable while tier-2 burns its budget on purpose.
+                for t in range(self.cfg.overload.tiers):
+                    tg, tt = self.attribution.slo_counts_tier(name, t)
+                    vals[f"slo_good[{name}@t{t}]"] = float(tg)
+                    vals[f"slo_total[{name}@t{t}]"] = float(tt)
             if hasattr(rt.engine, "util_report"):
                 u = rt.engine.util_report()
                 vals[f"device_busy_s[{name}]"] = u["device_busy_s"]

@@ -32,7 +32,11 @@ dispatch→oracle_…   work   oracle_step — delegated team/role oracle window
 device_step→seal    wait   readback_group_wait — results waiting for their
                            readback group to fill/go stale
 seal→collect        wait   readback_transfer — D2H in flight + collect poll
-collect→publish     wait   publish_lag — outcome handling queue on the loop
+*→respond           wait   publish_lag — outcome handling queued on the loop
+                           BEFORE the actual broker publish started
+respond→publish     work   respond — the broker publish + settle itself
+collect→publish     wait   publish_lag — traces without a respond mark keep
+                           the pre-split lumped semantics
 *→dedup_replay      work   dedup_replay — terminal-response replay
 *→shed / *→expired  work   admission — shed/expire decision + response
 *→reject            work   reject — middleware/contract rejection
@@ -85,6 +89,7 @@ _BY_TARGET: dict[str, tuple[str, str]] = {
     "oracle_step": ("oracle_step", WORK),
     "readback_seal": ("readback_group_wait", WAIT),
     "collect": ("readback_transfer", WAIT),
+    "respond": ("publish_lag", WAIT),
     "publish": ("publish_lag", WAIT),
     "dedup_replay": ("dedup_replay", WORK),
     "reject": ("reject", WORK),
@@ -110,6 +115,11 @@ def classify(prev: str, cur: str) -> tuple[str, str]:
         # Synchronous engines (host oracle, non-pipelined flush) bracket the
         # whole engine step with dispatch→collect and ship no device marks.
         return ("engine_step", WORK)
+    if cur == "publish" and prev == "respond":
+        # The respond mark (stamped at the broker-publish call) splits the
+        # old publish_lag in two: queueing before the publish (…→respond,
+        # wait) vs the publish + settle itself (respond→publish, work).
+        return ("respond", WORK)
     got = _BY_TARGET.get(cur)
     if got is not None:
         return got
@@ -167,9 +177,46 @@ class _Category:
         self.hist = Histogram(buckets)
 
 
+class _TierStats:
+    """Per-QoS-tier split of a queue's settled spans (tiered serving:
+    the aggregate averages tier-0 holding its SLO with tier-2 burning on
+    purpose into a number that describes neither). Totals only — the
+    category HISTOGRAMS stay aggregate; tiers × categories × buckets is
+    where the memory goes to die, and the per-tier question is "who is
+    burning / who absorbs the shedding", answered by these."""
+
+    __slots__ = ("spans", "work_s", "wait_s", "statuses", "slo_good",
+                 "slo_total", "total_hist")
+
+    def __init__(self, buckets: tuple[float, ...]):
+        self.spans = 0
+        self.work_s = 0.0
+        self.wait_s = 0.0
+        self.statuses: dict[str, int] = {}
+        self.slo_good = 0
+        self.slo_total = 0
+        self.total_hist = Histogram(buckets)
+
+
+class _RescanStats:
+    """Per-queue attribution bucket for rescan windows (PR 6 carry-over):
+    their device time lands in busy/idle but their window marks merge into
+    no trace — this is where that time becomes a number. Kept OUTSIDE the
+    queue's work/wait sums: those telescope to settled-trace spans exactly
+    (the check.sh identity), and a rescan is not a trace."""
+
+    __slots__ = ("windows", "total_s", "device_step_s", "hist")
+
+    def __init__(self, buckets: tuple[float, ...]):
+        self.windows = 0
+        self.total_s = 0.0
+        self.device_step_s = 0.0
+        self.hist = Histogram(buckets)
+
+
 class _QueueAttribution:
     __slots__ = ("categories", "work_s", "wait_s", "spans", "total_hist",
-                 "statuses", "slo_good", "slo_total")
+                 "statuses", "slo_good", "slo_total", "tiers", "rescan")
 
     def __init__(self, buckets: tuple[float, ...]):
         self.categories: dict[str, _Category] = {}
@@ -180,6 +227,8 @@ class _QueueAttribution:
         self.statuses: dict[str, int] = {}
         self.slo_good = 0
         self.slo_total = 0
+        self.tiers: dict[int, _TierStats] = {}
+        self.rescan: _RescanStats | None = None
 
 
 class Attribution:
@@ -188,9 +237,12 @@ class Attribution:
     any two scrapes are well-defined (the telemetry ring samples them)."""
 
     def __init__(self, buckets: tuple[float, ...] | None = None,
-                 slo_target_s: float = 0.0):
+                 slo_target_s: float = 0.0, tiers: int = 1):
         self.buckets = tuple(buckets or DEFAULT_STAGE_BUCKETS)
         self.slo_target_s = slo_target_s
+        #: QoS tier count (OverloadConfig.tiers): > 1 arms the per-tier
+        #: span/status/SLO splits; 1 keeps the pre-tier shape (and cost).
+        self.tiers = max(1, tiers)
         self._queues: dict[str, _QueueAttribution] = {}
 
     def _queue(self, q: str) -> _QueueAttribution:
@@ -203,6 +255,8 @@ class Attribution:
         qa = self._queue(trace.queue)
         marks = trace.marks
         touched: set[str] = set()
+        span_work = 0.0
+        span_wait = 0.0
         prev_name, prev_t = marks[0]
         for name, t in marks[1:]:
             dur = max(0.0, t - prev_t)
@@ -217,19 +271,58 @@ class Attribution:
                 touched.add(category)
                 cat.traces += 1
             if kind == WORK:
-                qa.work_s += dur
+                span_work += dur
             else:
-                qa.wait_s += dur
+                span_wait += dur
             prev_name, prev_t = name, t
+        qa.work_s += span_work
+        qa.wait_s += span_wait
         qa.spans += 1
         total = trace.total_s
         qa.total_hist.observe(total)
         status = trace.status or "unknown"
         qa.statuses[status] = qa.statuses.get(status, 0) + 1
+        good = (self.slo_target_s > 0 and status in _SERVED_STATUSES
+                and total <= self.slo_target_s)
         if self.slo_target_s > 0:
             qa.slo_total += 1
-            if status in _SERVED_STATUSES and total <= self.slo_target_s:
+            if good:
                 qa.slo_good += 1
+        if self.tiers > 1:
+            tier = min(max(getattr(trace, "tier", 0), 0), self.tiers - 1)
+            ts = qa.tiers.get(tier)
+            if ts is None:
+                ts = qa.tiers[tier] = _TierStats(self.buckets)
+            ts.spans += 1
+            ts.work_s += span_work
+            ts.wait_s += span_wait
+            ts.statuses[status] = ts.statuses.get(status, 0) + 1
+            ts.total_hist.observe(total)
+            if self.slo_target_s > 0:
+                ts.slo_total += 1
+                if good:
+                    ts.slo_good += 1
+
+    def observe_rescan(self, queue: str, marks) -> None:
+        """Record one finalized rescan window's engine marks (dispatch →
+        h2d/device_step… → collect) into the queue's rescan bucket. Not a
+        trace: kept out of work_s/wait_s so the telescoping identity over
+        settled traces is untouched."""
+        if not marks or len(marks) < 2:
+            return
+        qa = self._queue(queue)
+        if qa.rescan is None:
+            qa.rescan = _RescanStats(self.buckets)
+        rs = qa.rescan
+        span = max(0.0, marks[-1][1] - marks[0][1])
+        rs.windows += 1
+        rs.total_s += span
+        rs.hist.observe(span)
+        prev_t = marks[0][1]
+        for name, t in marks[1:]:
+            if name == "device_step":
+                rs.device_step_s += max(0.0, t - prev_t)
+            prev_t = t
 
     # ---- reads -------------------------------------------------------------
 
@@ -238,6 +331,15 @@ class Attribution:
         cumulative series the burn-rate monitor differences."""
         qa = self._queues.get(queue)
         return (qa.slo_good, qa.slo_total) if qa is not None else (0, 0)
+
+    def slo_counts_tier(self, queue: str, tier: int) -> tuple[int, int]:
+        """Per-tier (good, total) SLO counters — the series behind the
+        ``queue@tN`` burn monitors."""
+        qa = self._queues.get(queue)
+        if qa is None:
+            return (0, 0)
+        ts = qa.tiers.get(tier)
+        return (ts.slo_good, ts.slo_total) if ts is not None else (0, 0)
 
     def queue_totals(self, queue: str) -> dict[str, float]:
         """Monotone per-queue sums for the telemetry ring."""
@@ -283,6 +385,37 @@ class Attribution:
                 entry["slo_attainment"] = (
                     round(qa.slo_good / qa.slo_total, 4)
                     if qa.slo_total else None)
+            if qa.tiers:
+                entry["tiers"] = {
+                    str(t): {
+                        "spans": ts.spans,
+                        "work_s": round(ts.work_s, 6),
+                        "wait_s": round(ts.wait_s, 6),
+                        "wait_fraction": (
+                            round(ts.wait_s / (ts.work_s + ts.wait_s), 4)
+                            if ts.work_s + ts.wait_s else 0.0),
+                        "statuses": dict(sorted(ts.statuses.items())),
+                        "p99_total_ms": (
+                            round(ts.total_hist.percentile(99) * 1e3, 3)
+                            if ts.total_hist.count else None),
+                        **({"slo_good": ts.slo_good,
+                            "slo_total": ts.slo_total,
+                            "slo_attainment": (
+                                round(ts.slo_good / ts.slo_total, 4)
+                                if ts.slo_total else None)}
+                           if self.slo_target_s > 0 else {}),
+                    }
+                    for t, ts in sorted(qa.tiers.items())
+                }
+            if qa.rescan is not None and qa.rescan.windows:
+                entry["rescan"] = {
+                    "windows": qa.rescan.windows,
+                    "total_s": round(qa.rescan.total_s, 6),
+                    "device_step_s": round(qa.rescan.device_step_s, 6),
+                    "p99_ms": (
+                        round(qa.rescan.hist.percentile(99) * 1e3, 3)
+                        if qa.rescan.hist.count else None),
+                }
             out[q] = entry
         return {"slo_target_ms": round(self.slo_target_s * 1e3, 3),
                 "queues": out}

@@ -31,9 +31,20 @@ T = TypeVar("T")
 class Batcher(Generic[T]):
     def __init__(self, cfg: BatcherConfig,
                  flush: Callable[[list[T]], Awaitable[None]],
-                 observe_window: Callable[[int, float], None] | None = None):
+                 observe_window: Callable[[int, float], None] | None = None,
+                 sort_key: "Callable[[T], object] | None" = None):
         self.cfg = cfg
         self._flush = flush
+        #: Earliest-deadline-first window cutting (OverloadConfig.edf): when
+        #: set, each cut re-orders the pending backlog by this key — the
+        #: runtime keys on (tier, absolute x-deadline) — so a full window
+        #: is exactly the best ``max_batch`` candidates, never an
+        #: arrival-order prefix that strands a near-deadline tier-0
+        #: request behind backlog. The sort is stable (FIFO within equal
+        #: keys) and the key must be a pure function of the item (no clock
+        #: reads — matchlint's determinism rule owns that), so cut
+        #: composition replays bit-identically.
+        self._sort_key = sort_key
         #: Observability hook, called once per cut window with
         #: ``(window_size, open_age_seconds)`` — batch fill and batcher
         #: wait are BASELINE headline metrics (utils/metrics docstring) and
@@ -64,10 +75,27 @@ class Batcher(Generic[T]):
 
     def _cut(self) -> list[T]:
         """Slice the next window off the pending list and report it."""
+        if self._sort_key is not None and len(self._pending) > 1:
+            # EDF: stable-sort the WHOLE backlog, then slice — the window
+            # is the min-key prefix, and the carried-over remainder stays
+            # ordered for the next cut. O(n log n) on the backlog; the
+            # backlog is bounded by admission (and by prefetch without it).
+            key = self._sort_key
+            order = sorted(range(len(self._pending)),
+                           key=lambda i: key(self._pending[i]))
+            self._pending = [self._pending[i] for i in order]
+            if self._observe is not None:
+                self._submitted = [self._submitted[i] for i in order]
         window = self._pending[: self.cfg.max_batch]
         self._pending = self._pending[self.cfg.max_batch:]
         if self._observe is not None and window:
-            age = time.monotonic() - self._submitted[0]
+            # Oldest item still PENDING at the cut (window + remainder):
+            # under FIFO that is index 0, the pre-EDF behavior exactly;
+            # under EDF a starved low-tier item rides the remainder across
+            # many cuts, and restricting the age to the window would
+            # under-report batcher wait precisely while EDF is starving
+            # someone — the signal the adaptive limiter feeds on.
+            age = time.monotonic() - min(self._submitted)
             self._submitted = self._submitted[len(window):]
             self._observe(len(window), max(0.0, age))
         return window

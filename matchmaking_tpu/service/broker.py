@@ -59,6 +59,17 @@ class Delivery:
     #: the app's ingress lazily creates one. Requeues reuse the SAME
     #: Delivery object, so stage marks survive redelivery by construction.
     trace: Any = None
+    #: QoS priority tier (service/overload.py): parsed from the
+    #: ``x-tier`` header at admission and cached here so the batcher's EDF
+    #: sort key and the flush paths never re-parse headers. 0 = the most
+    #: latency-critical tier AND the untiered default.
+    tier: int = 0
+    #: Cached parse of the ``x-deadline`` header (same rationale: the EDF
+    #: key touches every pending delivery per cut). -1.0 = not parsed
+    #: yet; 0.0 = parsed, no deadline; > 0 = absolute wall-clock deadline.
+    #: Safe to cache: the header is stamped once (setdefault) and survives
+    #: redelivery on the same object.
+    deadline: float = -1.0
 
 
 class _Queue:

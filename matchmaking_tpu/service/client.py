@@ -14,7 +14,7 @@ from typing import Any, Mapping
 
 from matchmaking_tpu.service.broker import InProcBroker, Properties
 from matchmaking_tpu.service.contract import SearchResponse, decode_response
-from matchmaking_tpu.service.overload import stamp_deadline
+from matchmaking_tpu.service.overload import stamp_deadline, stamp_tier
 
 
 class MatchmakingClient:
@@ -25,15 +25,18 @@ class MatchmakingClient:
         self.auth_token = auth_token
 
     def submit(self, player: Mapping[str, Any], *, queue: str | None = None,
-               deadline_s: float | None = None) -> str:
+               deadline_s: float | None = None,
+               tier: int | None = None) -> str:
         """Fire a search request; returns the private reply queue name.
         ``deadline_s`` propagates the client's patience to the service as
         an absolute ``x-deadline`` header (service/overload.py): a request
         whose deadline passes before dispatch is cancelled (explicit
         ``timeout``) instead of matched. Deadlines are enforced on the way
-        INTO the pool (admission / batch formation / pre-dispatch); bound
-        the wait of players already pooled with the queue-level
-        ``QueueConfig.request_timeout_s`` sweeper."""
+        INTO the pool (admission / batch formation / pre-dispatch) AND on
+        pool waiters when ``OverloadConfig.deadline_sweep_ms`` is set;
+        ``QueueConfig.request_timeout_s`` remains the coarse fallback.
+        ``tier`` stamps the QoS priority class (``x-tier``: 0 = most
+        latency-critical; higher tiers shed first under overload)."""
         import time
 
         reply_to = f"amq.gen-{uuid.uuid4().hex}"
@@ -42,6 +45,8 @@ class MatchmakingClient:
             {"authorization": self.auth_token} if self.auth_token else {})
         if deadline_s is not None:
             stamp_deadline(headers, time.time(), deadline_s)
+        if tier is not None:
+            stamp_tier(headers, tier)
         self.broker.publish(
             queue or self.request_queue,
             json.dumps(dict(player)).encode(),
@@ -61,13 +66,15 @@ class MatchmakingClient:
                                    timeout: float = 5.0,
                                    queue: str | None = None,
                                    deadline_s: float | None = None,
+                                   tier: int | None = None,
                                    ) -> SearchResponse:
         """Submit and wait through ``queued`` acks until a terminal response
         (matched / timeout / error / shed) or the deadline. Pass
         ``deadline_s`` (usually = ``timeout``) to propagate the patience
         window to the service; a ``shed`` response carries
         ``retry_after_ms`` — back off, don't hammer."""
-        reply_to = self.submit(player, queue=queue, deadline_s=deadline_s)
+        reply_to = self.submit(player, queue=queue, deadline_s=deadline_s,
+                               tier=tier)
         import asyncio
 
         deadline = asyncio.get_event_loop().time() + timeout

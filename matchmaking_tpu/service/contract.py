@@ -99,6 +99,15 @@ class SearchRequest:
     correlation_id: str = ""
     queue: str = ""
     enqueued_at: float = 0.0
+    #: QoS priority tier (``x-tier`` header, not the JSON body — transport
+    #: metadata like reply_to): 0 = most latency-critical; higher numbers
+    #: shed/queue first (service/overload.py). Stamped by the runtime at
+    #: flush when overload control is on; 0 otherwise.
+    tier: int = 0
+    #: Absolute wall-clock deadline (``x-deadline`` header; 0.0 = none).
+    #: Mirrored into the pool so the per-slot sweep can cancel waiters
+    #: exactly at their deadline (OverloadConfig.deadline_sweep_ms).
+    deadline_at: float = 0.0
 
     @property
     def party_size(self) -> int:
@@ -131,6 +140,10 @@ class SearchResponse:
     #: handle a client quotes to ``/debug/traces?id=`` so a shed/timeout/
     #: matched response is directly explainable (ROADMAP PR 3 follow-up).
     trace_id: str = ""
+    #: QoS priority tier the service charged this request to (None on an
+    #: untiered service — the key is then omitted from the wire body, so
+    #: pre-tier clients see byte-identical responses).
+    tier: int | None = None
 
 
 # ---- decode ---------------------------------------------------------------
@@ -273,6 +286,8 @@ def encode_response(resp: SearchResponse) -> bytes:
         payload["retry_after_ms"] = round(resp.retry_after_ms, 3)
     if resp.trace_id:
         payload["trace_id"] = resp.trace_id
+    if resp.tier is not None:
+        payload["tier"] = resp.tier
     return json.dumps(payload, separators=(",", ":")).encode()
 
 
@@ -297,6 +312,7 @@ def decode_response(body: bytes | str) -> SearchResponse:
         latency_ms=float(payload.get("latency_ms", 0.0)),
         retry_after_ms=float(payload.get("retry_after_ms", 0.0)),
         trace_id=str(payload.get("trace_id", "")),
+        tier=(int(payload["tier"]) if "tier" in payload else None),
     )
 
 
@@ -328,6 +344,12 @@ class RequestColumns:
     enqueued_at: "np.ndarray"  # f64[N] wall-clock seconds
     reply_to: "np.ndarray | None" = None       # object[N] str, or None
     correlation_id: "np.ndarray | None" = None
+    #: QoS tier per row (i32; None = all tier 0) and absolute x-deadline
+    #: per row (f64 wall-clock; 0.0/None = none) — mirrored into the pool
+    #: so priority-aware eviction and the per-slot deadline sweep work
+    #: without re-materializing requests (service/overload.py).
+    tier: "np.ndarray | None" = None
+    deadline: "np.ndarray | None" = None
 
     def __len__(self) -> int:
         return len(self.ids)
@@ -347,6 +369,8 @@ class RequestColumns:
             reply_to=None if self.reply_to is None else f(self.reply_to),
             correlation_id=(None if self.correlation_id is None
                             else f(self.correlation_id)),
+            tier=None if self.tier is None else f(self.tier),
+            deadline=None if self.deadline is None else f(self.deadline),
         )
 
     @staticmethod
@@ -367,6 +391,9 @@ class RequestColumns:
             enqueued_at=np.fromiter((r.enqueued_at for r in requests), np.float64, n),
             reply_to=np.fromiter((r.reply_to for r in requests), object, n),
             correlation_id=np.fromiter((r.correlation_id for r in requests), object, n),
+            tier=np.fromiter((r.tier for r in requests), np.int32, n),
+            deadline=np.fromiter((r.deadline_at for r in requests),
+                                 np.float64, n),
         )
         return cols
 

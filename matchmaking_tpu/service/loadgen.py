@@ -18,12 +18,20 @@ deadline-propagation path is exercised. The seeded overload soak
 (tests/test_overload.py) and bench.py's multiproc phase both drive this
 entry point.
 
+Tiered mode (``--tier-mix``, ISSUE 7): offer a per-class load — e.g.
+``0:0.2,1:0.5,2:0.3`` sends 20% tier-0 / 50% tier-1 / 30% tier-2, each
+request stamped with its ``x-tier`` header — and account every response
+class PER TIER (the loadgen assigned each correlation id its tier, so the
+split needs no tier echo from the service). The tier draw is a pure
+function of the seed, so a tiered soak replays bit-identically.
+
 Env contract (set by the bench on top of the multiproc worker env; each has
 a CLI flag that wins when both are given):
     MM_LOADGEN_RATE         offered req/s (Poisson)      (--offered-rate)
     MM_LOADGEN_SECONDS      measured duration            (--seconds)
     MM_LOADGEN_SEED         arrival/rating RNG seed      (--seed)
     MM_LOADGEN_DEADLINE_MS  per-request deadline, 0=off  (--deadline-ms)
+    MM_LOADGEN_TIER_MIX     tier mix, "" = untiered      (--tier-mix)
     MM_LOADGEN_OUT          path for the JSON result     (--out)
 """
 
@@ -48,8 +56,24 @@ _STATUS_PROBES = (
 )
 
 
+def parse_tier_mix(spec: str) -> "dict[int, float] | None":
+    """``"0:0.2,1:0.5,2:0.3"`` → {0: 0.2, 1: 0.5, 2: 0.3} (weights
+    normalized); ""/None → None (untiered)."""
+    if not spec:
+        return None
+    mix: dict[int, float] = {}
+    for part in spec.split(","):
+        t, _, w = part.partition(":")
+        mix[int(t)] = float(w)
+    total = sum(mix.values())
+    if total <= 0:
+        raise ValueError(f"tier mix has no mass: {spec!r}")
+    return {t: w / total for t, w in sorted(mix.items())}
+
+
 async def offered_load(app, queue: str, *, rate: float, duration: float,
                        seed: int, deadline_s: float = 0.0,
+                       tier_mix: "dict[int, float] | None" = None,
                        reply_q: str = "loadgen.replies",
                        drain_polls: int = 200) -> dict:
     """Offer a seeded Poisson load to ``app``'s broker and account for
@@ -61,21 +85,50 @@ async def offered_load(app, queue: str, *, rate: float, duration: float,
     keeping the pool small so the measured cost is INGRESS (decode →
     middleware → batcher → publish) — or, when ``rate`` exceeds the
     clearing rate, ADMISSION (the shed path).
+
+    ``tier_mix`` (tier → weight) stamps a seeded ``x-tier`` per arrival
+    and splits the accounting per tier (statuses + matched-latency p99) —
+    correlation ids carry the assignment, so the per-tier split is exact
+    even for response bodies that don't echo the tier.
     """
     from matchmaking_tpu.service.broker import Properties
-    from matchmaking_tpu.service.overload import stamp_deadline
+    from matchmaking_tpu.service.overload import stamp_deadline, stamp_tier
 
     app.broker.declare_queue(reply_q)
     tally = {name: 0 for name, _ in _STATUS_PROBES}
     tally["replies"] = 0
+    tier_of_corr: dict[str, int] = {}
+    per_tier: dict[int, dict] = {}
+    if tier_mix:
+        per_tier = {t: {**{name: 0 for name, _ in _STATUS_PROBES},
+                        "offered": 0, "latencies_ms": []}
+                    for t in tier_mix}
 
     async def on_reply(delivery) -> None:
         tally["replies"] += 1
         body = bytes(delivery.body)
+        status = ""
         for name, probe in _STATUS_PROBES:
             if probe in body:
                 tally[name] += 1
-                return
+                status = name
+                break
+        if not per_tier or not status:
+            return
+        t = tier_of_corr.get(delivery.properties.correlation_id)
+        if t is None:
+            return
+        row = per_tier[t]
+        row[status] += 1
+        if status == "matched":
+            # Tiered runs pay one json.loads per MATCHED reply for the
+            # per-tier latency split; the untiered path keeps the cheap
+            # substring probes.
+            try:
+                row["latencies_ms"].append(
+                    float(json.loads(body).get("latency_ms", 0.0)))
+            except (ValueError, TypeError):
+                pass
 
     tag = app.broker.basic_consume(reply_q, on_reply, prefetch=1_000_000)
 
@@ -85,12 +138,23 @@ async def offered_load(app, queue: str, *, rate: float, duration: float,
     counters = app.metrics.counters
     shed0 = counters.get("shed_requests")
     expired0 = counters.get("expired_requests")
+    tier_base = {t: (counters.get(f"shed_requests_t{t}"),
+                     counters.get(f"expired_requests_t{t}"))
+                 for t in (tier_mix or ())}
 
     rng = np.random.default_rng(seed)
     n_max = int(rate * duration * 2) + 16
     ratings = np.repeat(rng.normal(1500.0, 300.0, size=n_max // 2 + 1), 2)
     gaps = rng.exponential(1.0 / rate, size=n_max)
     sched = np.cumsum(gaps)
+    tiers = None
+    if tier_mix:
+        # Seeded per-arrival tier draw (pure function of the seed, drawn
+        # up front like ratings/gaps — replay-identical by construction).
+        tiers = rng.choice(np.fromiter(tier_mix, np.int64, len(tier_mix)),
+                           size=n_max,
+                           p=np.fromiter(tier_mix.values(), np.float64,
+                                         len(tier_mix)))
     t0 = time.perf_counter()
     i = 0
     while i < n_max and sched[i] <= duration:
@@ -100,6 +164,11 @@ async def offered_load(app, queue: str, *, rate: float, duration: float,
             headers: dict = {}
             if deadline_s > 0:
                 stamp_deadline(headers, time.time(), deadline_s)
+            if tiers is not None:
+                t = int(tiers[i])
+                stamp_tier(headers, t)
+                tier_of_corr[pid] = t
+                per_tier[t]["offered"] += 1
             app.broker.publish(
                 queue,
                 f'{{"id":"{pid}","rating":{ratings[i]:.2f}}}'.encode(),
@@ -115,7 +184,7 @@ async def offered_load(app, queue: str, *, rate: float, duration: float,
                 and app.broker.handlers_idle()):
             break
     app.broker.basic_cancel(tag)
-    return {
+    result = {
         "queue": queue,
         "offered_req_s": rate,
         "sent": i,
@@ -130,6 +199,27 @@ async def offered_load(app, queue: str, *, rate: float, duration: float,
         "shed_requests": int(counters.get("shed_requests") - shed0),
         "expired_requests": int(counters.get("expired_requests") - expired0),
     }
+    if per_tier:
+        result["tiers"] = {
+            str(t): {
+                "offered": row["offered"],
+                "matched": row["matched"],
+                "queued_acks": row["queued"],
+                "shed": row["shed"],
+                "timeout": row["timeout"],
+                "error": row["error"],
+                "p99_ms": (round(float(np.percentile(
+                    row["latencies_ms"], 99)), 3)
+                    if row["latencies_ms"] else None),
+                "shed_requests": int(counters.get(f"shed_requests_t{t}")
+                                     - tier_base[t][0]),
+                "expired_requests": int(
+                    counters.get(f"expired_requests_t{t}")
+                    - tier_base[t][1]),
+            }
+            for t, row in sorted(per_tier.items())
+        }
+    return result
 
 
 async def _run(args) -> dict:
@@ -142,7 +232,8 @@ async def _run(args) -> dict:
     result = await offered_load(
         app, cfg.queues[0].name,
         rate=args.offered_rate, duration=args.seconds, seed=args.seed,
-        deadline_s=args.deadline_ms / 1e3 if args.deadline_ms > 0 else 0.0)
+        deadline_s=args.deadline_ms / 1e3 if args.deadline_ms > 0 else 0.0,
+        tier_mix=parse_tier_mix(args.tier_mix))
     result["pid"] = os.getpid()
     await app.stop()
     return result
@@ -169,6 +260,11 @@ def _parse_args(argv=None):
     p.add_argument("--deadline-ms", type=float,
                    default=float(env.get("MM_LOADGEN_DEADLINE_MS", "0")),
                    help="stamp x-deadline on every request (0 = off)")
+    p.add_argument("--tier-mix",
+                   default=env.get("MM_LOADGEN_TIER_MIX", ""),
+                   help="per-class offered load, e.g. '0:0.2,1:0.5,2:0.3' "
+                        "— stamps a seeded x-tier per arrival and splits "
+                        "the response accounting per tier ('' = untiered)")
     p.add_argument("--out", default=env.get("MM_LOADGEN_OUT", ""),
                    help="path for the one-line JSON result")
     return p.parse_args(argv)

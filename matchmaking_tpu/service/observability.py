@@ -204,6 +204,19 @@ def _flatten_prom(report: dict[str, Any]) -> str:
             fams.add("matchmaking_attribution_seconds", "counter",
                      {"queue": queue, "category": cat, "kind": c["kind"]},
                      c["total_s"])
+        # Per-QoS-tier work/wait split (tiered serving): cumulative, so
+        # rate() gives the live per-tier wait fraction.
+        for t, ts in entry.get("tiers", {}).items():
+            fams.add("matchmaking_attributed_work_seconds", "counter",
+                     {"queue": queue, "tier": t}, ts["work_s"])
+            fams.add("matchmaking_attributed_wait_seconds", "counter",
+                     {"queue": queue, "tier": t}, ts["wait_s"])
+        rescan = entry.get("rescan")
+        if rescan:
+            fams.add("matchmaking_rescan_attributed_seconds", "counter",
+                     {"queue": queue}, rescan["total_s"])
+            fams.add("matchmaking_rescan_windows", "counter",
+                     {"queue": queue}, rescan["windows"])
     # True per-stage latency histograms (the flight recorder's output) as a
     # proper histogram family: cumulative le buckets + _sum + _count.
     for queue, stages in report.get("stage_seconds", {}).items():
@@ -261,12 +274,24 @@ class ObservabilityServer:
             admission = getattr(rt, "admission", None)
             if admission is not None:
                 entry["overload"] = admission.snapshot()
-            monitor = getattr(self.app, "_slo_monitors", {}).get(name)
+            monitors = getattr(self.app, "_slo_monitors", {})
+            monitor = monitors.get(name)
             if monitor is not None:
                 entry["slo"] = monitor.snapshot()
+            # Tiered QoS: the per-tier burn monitors (keyed "queue@tN") —
+            # /healthz must show WHICH tier is burning, not an aggregate
+            # that averages tier-0 holding with tier-2 burning on purpose.
+            tier_mons = {k.rsplit("@", 1)[1]: m.snapshot()
+                         for k, m in monitors.items()
+                         if k.startswith(name + "@t")}
+            if tier_mons:
+                entry["slo_tiers"] = tier_mons
             queues[name] = entry
-        burning = [name for name, q in queues.items()
-                   if q.get("slo", {}).get("burning")]
+        # Burning keys include tier monitors ("queue@tN"): routing reacts
+        # to the aggregate, placement/QoS tooling to the tier split.
+        burning = [key for key, mon in
+                   getattr(self.app, "_slo_monitors", {}).items()
+                   if mon.burning]
         body = {
             # Degraded ≠ dead: matches still flow on the host path, so the
             # service stays live — operators alert on the field instead.

@@ -38,6 +38,20 @@ Three pieces, all deterministic by construction:
   trips, which is the whole point (the breaker handles component failure;
   this handles offered load).
 
+- **Priority tiers** (``OverloadConfig.tiers`` — Nitsum's admission
+  classes): requests carry an ``x-tier`` header (0 = most latency-critical;
+  missing → the queue's ``default_tier``), and every cap is partitioned
+  into a NESTED LADDER: tier t is shed once same-or-higher-priority usage
+  (tiers 0..t) reaches ``cap * tier_shares[t]`` — so under overload the
+  lowest tier stops admitting first, adaptive tightening bites the lowest
+  tier first (every slice scales with the credit fraction and the smallest
+  binds first), ``shed_policy="oldest"`` eviction consumes victims
+  lowest-priority-first (oldest within a tier), and tier 0 is never shed
+  while a lower tier holds anything evictable. Graceful degradation is
+  thereby ORDERED: tier 2 absorbs the shedding and queueing so tier 0
+  holds its SLO. Tier decisions are pure functions of the header + the
+  controller's per-tier counts, so tiered soaks replay bit-identically.
+
 Graceful drain rides the same controller: ``begin_drain()`` flips it to
 shed-everything while the app collects in-flight windows and checkpoints
 every waiting pool (service/app.MatchmakingApp.drain).
@@ -45,13 +59,18 @@ every waiting pool (service/app.MatchmakingApp.drain).
 
 from __future__ import annotations
 
-from typing import Any, Mapping, MutableMapping
+from typing import Any, Mapping, MutableMapping, Sequence
 
 from matchmaking_tpu.config import OverloadConfig
 
 #: Message header carrying the absolute wall-clock request deadline
 #: (epoch seconds, ``repr(float)`` — same convention as x-trace-enqueue).
 DEADLINE_HEADER = "x-deadline"
+
+#: Message header carrying the QoS priority tier (decimal int; 0 = the
+#: most latency-critical class, higher numbers shed first). Missing or
+#: garbled reads as the queue's configured default tier.
+TIER_HEADER = "x-tier"
 
 #: Admission decisions (AdmissionController.decide).
 ADMIT = "admit"
@@ -81,6 +100,28 @@ def deadline_of(headers: Mapping[str, Any]) -> float | None:
         return None
 
 
+def stamp_tier(headers: MutableMapping[str, Any], tier: int) -> None:
+    """Stamp the QoS tier unless one is already set (client-stamped tiers
+    win; redeliveries reuse the headers dict, so the class survives
+    requeue by construction — same contract as ``stamp_deadline``)."""
+    headers.setdefault(TIER_HEADER, str(int(tier)))
+
+
+def tier_of(headers: Mapping[str, Any], default: int = 0,
+            n_tiers: int = 1) -> int:
+    """The tier stamped in ``headers``, clamped into ``[0, n_tiers)``;
+    missing/garbled reads as ``default`` (a foreign header must not crash
+    admission, and an out-of-range tier must not escape the ladder)."""
+    raw = headers.get(TIER_HEADER)
+    if raw is None:
+        return min(max(int(default), 0), max(0, n_tiers - 1))
+    try:
+        t = int(float(raw))
+    except (TypeError, ValueError):
+        t = int(default)
+    return min(max(t, 0), max(0, n_tiers - 1))
+
+
 class AdmissionController:
     """Per-queue credit limiter + deadline gate + adaptive shedding.
 
@@ -91,15 +132,36 @@ class AdmissionController:
     """
 
     def __init__(self, cfg: OverloadConfig, queue: str, metrics=None,
-                 events=None):
+                 events=None, default_tier: int = 0):
         self.cfg = cfg
         self.queue = queue
         self._metrics = metrics
         self._events = events
+        #: QoS priority ladder (cfg.tiers; 1 = untiered). Tier 0 is the
+        #: most latency-critical; higher numbers shed first.
+        self.tiers = max(1, cfg.tiers)
+        self.default_tier = min(max(int(default_tier), 0), self.tiers - 1)
+        #: Per-tier cap shares: tier t is shed once same-or-higher-priority
+        #: occupancy reaches ``cap * share[t]``. share[0] is forced to 1.0
+        #: (tier 0 may use the whole cap); () → the equal ladder.
+        if cfg.tier_shares:
+            shares = [min(1.0, max(0.0, float(s)))
+                      for s in cfg.tier_shares[:self.tiers]]
+            while len(shares) < self.tiers:
+                shares.append(shares[-1])
+        else:
+            shares = [(self.tiers - t) / self.tiers
+                      for t in range(self.tiers)]
+        shares[0] = 1.0
+        self._shares = tuple(shares)
         #: Delivery tags holding an admission credit (admitted, not yet
-        #: settled). A set keyed by tag makes release idempotent: every
-        #: settle path (ack, nack, requeue, revive) can release blindly.
-        self._credits: set[int] = set()
+        #: settled), mapped to the tier they admitted under. Keyed by tag
+        #: so release is idempotent: every settle path (ack, nack,
+        #: requeue, revive) can release blindly.
+        self._credits: dict[int, int] = {}
+        #: Per-tier held-credit counts (len == tiers; the prefix sums the
+        #: partition checks run on).
+        self._held = [0] * self.tiers
         #: Adaptive credit fraction in [min_credit_fraction, 1.0]; scales
         #: BOTH caps so occupancy and concurrency tighten together.
         self._fraction = 1.0
@@ -107,6 +169,8 @@ class AdmissionController:
         self.draining = False
         self.shed_total = 0
         self.expired_total = 0
+        self.shed_by_tier = [0] * self.tiers
+        self.expired_by_tier = [0] * self.tiers
         self._publish_gauges()
 
     # ---- decisions ---------------------------------------------------------
@@ -118,10 +182,44 @@ class AdmissionController:
             return 0
         return max(1, int(cap * self._fraction))
 
-    def decide(self, delivery, now: float, pool_size: int) -> str:
+    def _tier_cap(self, cap: int, tier: int) -> int:
+        """Tier ``tier``'s slice of an (already adaptive-scaled) cap —
+        the nested-ladder bound its prefix occupancy is held to."""
+        if tier == 0:
+            return cap
+        return max(1, int(cap * self._shares[tier]))
+
+    def _held_upto(self, tier: int) -> int:
+        """Credits held by SAME-OR-HIGHER-priority tiers (0..tier). The
+        partition check counts only these: lower-priority holdings never
+        block a higher tier — that is the whole point of the ladder — so
+        a high-tier burst may transiently overshoot the global cap by what
+        lower tiers already held (bounded by the share ladder; lower-tier
+        admission stops first and drains the overshoot)."""
+        return sum(self._held[: tier + 1])
+
+    def tier_of_delivery(self, delivery) -> int:
+        """The delivery's QoS tier: ``x-tier`` header, else the queue
+        default — stamped back into the headers so redeliveries keep the
+        class (same contract as the deadline stamp)."""
+        headers = delivery.properties.headers
+        tier = tier_of(headers, self.default_tier, self.tiers)
+        if self.tiers > 1:
+            stamp_tier(headers, tier)
+        return tier
+
+    def decide(self, delivery, now: float, pool_size: int,
+               pool_tiers: "Sequence[int] | None" = None) -> str:
         """ADMIT / SHED / EXPIRED for one arriving delivery. Pure function
-        of (draining, deadline header vs now, credits held, pool_size) —
-        no RNG, no clock reads — so identical ingress replays identically."""
+        of (draining, deadline header vs now, tier header, credits held,
+        pool occupancy per tier) — no RNG, no clock reads — so identical
+        ingress replays identically. ``pool_tiers`` is the per-tier
+        waiting-pool composition (engine ``pool_tier_counts``); None means
+        unknown and every pool occupant counts against every tier (the
+        conservative read, and exactly the untiered behavior at tiers=1).
+
+        Caches the resolved tier on ``delivery.tier`` so the batcher's EDF
+        key and the flush paths never re-parse headers."""
         headers = delivery.properties.headers
         if self.cfg.default_deadline_ms > 0:
             # Stamp relative to first receive, not now: a redelivered copy
@@ -135,31 +233,54 @@ class AdmissionController:
             except (TypeError, ValueError):
                 first = now
             stamp_deadline(headers, first, self.cfg.default_deadline_ms / 1e3)
+        tier = self.tier_of_delivery(delivery)
+        delivery.tier = tier
         deadline = deadline_of(headers)
+        # Cache the parse (Delivery.deadline): the EDF cut key and the
+        # flush paths read it per pending item, and the header cannot
+        # change after this point (stamp is setdefault-once).
+        delivery.deadline = deadline if deadline is not None else 0.0
         if deadline is not None and now >= deadline:
             return EXPIRED
         if self.draining:
             return SHED
         cap = self._eff(self.cfg.max_inflight)
-        if cap and len(self._credits) >= cap:
+        if cap and self._held_upto(tier) >= self._tier_cap(cap, tier):
             return SHED
         cap = self._eff(self.cfg.max_waiting)
-        if cap and pool_size + len(self._credits) >= cap:
+        if cap:
+            if pool_tiers is None or self.tiers == 1:
+                pool_upto = pool_size
+            else:
+                pool_upto = sum(pool_tiers[: tier + 1])
             # Projected occupancy: credits are deliveries already committed
             # toward the pool (in the batcher or an in-flight window) —
             # counting the live pool alone would over-admit a whole
-            # batcher's worth per window. Under shed_policy="oldest" the
-            # over-cap arrival admits anyway; the flush settles the debt
-            # from ACTUAL occupancy (eviction_debt), so an admit that
-            # never reaches the pool (bad auth, dedup replay, expired
-            # deadline) cannot cost an innocent waiting player their slot.
-            if self.cfg.shed_policy == "oldest":
-                return ADMIT
-            return SHED
+            # batcher's worth per window. Only same-or-higher-priority
+            # usage counts against tier ``tier``'s slice (nested ladder).
+            if pool_upto + self._held_upto(tier) >= self._tier_cap(cap, tier):
+                # Under shed_policy="oldest" the over-cap arrival admits
+                # anyway; the flush settles the debt from ACTUAL occupancy
+                # (eviction_debt, victims lowest-priority-first), so an
+                # admit that never reaches the pool (bad auth, dedup
+                # replay, expired deadline) cannot cost an innocent
+                # waiting player their slot. Tiered queues additionally
+                # require a same-or-lower-priority victim to exist —
+                # admitting a tier-2 arrival into a pool of tier-0
+                # waiters would either evict a HIGHER-priority player or
+                # blow the cap with nothing evictable.
+                if self.cfg.shed_policy == "oldest":
+                    if (self.tiers == 1 or pool_tiers is None
+                            or any(pool_tiers[tier:])):
+                        return ADMIT
+                return SHED
         return ADMIT
 
-    def admit(self, delivery_tag: int) -> None:
-        self._credits.add(delivery_tag)
+    def admit(self, delivery_tag: int, tier: int = 0) -> None:
+        if delivery_tag not in self._credits:
+            tier = min(max(tier, 0), self.tiers - 1)
+            self._credits[delivery_tag] = tier
+            self._held[tier] += 1
         if self._metrics is not None:
             self._metrics.set_gauge(f"overload_inflight[{self.queue}]",
                                     len(self._credits))
@@ -167,8 +288,9 @@ class AdmissionController:
     def release(self, delivery_tag: int) -> None:
         """Return the delivery's credit (idempotent; unknown tags — never
         admitted, or already settled — are no-ops)."""
-        if delivery_tag in self._credits:
-            self._credits.discard(delivery_tag)
+        tier = self._credits.pop(delivery_tag, None)
+        if tier is not None:
+            self._held[tier] -= 1
             if self._metrics is not None:
                 self._metrics.set_gauge(f"overload_inflight[{self.queue}]",
                                         len(self._credits))
@@ -176,19 +298,31 @@ class AdmissionController:
     def inflight(self) -> int:
         return len(self._credits)
 
-    def record_shed(self, detail: str = "") -> None:
+    def record_shed(self, detail: str = "", tier: int = 0) -> None:
         self.shed_total += 1
+        tier = min(max(tier, 0), self.tiers - 1)
+        self.shed_by_tier[tier] += 1
         if self._metrics is not None:
             self._metrics.counters.inc("shed_requests")
+            if self.tiers > 1:
+                self._metrics.counters.inc(f"shed_requests_t{tier}")
         if self._events is not None:
-            self._events.append("shed", self.queue, detail)
+            self._events.append("shed", self.queue,
+                                f"tier={tier} {detail}" if self.tiers > 1
+                                else detail)
 
-    def record_expired(self, detail: str = "") -> None:
+    def record_expired(self, detail: str = "", tier: int = 0) -> None:
         self.expired_total += 1
+        tier = min(max(tier, 0), self.tiers - 1)
+        self.expired_by_tier[tier] += 1
         if self._metrics is not None:
             self._metrics.counters.inc("expired_requests")
+            if self.tiers > 1:
+                self._metrics.counters.inc(f"expired_requests_t{tier}")
         if self._events is not None:
-            self._events.append("expired", self.queue, detail)
+            self._events.append("expired", self.queue,
+                                f"tier={tier} {detail}" if self.tiers > 1
+                                else detail)
 
     def eviction_debt(self, n_entering: int, pool_size: int) -> int:
         """shed_policy="oldest": how many longest-waiting pool players the
@@ -254,7 +388,7 @@ class AdmissionController:
                                 self._fraction)
 
     def snapshot(self) -> dict[str, Any]:
-        return {
+        snap: dict[str, Any] = {
             "inflight": len(self._credits),
             "credit_fraction": round(self._fraction, 4),
             "max_inflight": self.cfg.max_inflight,
@@ -264,3 +398,14 @@ class AdmissionController:
             "expired_total": self.expired_total,
             "draining": self.draining,
         }
+        if self.tiers > 1:
+            snap["tiers"] = {
+                str(t): {
+                    "share": round(self._shares[t], 4),
+                    "held": self._held[t],
+                    "shed": self.shed_by_tier[t],
+                    "expired": self.expired_by_tier[t],
+                }
+                for t in range(self.tiers)
+            }
+        return snap

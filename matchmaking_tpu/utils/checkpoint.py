@@ -121,6 +121,8 @@ def engine_waiting_columns(engine) -> tuple[RequestColumns, np.ndarray, np.ndarr
             enqueued_at=pool.m_enqueued[slots].copy(),
             reply_to=pool.m_reply[slots].copy(),
             correlation_id=pool.m_corr[slots].copy(),
+            tier=pool.m_tier[slots].copy(),
+            deadline=pool.m_deadline[slots].copy(),
         )
         return cols, regions, modes
     # Object-path fallback (CPU oracle / team delegates).
@@ -138,6 +140,8 @@ def engine_waiting_columns(engine) -> tuple[RequestColumns, np.ndarray, np.ndarr
         enqueued_at=np.fromiter((r.enqueued_at for r in reqs), np.float64, n),
         reply_to=np.fromiter((r.reply_to for r in reqs), object, n),
         correlation_id=np.fromiter((r.correlation_id for r in reqs), object, n),
+        tier=np.fromiter((r.tier for r in reqs), np.int32, n),
+        deadline=np.fromiter((r.deadline_at for r in reqs), np.float64, n),
     )
     regions = np.fromiter((r.region for r in reqs), object, n)
     modes = np.fromiter((r.game_mode for r in reqs), object, n)
@@ -168,6 +172,15 @@ def save_pool(engine, path: str, *, queue_name: str = "") -> int:
                           else np.full(len(cols), "", object)).astype(str),
                 correlation_id=(cols.correlation_id if cols.correlation_id
                                 is not None else np.full(len(cols), "", object)).astype(str),
+                # QoS columns (tier + absolute x-deadline): a drained
+                # tier-0 waiter must restore as tier-0, and its deadline
+                # must survive the handoff so the successor's sweep still
+                # honors it. Written unconditionally; loaders tolerate
+                # their absence (pre-QoS checkpoints read as tier 0).
+                tier=(cols.tier if cols.tier is not None
+                      else np.zeros(len(cols), np.int32)),
+                deadline=(cols.deadline if cols.deadline is not None
+                          else np.zeros(len(cols), np.float64)),
             )
         os.replace(tmp, path)
     except BaseException:
@@ -200,6 +213,11 @@ def load_pool(engine, path: str, now: float | None = None) -> int:
             enqueued_at=z["enqueued_at"],
             reply_to=z["reply_to"].astype(object),
             correlation_id=z["correlation_id"].astype(object),
+            # Pre-QoS checkpoints lack these: tier 0 / no deadline.
+            tier=(z["tier"] if "tier" in z.files
+                  else np.zeros(n, np.int32)),
+            deadline=(z["deadline"] if "deadline" in z.files
+                      else np.zeros(n, np.float64)),
         )
     t = time.time() if now is None else now
     if hasattr(engine, "restore_columns") and hasattr(engine, "intern_columns"):
@@ -219,6 +237,9 @@ def load_pool(engine, path: str, now: float | None = None) -> int:
             reply_to=str(cols.reply_to[i]),
             correlation_id=str(cols.correlation_id[i]),
             enqueued_at=float(cols.enqueued_at[i]),
+            tier=int(cols.tier[i]) if cols.tier is not None else 0,
+            deadline_at=(float(cols.deadline[i])
+                         if cols.deadline is not None else 0.0),
         )
         for i in range(n)
     ]

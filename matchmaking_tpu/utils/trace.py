@@ -50,7 +50,7 @@ class TraceContext:
     list) — it is allocated on EVERY broker publish."""
 
     __slots__ = ("trace_id", "queue", "correlation_id", "player_id",
-                 "redelivered", "status", "marks")
+                 "redelivered", "status", "tier", "marks")
 
     def __init__(self, queue: str, correlation_id: str = "",
                  redelivered: bool = False, t: float | None = None):
@@ -60,6 +60,9 @@ class TraceContext:
         self.player_id = ""
         self.redelivered = redelivered
         self.status = ""  # set at settle: matched/queued/rejected/...
+        #: QoS priority tier (service/overload.py; 0 = untiered default),
+        #: stamped at admission so attribution can split per tier.
+        self.tier = 0
         self.marks: list[tuple[str, float]] = [
             ("enqueue", time.time() if t is None else t)]
 
@@ -82,6 +85,7 @@ class TraceContext:
             "correlation_id": self.correlation_id,
             "redelivered": self.redelivered,
             "status": self.status,
+            "tier": self.tier,
             "enqueue_t": t0,
             "total_ms": round(self.total_s * 1e3, 3),
             #: absolute wall-clock marks (monotone non-decreasing)

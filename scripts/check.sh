@@ -26,6 +26,12 @@ echo "== overload =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'overload and not slow' \
     --continue-on-collection-errors -p no:cacheprovider
 
+echo "== qos =="
+# Tiered-QoS suite (ISSUE 7): priority partitions / EDF ordering /
+# pool-resident deadline expiry regressions fail fast and by name.
+JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'qos and not slow' \
+    --continue-on-collection-errors -p no:cacheprovider
+
 echo "== tier-1 =="
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider

@@ -48,6 +48,11 @@ def pytest_configure(config):
         "markers", "lint: static-analysis gate (`pytest -m lint` runs "
         "matchlint as a test node; part of tier-1)")
     config.addinivalue_line(
+        "markers", "qos: tiered-QoS suite (priority classes / EDF window "
+        "cutting / pool-resident deadline expiry — scripts/check.sh runs "
+        "it by marker; the fast ones are tier-1, soaks additionally "
+        "carry `slow`)")
+    config.addinivalue_line(
         "markers", "overload: overload-control suite (admission/shed/"
         "deadline/drain — scripts/check.sh runs it by marker; the fast "
         "ones are tier-1, soaks additionally carry `slow`)")

@@ -358,3 +358,81 @@ class Sampler:
         self._next_snapshot = time.monotonic() + 1.0
 ''', path="matchmaking_tpu/utils/fixture.py")
     assert clean == []
+
+
+def test_cross_class_guarded_by_checks_external_serialization():
+    """ISSUE 7 satellite (PR 4 carry-over): a class declaring
+    ``externally-serialized-by: <lock>`` arms method-CALL checking on
+    every attribute guarded by that lock — an off-lock
+    ``self.engine.remove(...)`` is now a finding, not a docstring
+    violation; declared ``lock-free:`` reads stay exempt."""
+    src = '''
+import asyncio
+
+# externally-serialized-by: _engine_lock
+# lock-free: pool_size
+class FakeEngine:
+    def expire_deadlines(self, now):
+        return []
+
+    def pool_size(self):
+        return 0
+
+class Runtime:
+    def __init__(self):
+        self._engine_lock = asyncio.Lock()
+        # guarded-by: _engine_lock
+        self.engine = FakeEngine()
+
+    async def bad(self, now):
+        return self.engine.expire_deadlines(now)
+
+    async def good_read(self):
+        return self.engine.pool_size()
+
+    async def good_locked(self, now):
+        async with self._engine_lock:
+            return self.engine.expire_deadlines(now)
+
+    # holds-lock: _engine_lock
+    def good_helper(self, now):
+        return self.engine.expire_deadlines(now)
+'''
+    findings = analyze_source(src, path="matchmaking_tpu/service/fixture.py")
+    guarded = [f for f in findings if f.rule == "guarded-by"]
+    assert len(guarded) == 1
+    assert "Runtime.bad" in guarded[0].context
+    assert "externally-serialized-by" in guarded[0].message
+    # Without the class declaration, calls through the attr are unchecked
+    # (the pre-cross-class behavior — only mutations/stores were).
+    undeclared = src.replace(
+        "# externally-serialized-by: _engine_lock\n", "").replace(
+        "# lock-free: pool_size\n", "")
+    assert [f for f in analyze_source(
+        undeclared, path="matchmaking_tpu/service/fixture.py")
+        if f.rule == "guarded-by"] == []
+
+
+def test_determinism_covers_edf_ordering_arithmetic():
+    """ISSUE 7 satellite: the EDF window-cut ordering keys are a new
+    schedule-shaped surface — a cut key born from time.time() makes
+    window COMPOSITION depend on scheduler jitter. The sanctioned shape
+    is a pure function of the message (stamped x-deadline header + the
+    admission-cached delivery tier)."""
+    findings = analyze_source('''
+import time
+
+def cut(pending, delivery):
+    edf_key = (delivery.tier, time.time() + 0.2)
+    cut_key = time.time() + 1.0
+    return sorted(pending, key=lambda d: edf_key)
+''', path="matchmaking_tpu/service/fixture.py")
+    assert _rules(findings) == ["determinism"] * 2
+    clean = analyze_source('''
+def edf_key(item, deadline_of):
+    _req, delivery = item
+    deadline = deadline_of(delivery.properties.headers)
+    return (delivery.tier,
+            deadline if deadline is not None else float("inf"))
+''', path="matchmaking_tpu/service/fixture.py")
+    assert clean == []
