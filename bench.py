@@ -713,6 +713,121 @@ def bench_e2e(args) -> dict:
     return asyncio.run(run())
 
 
+def bench_quality_frontier(args) -> dict:
+    """The measured quality-vs-latency frontier (ISSUE 8; ``--e2e-quality``).
+
+    Sweeps the queue's ``rating_threshold`` (and optionally
+    ``widen_per_sec``) across fresh single-queue apps at a fixed offered
+    load, and records each point's match-quality distribution
+    (p10/p50/mean), engine-observed wait-at-match p99, client-observed
+    latency p99, and the per-rating-bucket disparity gaps — the Cinder-style
+    fast-vs-fair tradeoff as ``e2e_frontier`` rows in the BENCH json.
+
+    A STRICTER threshold buys closer-rated matches (mean rating distance
+    falls) at the cost of longer waits; the monotone flags compare the
+    extremes so a frontier that fails to trade is visible in the artifact.
+    This is the baseline any future pluggable match-objective kernel
+    (ROADMAP) must beat: better quality at equal wait, or equal quality
+    faster.
+    """
+    import asyncio
+
+    from matchmaking_tpu.config import (
+        BatcherConfig,
+        BrokerConfig,
+        Config,
+        EngineConfig,
+        ObservabilityConfig,
+        QueueConfig,
+    )
+    from matchmaking_tpu.service.app import MatchmakingApp
+    from matchmaking_tpu.service.loadgen import offered_load
+
+    thresholds = [float(x) for x in args.e2e_quality_thresholds.split(",")
+                  if x.strip()]
+    rate = float(args.e2e_quality_rate)
+    seconds = float(args.e2e_quality_seconds)
+    widen = float(args.e2e_quality_widen)
+    # Small geometry on purpose: the frontier is a SHAPE measurement (how
+    # quality trades against wait), not a throughput row — it must also
+    # complete on the CPU-mesh fallback.
+    capacity = min(args.capacity, 8192)
+
+    async def point(threshold: float) -> dict:
+        cfg = Config(
+            queues=(QueueConfig(rating_threshold=threshold,
+                                widen_per_sec=widen,
+                                max_threshold=max(400.0, threshold),
+                                send_queued_ack=False),),
+            engine=EngineConfig(backend="tpu", pool_capacity=capacity,
+                                pool_block=min(args.pool_block, capacity),
+                                batch_buckets=(16, 64, 256), top_k=8,
+                                pipeline_depth=args.depth,
+                                warm_start=True),
+            batcher=BatcherConfig(max_batch=256, max_wait_ms=3.0),
+            broker=BrokerConfig(prefetch=8192),
+            observability=ObservabilityConfig(snapshot_interval_s=0.0,
+                                              quality_report_every=4),
+        )
+        app = MatchmakingApp(cfg)
+        await app.start()
+        rt = app.runtime(cfg.broker.request_queue)
+        res = await offered_load(
+            app, cfg.broker.request_queue, rate=rate, duration=seconds,
+            seed=11, quality_stats=True,
+            rating_sigma=float(args.e2e_quality_sigma))
+        # Exact engine totals for the disparity read: flush forces the
+        # device-accumulator snapshot.
+        async with rt._engine_lock:
+            await asyncio.to_thread(rt.engine.flush)
+        rep = (rt.engine.quality_report()
+               if hasattr(rt.engine, "quality_report") else None) or {}
+        await app.stop()
+        qs = res.get("quality", {})
+        return {
+            "threshold": threshold,
+            "widen_per_sec": widen,
+            "offered_req_s": rate,
+            "matched": qs.get("matched", 0),
+            "matched_per_s": res.get("matched_per_s"),
+            "quality_mean": qs.get("quality_mean"),
+            "quality_p10": qs.get("quality_p10"),
+            "quality_p50": qs.get("quality_p50"),
+            "wait_at_match_ms_p50": qs.get("waited_ms_p50"),
+            "wait_at_match_ms_p99": qs.get("waited_ms_p99"),
+            "latency_ms_p99": qs.get("latency_ms_p99"),
+            "wait_gap_ms_mean": qs.get("wait_gap_ms_mean"),
+            "spread_mean": rep.get("spread_mean"),
+            "engine_wait_p90_s": rep.get("wait_p90_s"),
+            "quality_disparity": rep.get("disparity", {}).get("quality_gap"),
+            "wait_p90_disparity_s": rep.get("disparity",
+                                            {}).get("wait_p90_gap_s"),
+            "sent": res.get("sent", 0),
+        }
+
+    rows = []
+    for thr in thresholds:
+        row = asyncio.run(point(thr))
+        log(f"[e2e-quality thr={thr:g}] {row}")
+        rows.append(row)
+    out: dict = {"e2e_frontier": rows}
+    # Monotone-tradeoff flags between the sweep extremes (sorted by
+    # threshold): stricter matching must buy a smaller mean rating
+    # distance and cost a longer wait, or the frontier didn't trade.
+    ordered = sorted((r for r in rows if r["matched"]),
+                     key=lambda r: r["threshold"])
+    if len(ordered) >= 2:
+        lo, hi = ordered[0], ordered[-1]
+        if lo.get("spread_mean") is not None and hi.get("spread_mean") is not None:
+            out["e2e_frontier_spread_monotone"] = (
+                lo["spread_mean"] <= hi["spread_mean"])
+        if (lo.get("wait_at_match_ms_p50") is not None
+                and hi.get("wait_at_match_ms_p50") is not None):
+            out["e2e_frontier_wait_monotone"] = (
+                lo["wait_at_match_ms_p50"] >= hi["wait_at_match_ms_p50"])
+    return out
+
+
 def bench_multiproc(args) -> dict:
     """Multi-process ingress scaling: N supervised self-driving workers
     (service/multiproc.WorkerSupervisor + service/loadgen), each running
@@ -977,6 +1092,13 @@ def run_cpu_fallback(args) -> None:
     except Exception as e:
         log(f"[fallback] e2e phase failed: {e!r}")
         out["error"] = "cpu_fallback_failed"
+    if args.e2e_quality:
+        # The frontier is a shape measurement — it runs on the CPU mesh
+        # unchanged (the acceptance gate for ISSUE 8 reads it here).
+        try:
+            out.update(bench_quality_frontier(args))
+        except Exception as e:
+            log(f"[fallback] e2e-quality phase failed: {e!r}")
     print(json.dumps(out), flush=True)
 
 
@@ -1066,6 +1188,29 @@ def main() -> None:
                         "headers, enables EDF cutting + lowest-tier-first "
                         "shedding, and emits per-tier p99/shed/expired "
                         "rows (e2e_tiers) in the BENCH json ('' = off)")
+    p.add_argument("--e2e-quality", action="store_true",
+                   help="quality/latency frontier phase (ISSUE 8): sweep "
+                        "rating_threshold across fresh apps and record "
+                        "per-point quality p10/p50/mean, wait-at-match "
+                        "p99, and rating-bucket disparity as e2e_frontier "
+                        "rows — the baseline any future match-objective "
+                        "kernel must beat")
+    p.add_argument("--e2e-quality-thresholds", default="25,50,100,200,400",
+                   help="comma-separated rating_threshold sweep for the "
+                        "frontier phase")
+    p.add_argument("--e2e-quality-rate", type=float, default=600.0,
+                   help="offered req/s per frontier point (kept modest: "
+                        "the frontier is a shape measurement and must "
+                        "complete on the CPU-mesh fallback)")
+    p.add_argument("--e2e-quality-seconds", type=float, default=3.0,
+                   help="measured duration per frontier point")
+    p.add_argument("--e2e-quality-widen", type=float, default=0.0,
+                   help="widen_per_sec applied at every frontier point "
+                        "(0 = pure threshold sweep)")
+    p.add_argument("--e2e-quality-sigma", type=float, default=150.0,
+                   help="iid rating stddev for frontier arrivals (diverse "
+                        "ratings, NOT the loadgen's paired default — the "
+                        "threshold must bite for quality/wait to trade)")
     p.add_argument("--e2e-sweep-seconds", type=float, default=4.0,
                    help="duration of each saturation-sweep step")
     p.add_argument("--e2e-slo-ms", type=float, default=250.0,
@@ -1210,6 +1355,11 @@ def main() -> None:
             log(f"[e2e] {e2e}")
         except Exception as e:
             log(f"[e2e] failed: {e!r}")
+    if args.e2e_quality:
+        try:
+            e2e.update(bench_quality_frontier(args))
+        except Exception as e:
+            log(f"[e2e-quality] failed: {e!r}")
     mp = {}
     if not args.skip_multiproc:
         try:

@@ -38,6 +38,7 @@ RULES = (
     "blocking-call",
     "determinism",
     "recompile",
+    "perf",
 )
 
 

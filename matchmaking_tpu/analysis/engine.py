@@ -11,7 +11,13 @@ import os
 import sys
 import tempfile
 
-from matchmaking_tpu.analysis import blocking, determinism, locks, recompile
+from matchmaking_tpu.analysis import (
+    blocking,
+    determinism,
+    locks,
+    perf,
+    recompile,
+)
 from matchmaking_tpu.analysis.core import (
     Finding,
     SourceFile,
@@ -24,7 +30,8 @@ from matchmaking_tpu.analysis.core import (
 )
 
 #: rule-module checkers run over the discovered sources.
-_STATIC_CHECKS = (locks.check, blocking.check, determinism.check)
+_STATIC_CHECKS = (locks.check, blocking.check, determinism.check,
+                  perf.check)
 
 
 def analyze_source(code: str, path: str = "snippet.py") -> list[Finding]:

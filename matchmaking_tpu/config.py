@@ -466,6 +466,30 @@ class ObservabilityConfig:
     slo_fast_window_s: float = 60.0
     slo_slow_window_s: float = 300.0
     slo_burn_threshold: float = 1.0
+    #: Match-quality & fairness observatory (ISSUE 8; engine/quality.py).
+    #: Rating-bucket edges for the conditional quality/wait accounting —
+    #: () → engine/quality.DEFAULT_RATING_EDGES (8 buckets around a
+    #: N(1500, 300) rating distribution). The fairness axis: per-bucket
+    #: conditional means + the disparity gaps are computed over these.
+    quality_rating_edges: tuple[float, ...] = ()
+    #: Linear quality-histogram buckets over [0, 1].
+    quality_buckets: int = 20
+    #: Wait-at-match histogram bucket upper bounds (seconds); () → the
+    #: default log-spaced scheme (1 ms · 2^k, 22 buckets + overflow).
+    quality_wait_buckets: tuple[float, ...] = ()
+    #: Device-accumulator readback cadence, in WINDOWS: the engine
+    #: snapshots its device-resident quality state with an async D2H every
+    #: N finalized windows and materializes it at a later finalize — the
+    #: quality report is at most N windows stale and the hot path never
+    #: pays a synchronous readback. flush() forces a fresh snapshot.
+    quality_report_every: int = 16
+    #: Per-queue quality SLO (reuses utils/timeseries.SloMonitor): a
+    #: matched player is GOOD when the match quality is ≥ this target
+    #: (0..1; 0 disables). Quality regressions then burn on /healthz
+    #: exactly like latency SLOs — ``<queue>#quality`` monitor keys.
+    quality_slo_target: float = 0.0
+    #: Fraction of matched players that must meet the quality target.
+    quality_slo_objective: float = 0.9
 
 
 @dataclass(frozen=True)

@@ -20,6 +20,11 @@ import numpy as np
 from matchmaking_tpu.config import Config, QueueConfig
 from matchmaking_tpu.engine import scoring
 from matchmaking_tpu.engine.interface import Engine, Match, SearchOutcome
+from matchmaking_tpu.engine.quality import (
+    HostQualityAccum,
+    QualitySpec,
+    build_report,
+)
 from matchmaking_tpu.service.contract import ANY, SearchRequest, new_match_id
 
 
@@ -27,13 +32,19 @@ from matchmaking_tpu.service.contract import ANY, SearchRequest, new_match_id
 # either behind the same _engine_lock); the insertion-ordered lists here
 # are just as unsynchronized as the device mirror.
 # externally-serialized-by: _engine_lock
-# lock-free: pool_size, inflight, pool_tier_counts, deadline_count, util_report, span_report
+# lock-free: pool_size, inflight, pool_tier_counts, deadline_count, util_report, span_report, quality_report
 class CpuEngine(Engine):
     def __init__(self, cfg: Config, queue: QueueConfig):
         super().__init__(cfg, queue)
         # Waiting pool: insertion-ordered parallel lists (the ETS table analog).
         self._entries: list[SearchRequest] = []
         self._by_id: dict[str, int] = {}  # player id -> index in _entries
+        #: Match-quality & fairness accounting (ISSUE 8): the exact
+        #: host-side equivalent of the device accumulation kernel — the
+        #: oracle is also the delegate behind breaker demotion / wildcard
+        #: delegation, so its matches must land in the same ledger.
+        self.quality_accum = HostQualityAccum(
+            QualitySpec.from_config(cfg.observability))
         # Incremental per-tier occupancy (QoS admission partitions read
         # this per delivery — see Engine.pool_tier_counts) + the count of
         # deadline-carrying waiters (sweep-loop gate).
@@ -127,6 +138,25 @@ class CpuEngine(Engine):
 
     # ---- internals --------------------------------------------------------
 
+    def quality_report(self) -> dict:
+        """Per-rating-bucket quality/wait report over every match this
+        engine formed (engine/quality.build_report shape). Lock-free:
+        monotone numpy counters written on the caller thread only."""
+        return build_report(self.quality_accum.arrays,
+                            self.quality_accum.spec)
+
+    def _observe_match(self, members, quality: float, spread: float,
+                       now: float) -> None:
+        """Fold one formed match into the quality accumulator: one sample
+        per member request unit (the unit's leader rating; parties count
+        once — the device role path has no columnar form either)."""
+        self.quality_accum.observe(
+            rating=[m.rating for m in members],
+            quality=quality,
+            wait_s=[(max(0.0, now - m.enqueued_at) if m.enqueued_at else 0.0)
+                    for m in members],
+            spread=spread)
+
     def pool_tier_counts(self, n_tiers: int) -> list[int]:
         out = [0] * max(1, n_tiers)
         for t, n in self._tier_n.items():
@@ -211,6 +241,7 @@ class CpuEngine(Engine):
             out.matches.append(
                 Match(match_id=new_match_id(), teams=((req,), (cand,)), quality=q)
             )
+            self._observe_match((req, cand), q, float(best_dist), now)
         else:
             self._insert(req)
             out.queued.append(req)
@@ -249,6 +280,12 @@ class CpuEngine(Engine):
                     for r in (r for team in teams for r in team):
                         self._evict(self._by_id[r.id])
                     out.matches.append(Match(new_match_id(), teams, qual))
+                    # Role windows report quality only; spread is folded
+                    # into it (quality = 1 - spread/threshold), so record 0
+                    # rather than inventing a second number.
+                    self._observe_match(
+                        tuple(r for team in teams for r in team),
+                        qual, 0.0, now)
                     matched_here = True
                     break
             if self._team_full_scan and not matched_here:
@@ -265,6 +302,9 @@ class CpuEngine(Engine):
                         self._evict(self._by_id[p.id])
                     qual = max(0.0, 1.0 - spread / thr) if thr > 0 else 0.0
                     out.matches.append(Match(new_match_id(), teams, qual))
+                    self._observe_match(
+                        tuple(p for t in teams for p in t),
+                        qual, spread, now)
                     break
         # The newest request may or may not be in the formed match; if it
         # still waits, report it queued.

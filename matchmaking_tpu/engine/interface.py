@@ -94,6 +94,22 @@ class ColumnarOutcome:
     q_ids: "np.ndarray"       # object[Q] newly queued player ids
     #: (player_id, reason_code) pairs the engine refused.
     rejected: list[tuple[str, str]] = field(default_factory=list)
+    #: Engine-observed wait-at-match per side (seconds): the window's
+    #: dispatch time minus the slot's enqueue timestamp — what the
+    #: ``waited_ms`` response field and the quality/fairness accounting
+    #: report (ISSUE 8). Distinct from the response ``latency_ms``
+    #: (publish time − enqueue), which additionally counts collect +
+    #: publish queueing.
+    m_wait_a: "np.ndarray" = field(
+        default_factory=lambda: np.empty(0, np.float64))
+    m_wait_b: "np.ndarray" = field(
+        default_factory=lambda: np.empty(0, np.float64))
+    #: QoS tier per matched side (pool mirror column; zeros untiered) —
+    #: the service's per-tier quality histograms key off these.
+    m_tier_a: "np.ndarray" = field(
+        default_factory=lambda: np.empty(0, np.int32))
+    m_tier_b: "np.ndarray" = field(
+        default_factory=lambda: np.empty(0, np.int32))
 
     @property
     def n_matches(self) -> int:
@@ -104,9 +120,12 @@ def empty_columnar_outcome() -> ColumnarOutcome:
     e = np.empty(0, object)
     z = np.empty(0, np.float32)
     t = np.empty(0, np.float64)
+    i = np.empty(0, np.int32)
     return ColumnarOutcome(m_id_a=e, m_id_b=e, m_match_id=e, m_dist=z,
                            m_quality=z, m_reply_a=e, m_reply_b=e, m_corr_a=e,
-                           m_corr_b=e, m_enq_a=t, m_enq_b=t, q_ids=e)
+                           m_corr_b=e, m_enq_a=t, m_enq_b=t, q_ids=e,
+                           m_wait_a=t.copy(), m_wait_b=t.copy(),
+                           m_tier_a=i.copy(), m_tier_b=i.copy())
 
 
 class Engine(abc.ABC):
@@ -179,6 +198,16 @@ class Engine(abc.ABC):
         once per delivery on tiered queues, so implementations must be
         O(n_tiers), never O(pool): both backends maintain the counts
         incrementally."""
+        return None
+
+    def quality_report(self) -> "dict | None":
+        """Match-quality & fairness accounting (ISSUE 8;
+        engine/quality.build_report shape): per-rating-bucket quality/wait
+        histograms, conditional means, and disparity gaps over every match
+        this engine formed. None when the engine does not track quality.
+        Implementations must be lock-free reads of host-side monotone
+        counters (the /metrics scrape path calls this off the engine
+        lock, like ``util_report``)."""
         return None
 
     def deadline_count(self) -> int:

@@ -829,6 +829,117 @@ class KernelSet:
         return pool, out_q, out_c, out_d
 
 
+class QualityAccumKernel:
+    """Device-resident match-quality/wait accumulator (ISSUE 8).
+
+    One tiny jitted step per dispatched window folds the step's OWN device
+    outputs — ``(q_slot, c_slot, dist)`` — into per-queue device-resident
+    histogram + count/sum arrays, conditioned on rating bucket. The matched
+    slots' rating/enqueue/threshold columns are read from the POST-step
+    pool: eviction only clears ``active`` (``KernelSet._evict`` is a mask,
+    not a wipe), so the columns still hold the matched players' values.
+
+    Hot-path cost: one extra async dispatch per window over arrays already
+    on device — no host scan, no D2H, no sync. The state is NOT donated:
+    it is a few KB, and keeping old handles valid is what lets the engine
+    snapshot it with ``copy_to_host_async`` and materialize lazily at a
+    later finalize (TpuEngine piggybacks the readback on the existing
+    window-collect path instead of adding a transfer per window).
+
+    Scatter-free like everything else here: histogram adds are dense
+    one-hot compare matrices ((2B samples) × (R·N cells), both tiny) —
+    the same idiom the admit/evict kernels use instead of XLA scatters.
+
+    Bucket rules must match ``engine/quality.QualitySpec`` bit-for-bit on
+    equal f32 inputs (side="right" searchsorted, floor(q·N) clip) — the
+    device-vs-host reconciliation soak in tests/test_quality.py holds the
+    two implementations together.
+    """
+
+    def __init__(self, *, capacity: int, widen_per_sec: float,
+                 max_threshold: float, rating_edges, n_quality: int,
+                 wait_edges):
+        import numpy as np
+
+        self.capacity = capacity
+        self.widen_per_sec = widen_per_sec
+        self.max_threshold = max_threshold
+        self.n_rating = len(rating_edges) + 1
+        self.n_quality = n_quality
+        self.n_wait = len(wait_edges) + 1  # + overflow
+        self._r_edges = np.asarray(rating_edges, np.float32)
+        self._w_edges = np.asarray(wait_edges, np.float32)
+        self.accum = jax.jit(self._accum)
+
+    def init_state(self) -> dict[str, jnp.ndarray]:
+        r = self.n_rating
+        return {
+            "q_hist": jnp.zeros((r, self.n_quality), jnp.int32),
+            "w_hist": jnp.zeros((r, self.n_wait), jnp.int32),
+            "count": jnp.zeros(r, jnp.int32),
+            "q_sum": jnp.zeros(r, jnp.float32),
+            "w_sum": jnp.zeros(r, jnp.float32),
+            "d_sum": jnp.zeros(r, jnp.float32),
+        }
+
+    def _accum(self, state, rating, enqueue_t, threshold, out, now):
+        q_slot = out[0].astype(jnp.int32)
+        c_slot = out[1].astype(jnp.int32)
+        dist = out[2]
+        b = q_slot.shape[0]
+        cap = self.capacity
+        hit = q_slot < cap
+        idx = jnp.concatenate([jnp.clip(q_slot, 0, cap - 1),
+                               jnp.clip(c_slot, 0, cap - 1)])
+        valid = jnp.concatenate([hit, hit])
+        r = jnp.take(rating, idx)
+        enq = jnp.take(enqueue_t, idx)
+        thr = jnp.take(threshold, idx)
+        eff = _effective_threshold(thr, enq, now, self.widen_per_sec,
+                                   self.max_threshold)
+        # The pair's mutual limit — min of both sides' effective thresholds
+        # at match time, the exact formula the host response path uses.
+        limit = jnp.minimum(eff[:b], eff[b:])
+        limit2 = jnp.concatenate([limit, limit])
+        d2 = jnp.concatenate([dist, dist])
+        # Sanitize BEFORE any masked arithmetic: unmatched lanes carry the
+        # +inf dist sentinel, and 0 × inf is NaN, not 0.
+        d2 = jnp.where(valid, d2, 0.0)
+        quality = jnp.where(
+            valid & (limit2 > 0.0),
+            jnp.clip(1.0 - d2 / jnp.maximum(limit2, jnp.float32(1e-30)),
+                     0.0, 1.0),
+            0.0)
+        wait = jnp.where(valid, jnp.maximum(0.0, now - enq), 0.0)
+
+        rb = jnp.searchsorted(jnp.asarray(self._r_edges), r,
+                              side="right").astype(jnp.int32)
+        qb = jnp.clip((quality * self.n_quality).astype(jnp.int32), 0,
+                      self.n_quality - 1)
+        wb = jnp.searchsorted(jnp.asarray(self._w_edges), wait,
+                              side="right").astype(jnp.int32)
+
+        def hist_add(hist, col_idx, n_cols):
+            flat = rb * n_cols + col_idx
+            cells = jnp.arange(hist.size, dtype=jnp.int32)
+            onehot = (flat[:, None] == cells[None, :]) & valid[:, None]
+            return hist + onehot.sum(axis=0,
+                                     dtype=hist.dtype).reshape(hist.shape)
+
+        rows = ((rb[:, None] == jnp.arange(self.n_rating,
+                                           dtype=jnp.int32)[None, :])
+                & valid[:, None])
+        rf = rows.astype(jnp.float32)
+        return {
+            "q_hist": hist_add(state["q_hist"], qb, self.n_quality),
+            "w_hist": hist_add(state["w_hist"], wb, self.n_wait),
+            "count": state["count"] + rows.sum(axis=0, dtype=jnp.int32),
+            "q_sum": state["q_sum"] + (rf * quality[:, None]).sum(axis=0),
+            "w_sum": state["w_sum"] + (rf * wait[:, None]).sum(axis=0),
+            "d_sum": state["d_sum"] + (rf * d2[:, None]).sum(axis=0),
+        }
+
+
 @functools.lru_cache(maxsize=None)
 def kernel_set(capacity: int, top_k: int, pool_block: int, glicko2: bool,
                widen_per_sec: float, max_threshold: float,

@@ -64,7 +64,18 @@ from matchmaking_tpu.engine.interface import (
     SearchOutcome,
     empty_columnar_outcome,
 )
-from matchmaking_tpu.engine.kernels import kernel_set
+from matchmaking_tpu.engine.kernels import (
+    KernelSet,
+    QualityAccumKernel,
+    kernel_set,
+)
+from matchmaking_tpu.engine.quality import (
+    HostQualityAccum,
+    QualitySpec,
+    add_arrays,
+    build_report,
+    empty_arrays,
+)
 from matchmaking_tpu.service.contract import (
     RequestColumns,
     SearchRequest,
@@ -159,7 +170,7 @@ class _Pending:
 # under the GIL, no mirror mutation) the service uses off-lock: admission
 # occupancy, backpressure polling, /metrics scrapes.
 # externally-serialized-by: _engine_lock
-# lock-free: pool_size, inflight, pool_tier_counts, deadline_count, util_report, span_report
+# lock-free: pool_size, inflight, pool_tier_counts, deadline_count, util_report, span_report, quality_report
 class TpuEngine(Engine):
     def __init__(self, cfg: Config, queue: QueueConfig):
         super().__init__(cfg, queue)
@@ -370,6 +381,40 @@ class TpuEngine(Engine):
         #: perf_counter at the last busy/idle transition; written only on
         #: the caller thread (same single-writer discipline as the mirror).
         self._util_mark = time.perf_counter()
+        #: Match-quality & fairness accumulation (ISSUE 8). Plain 1v1
+        #: kernel sets accumulate ON DEVICE (engine/kernels.
+        #: QualityAccumKernel — one extra async dispatch per window over
+        #: arrays already on device, zero host scans); team/role/sharded
+        #: paths fall back to the exact host-side equivalent at finalize.
+        #: Never both for one match: ``_quality is None`` gates the host
+        #: fallback.
+        self._q_spec = QualitySpec.from_config(cfg.observability)
+        self._quality: QualityAccumKernel | None = None
+        if not self._team_device and isinstance(self.kernels, KernelSet):
+            self._quality = QualityAccumKernel(
+                capacity=self.kernels.capacity,
+                widen_per_sec=queue.widen_per_sec,
+                max_threshold=queue.max_threshold,
+                rating_edges=self._q_spec.rating_edges,
+                n_quality=self._q_spec.n_quality,
+                wait_edges=self._q_spec.wait_edges)
+        #: Device-resident accumulator state (None when host-only). NOT
+        #: donated through accum steps, so snapshot handles stay valid for
+        #: the piggybacked async readback below.
+        self._q_dev = (self._quality.init_state()
+                       if self._quality is not None else None)
+        #: Host-side accumulator for the paths with no device kernel
+        #: (object/team finalize, sharded columnar finalize) — same bucket
+        #: scheme, merged into quality_report().
+        self._q_host_accum = HostQualityAccum(self._q_spec)
+        #: Last materialized device-state snapshot (numpy) + the in-flight
+        #: async D2H handles; refreshed every ``quality_report_every``
+        #: finalized windows, forced at flush(). quality_report() reads
+        #: ONLY these host arrays — never a device sync off the lock.
+        self._q_host: dict[str, np.ndarray] | None = None
+        self._q_sync_handles: dict[str, Any] | None = None
+        self._q_sync_every = max(1, cfg.observability.quality_report_every)
+        self._q_windows = 0
 
     def _chaos_step(self) -> None:
         """Scripted device-step fault point: called BEFORE any state is
@@ -697,6 +742,9 @@ class TpuEngine(Engine):
             self._dev_pool, out = step(
                 self._dev_pool, jnp.asarray(pack_batch(batch, now - t0))
             )
+            # Rescan matches are real matches: they land in the quality
+            # accounting like traffic windows.
+            self._quality_accum_dispatch(out, now)
             self.util["lanes_valid"] += int(slots.size)
             self.util["lanes_padded"] += bucket
             pending.chunks.append(((cols, slots), (out,), now))
@@ -794,6 +842,7 @@ class TpuEngine(Engine):
         )
         self.spans["jit_s"] += time.perf_counter() - _t
         pending.marks.append(("device_step", time.time()))
+        self._quality_accum_dispatch(out, now)
         self.util["lanes_valid"] += len(cols)
         self.util["lanes_padded"] += bucket
         pending.chunks.append(((cols, slots), (out,), now))
@@ -839,6 +888,73 @@ class TpuEngine(Engine):
                 lanes_valid / max(1, lanes_padded), 6),
         }
 
+    # ---- match-quality & fairness accumulation (ISSUE 8) ------------------
+
+    def _quality_accum_dispatch(self, out: Any, now: float) -> None:
+        """Fold one dispatched window's device outputs into the
+        device-resident quality accumulator. One extra ASYNC dispatch over
+        arrays already on device (the post-step pool columns + the step's
+        own result array) — no host scan, no D2H, no sync; the matchlint
+        ``perf`` rule covers this function by name."""
+        if self._quality is None:
+            return
+        pool = self._dev_pool
+        self._q_dev = self._quality.accum(
+            self._q_dev, pool["rating"], pool["enqueue_t"],
+            pool["threshold"], out, now - self._rel_base(now))
+
+    def _quality_sync_finalize(self) -> None:
+        """Piggyback the accumulator readback on window collection: every
+        ``quality_report_every`` finalized windows, queue ONE async D2H of
+        the state handles; a later finalize materializes them once the
+        transfer has landed. The hot path never pays a synchronous device
+        round trip for the quality report — it is at most N windows
+        stale."""
+        if self._quality is None:
+            return
+        pending = self._q_sync_handles
+        if pending is not None:
+            try:
+                ready = all(h.is_ready() for h in pending.values())
+            except AttributeError:  # pragma: no cover - non-Array types
+                ready = True
+            if ready:
+                self._q_host = {k: np.asarray(v) for k, v in pending.items()}
+                self._q_sync_handles = None
+        self._q_windows += 1
+        if (self._q_windows >= self._q_sync_every
+                and self._q_sync_handles is None):
+            self._q_windows = 0
+            handles = dict(self._q_dev)
+            for h in handles.values():
+                _copy_async(h)
+            self._q_sync_handles = handles
+
+    def _quality_force_sync(self) -> None:
+        """Blocking accumulator readback — flush()-time only (flush already
+        blocks on every in-flight window), so tests/drain/checkpoint see
+        exact totals."""
+        if self._quality is None:
+            return
+        self._q_host = {k: np.asarray(v) for k, v in self._q_dev.items()}
+        self._q_sync_handles = None
+        self._q_windows = 0
+
+    def quality_report(self) -> dict:
+        """Per-rating-bucket quality/wait report over every match this
+        engine formed (engine/quality.build_report shape): the last
+        device-state snapshot + the host-side fallback accumulator + a
+        live delegate's accumulator. Lock-free: host numpy arrays and an
+        atomically-swapped snapshot dict only — /metrics may call this off
+        the engine lock, like util_report()."""
+        arrays = empty_arrays(self._q_spec)
+        add_arrays(arrays, self._q_host_accum.arrays)
+        add_arrays(arrays, self._q_host)
+        d = self._team_delegate
+        if d is not None and hasattr(d, "quality_accum"):
+            add_arrays(arrays, d.quality_accum.arrays)
+        return build_report(arrays, self._q_spec)
+
     def inflight(self) -> int:
         """Windows dispatched but not yet finalized (caller-thread view)."""
         return self._open
@@ -875,6 +991,9 @@ class TpuEngine(Engine):
             done.append((pending.token,
                          pending.columnar if pending.columnar is not None
                          else pending.outcome))
+        # Every window is collected — refresh the quality snapshot so
+        # drain/checkpoint/tests read exact totals (flush blocks anyway).
+        self._quality_force_sync()
         return done
 
     def close(self) -> None:
@@ -1144,6 +1263,10 @@ class TpuEngine(Engine):
             self._delegate_last_wc = now
             return False
         waiting = d.waiting()
+        # The delegate's quality accounting must survive re-promotion — its
+        # matches were this queue's matches.
+        if hasattr(d, "quality_accum"):
+            add_arrays(self._q_host_accum.arrays, d.quality_accum.arrays)
         self._team_delegate = None
         self._delegate_last_wc = float("-inf")
         self.pool = PlayerPool(self.kernels.capacity,
@@ -1187,6 +1310,14 @@ class TpuEngine(Engine):
             for fn in variants:
                 self._dev_pool, out = fn(self._dev_pool, packed)
                 jax.block_until_ready(out)
+            if self._quality is not None:
+                # The quality accumulator compiles once per result shape
+                # (bucket) too — an all-padding window adds nothing, so
+                # warming it here is state-free.
+                self._q_dev = self._quality.accum(
+                    self._q_dev, self._dev_pool["rating"],
+                    self._dev_pool["enqueue_t"],
+                    self._dev_pool["threshold"], out, 0.0)
             admit = getattr(self.kernels, "admit_packed", None)
             if admit is not None:
                 self._dev_pool = admit(self._dev_pool,
@@ -1286,6 +1417,7 @@ class TpuEngine(Engine):
             self._dev_pool, packed_dev
         )
         pending.marks.append(("device_step", time.time()))
+        self._quality_accum_dispatch(out, now)
         self.util["lanes_valid"] += len(window)
         self.util["lanes_padded"] += bucket
         pending.chunks.append((list(window), (out,), now))
@@ -1307,6 +1439,9 @@ class TpuEngine(Engine):
             now_pc = time.perf_counter()
             self.util["busy_s"] += max(0.0, now_pc - self._util_mark)
             self._util_mark = now_pc
+        # Quality-accumulator readback rides the collect path (async D2H
+        # queued at a window cadence, materialized when it lands).
+        self._quality_sync_finalize()
         if pending.created:
             self.spans["windows"] += 1
             self.spans["turnaround_s"] += time.perf_counter() - pending.created
@@ -1350,6 +1485,8 @@ class TpuEngine(Engine):
         if self._team_device:
             self._finalize_team(pending)
             return
+        acc: list[tuple[float, float, float, float]] | None = (
+            [] if self._quality is None else None)
         for (window, _, now), (packed_out,) in zip(
                 pending.chunks, pending.raw or ()):
             q_slot = packed_out[0].astype(np.int32)
@@ -1363,7 +1500,7 @@ class TpuEngine(Engine):
                 cs_l = c_slot[hit].tolist()
                 d_l = dist[hit].tolist()
                 for qs, cs, d in zip(qs_l, cs_l, d_l):
-                    req_q = self.pool.request_at(qs)
+                    req_q = self.pool.request_at(qs)  # matchlint: ignore[perf] object 1v1 path — per-match materialization is its contract; the columnar hot path is scan-free
                     req_c = self.pool.request_at(cs)
                     matched_ids.add(req_q.id)
                     matched_ids.add(req_c.id)
@@ -1378,11 +1515,22 @@ class TpuEngine(Engine):
                         Match(match_id=new_match_id(),
                               teams=((req_q,), (req_c,)), quality=qual)
                     )
+                    if acc is not None:
+                        # Host quality fallback (no device accumulator on
+                        # this kernel set): one sample per matched player.
+                        for r in (req_q, req_c):
+                            w = (max(0.0, now - r.enqueued_at)
+                                 if r.enqueued_at else 0.0)
+                            acc.append((r.rating, qual, w, d))
                 self.pool.release(qs_l)
                 self.pool.release(cs_l)
             for req in window:
                 if req.id not in matched_ids:
                     out.queued.append(req)
+        if acc:
+            self._q_host_accum.observe(
+                rating=[a[0] for a in acc], quality=[a[1] for a in acc],
+                wait_s=[a[2] for a in acc], spread=[a[3] for a in acc])
 
     def _eff_vec(self, thr: np.ndarray, enqueued: np.ndarray, now: float) -> np.ndarray:
         """Vectorized effective_threshold over mirror columns."""
@@ -1419,6 +1567,16 @@ class TpuEngine(Engine):
                     0.0,
                 ).astype(np.float32)
                 match_ids = new_match_ids(qs.size)
+                enq_a, enq_b = pool.m_enqueued[qs], pool.m_enqueued[cs]
+                # Engine-observed wait-at-match (ISSUE 8): this chunk's
+                # DISPATCH time minus the slot's enqueue stamp — the number
+                # the waited_ms response field and the quality/fairness
+                # accounting carry (latency_ms additionally counts collect
+                # + publish queueing and is stamped later, at publish).
+                wait_a = np.where(enq_a != 0.0,
+                                  np.maximum(0.0, now - enq_a), 0.0)
+                wait_b = np.where(enq_b != 0.0,
+                                  np.maximum(0.0, now - enq_b), 0.0)
                 out.m_id_a = np.concatenate([out.m_id_a, ids_a])
                 out.m_id_b = np.concatenate([out.m_id_b, ids_b])
                 out.m_match_id = np.concatenate([out.m_match_id, match_ids])
@@ -1428,8 +1586,22 @@ class TpuEngine(Engine):
                 out.m_reply_b = np.concatenate([out.m_reply_b, pool.m_reply[cs]])
                 out.m_corr_a = np.concatenate([out.m_corr_a, pool.m_corr[qs]])
                 out.m_corr_b = np.concatenate([out.m_corr_b, pool.m_corr[cs]])
-                out.m_enq_a = np.concatenate([out.m_enq_a, pool.m_enqueued[qs]])
-                out.m_enq_b = np.concatenate([out.m_enq_b, pool.m_enqueued[cs]])
+                out.m_enq_a = np.concatenate([out.m_enq_a, enq_a])
+                out.m_enq_b = np.concatenate([out.m_enq_b, enq_b])
+                out.m_wait_a = np.concatenate([out.m_wait_a, wait_a])
+                out.m_wait_b = np.concatenate([out.m_wait_b, wait_b])
+                out.m_tier_a = np.concatenate([out.m_tier_a, pool.m_tier[qs]])
+                out.m_tier_b = np.concatenate([out.m_tier_b, pool.m_tier[cs]])
+                if self._quality is None:
+                    # Host quality fallback (sharded/no-device-accum kernel
+                    # sets): the exact vectorized equivalent of the device
+                    # scatter-add, over the same mirror columns.
+                    self._q_host_accum.observe(
+                        rating=np.concatenate([pool.m_rating[qs],
+                                               pool.m_rating[cs]]),
+                        quality=np.concatenate([quality, quality]),
+                        wait_s=np.concatenate([wait_a, wait_b]),
+                        spread=np.concatenate([d, d]))
                 matched = np.concatenate([qs, cs])
                 pool.release(matched)
                 queued_ids = cols.ids[~np.isin(slots, matched)]
@@ -1461,6 +1633,7 @@ class TpuEngine(Engine):
             hit = slots[:, 0] < P
             for m in np.nonzero(hit)[0].tolist():
                 row = slots[m].tolist()
+                # matchlint: ignore[perf] device team path — O(team) member materialization per formed match is its contract
                 members = [self.pool.request_at(s) for s in row]
                 matched_ids.update(r.id for r in members)
                 if is_role:
@@ -1477,6 +1650,12 @@ class TpuEngine(Engine):
                     Match(match_id=new_match_id(),
                           teams=(tuple(team_a), tuple(team_b)), quality=qual)
                 )
+                self._q_host_accum.observe(
+                    rating=[r.rating for r in members],
+                    quality=qual,
+                    wait_s=[(max(0.0, now - r.enqueued_at)
+                             if r.enqueued_at else 0.0) for r in members],
+                    spread=float(spread[m]))
                 self.pool.release(row)
             for req in window:
                 if req.id not in matched_ids:

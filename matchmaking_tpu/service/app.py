@@ -48,6 +48,8 @@ from matchmaking_tpu.service.overload import (
     deadline_of,
 )
 from matchmaking_tpu.service.attribution import Attribution
+from matchmaking_tpu.service.quality import QualityLedger
+from matchmaking_tpu.engine.quality import QualitySpec
 from matchmaking_tpu.utils.chaos import ChaosState
 from matchmaking_tpu.utils.metrics import Metrics
 from matchmaking_tpu.utils.timeseries import SloMonitor, TelemetryRing
@@ -64,6 +66,14 @@ def _body_with_trace_id(body: bytes, trace_id: str) -> bytes:
     import json
 
     return body[:-1] + b',"trace_id":' + json.dumps(trace_id).encode() + b"}"
+
+
+def _body_with_waited(body: bytes, waited_ms: float) -> bytes:
+    """Splice ``"waited_ms": ...`` into a native-encoded matched body —
+    same trick as ``_body_with_trace_id`` (ISSUE 8: the C encoder knows
+    nothing of the engine-observed wait; one bytes concat per matched
+    response keeps the batch-encode win)."""
+    return body[:-1] + b',"waited_ms":%.3f}' % waited_ms
 
 
 class _QueueRuntime:
@@ -1482,6 +1492,17 @@ class _QueueRuntime:
         n = out.n_matches
         if n == 0:
             return
+        # Quality ledger (ISSUE 8): one vectorized observe per window —
+        # both sides' quality/wait/tier samples, regardless of which
+        # encoder builds the bodies below.
+        have_wait = len(out.m_wait_a) == n
+        if have_wait:
+            self.app.quality.observe(
+                self.queue_cfg.name,
+                np.concatenate([out.m_quality, out.m_quality]),
+                np.concatenate([out.m_wait_a, out.m_wait_b]),
+                (np.concatenate([out.m_tier_a, out.m_tier_b])
+                 if len(out.m_tier_a) == n else None))
         bodies = None
         if codec.available():
             lat_a = np.where(out.m_enq_a != 0.0, (now - out.m_enq_a) * 1e3, 0.0)
@@ -1504,9 +1525,19 @@ class _QueueRuntime:
             ids_a, ids_b = out.m_id_a.tolist(), out.m_id_b.tolist()
             reply_a, reply_b = out.m_reply_a.tolist(), out.m_reply_b.tolist()
             corr_a, corr_b = out.m_corr_a.tolist(), out.m_corr_b.tolist()
+            wa_ms = ((out.m_wait_a * 1e3).tolist() if have_wait
+                     else [0.0] * n)
+            wb_ms = ((out.m_wait_b * 1e3).tolist() if have_wait
+                     else [0.0] * n)
+            qual_l = out.m_quality.tolist()
             traces = traces or {}
             for j in range(n):
                 body_a, body_b = bodies[2 * j], bodies[2 * j + 1]
+                if have_wait:
+                    # waited_ms rides every matched body (wire contract,
+                    # ISSUE 8) — spliced like trace_id, one concat each.
+                    body_a = _body_with_waited(body_a, wa_ms[j])
+                    body_b = _body_with_waited(body_b, wb_ms[j])
                 if trace_ids:
                     tid = trace_ids.get(ids_a[j])
                     if tid:
@@ -1514,12 +1545,19 @@ class _QueueRuntime:
                     tid = trace_ids.get(ids_b[j])
                     if tid:
                         body_b = _body_with_trace_id(body_b, tid)
+                tr_a, tr_b = traces.get(ids_a[j]), traces.get(ids_b[j])
+                if tr_a is not None:
+                    tr_a.quality = qual_l[j]
+                    tr_a.waited_s = wa_ms[j] / 1e3
+                if tr_b is not None:
+                    tr_b.quality = qual_l[j]
+                    tr_b.waited_s = wb_ms[j] / 1e3
                 self._remember(ids_a[j], body_a, now)
                 self._remember(ids_b[j], body_b, now)
                 self._publish_body(reply_a[j], corr_a[j], body_a,
-                                   trace=traces.get(ids_a[j]))
+                                   trace=tr_a)
                 self._publish_body(reply_b[j], corr_b[j], body_b,
-                                   trace=traces.get(ids_b[j]))
+                                   trace=tr_b)
             return
         trace_ids = trace_ids or {}
         traces = traces or {}
@@ -1533,27 +1571,50 @@ class _QueueRuntime:
             self._publish_matched(id_a, out.m_reply_a[j], out.m_corr_a[j],
                                   float(out.m_enq_a[j]), result, now,
                                   trace_id=trace_ids.get(id_a, ""),
-                                  trace=traces.get(id_a))
+                                  trace=traces.get(id_a),
+                                  waited_ms=(float(out.m_wait_a[j]) * 1e3
+                                             if have_wait else None),
+                                  record_quality=not have_wait)
             self._publish_matched(id_b, out.m_reply_b[j], out.m_corr_b[j],
                                   float(out.m_enq_b[j]), result, now,
                                   trace_id=trace_ids.get(id_b, ""),
-                                  trace=traces.get(id_b))
+                                  trace=traces.get(id_b),
+                                  waited_ms=(float(out.m_wait_b[j]) * 1e3
+                                             if have_wait else None),
+                                  record_quality=not have_wait)
 
     def _publish_matched(self, pid: str, reply_to: str, correlation_id: str,
                          enqueued_at: float, result, now: float,
-                         trace_id: str = "", trace=None) -> None:
+                         trace_id: str = "", trace=None,
+                         waited_ms: float | None = None, tier: int = 0,
+                         record_quality: bool = True) -> None:
         """One matched player's response + metrics + dedup memory — the
         slow-path builder (object flush; the columnar flush uses the native
-        batch encoder when available and only falls back here)."""
+        batch encoder when available and only falls back here).
+
+        ``waited_ms`` is the engine-observed wait-at-match when the caller
+        has one (columnar outcomes carry it); the object path falls back to
+        publish-time latency — it has no separate dispatch stamp here.
+        ``record_quality=False`` when the caller already fed the quality
+        ledger vectorized (the columnar publish did, for the whole window)."""
         m = self.app.metrics
         m.counters.inc("players_matched")
         if enqueued_at:
             m.record_latency("match_wait", now - enqueued_at)
             m.observe_stage(self.queue_cfg.name, "e2e", now - enqueued_at)
+        waited = (waited_ms if waited_ms is not None
+                  else ((now - enqueued_at) * 1e3 if enqueued_at else 0.0))
         body = encode_response(SearchResponse(
             status="matched", player_id=pid, match=result,
             latency_ms=(now - enqueued_at) * 1e3 if enqueued_at else 0.0,
+            waited_ms=waited,
             trace_id=trace_id))
+        if record_quality:
+            self.app.quality.observe(self.queue_cfg.name, result.quality,
+                                     waited / 1e3, tier)
+        if trace is not None:
+            trace.quality = result.quality
+            trace.waited_s = waited / 1e3
         self._remember(pid, body, now)
         self._publish_body(reply_to, correlation_id, body, trace=trace)
 
@@ -1622,7 +1683,8 @@ class _QueueRuntime:
                 self._publish_matched(req.id, req.reply_to, req.correlation_id,
                                       req.enqueued_at, result, now,
                                       trace_id=tids.get(req.id, ""),
-                                      trace=trs.get(req.id))
+                                      trace=trs.get(req.id),
+                                      tier=req.tier)
         if self.queue_cfg.send_queued_ack:
             for req in outcome.queued:
                 self._respond(req, SearchResponse(
@@ -1790,7 +1852,7 @@ class _QueueRuntime:
                 for req in match.requests():
                     self._publish_matched(
                         req.id, req.reply_to, req.correlation_id,
-                        req.enqueued_at, result, now)
+                        req.enqueued_at, result, now, tier=req.tier)
         if matched:
             self.app.metrics.counters.inc("rescan_matches", matched)
 
@@ -2091,6 +2153,13 @@ class MatchmakingApp:
             slo_target_s=obs.slo_target_ms / 1e3,
             tiers=max(1, self.cfg.overload.tiers))
         self.recorder.attribution = self.attribution
+        #: Match-quality ledger (service/quality.py, ISSUE 8): per-queue/
+        #: per-tier quality + wait-at-match histograms fed at response
+        #: publish, plus the quality-SLO good/total counters the
+        #: ``<queue>#quality`` burn monitors difference.
+        self.quality = QualityLedger(
+            QualitySpec.from_config(obs),
+            quality_target=obs.quality_slo_target)
         #: Continuous telemetry ring (utils/timeseries.py): periodic
         #: snapshots of per-queue load/SLO/idle signals with delta/rate
         #: queries; sampled by _telemetry_loop every
@@ -2157,6 +2226,23 @@ class MatchmakingApp:
                     for t in range(self.cfg.overload.tiers):
                         key = f"{name}@t{t}"
                         self._slo_monitors[key] = _monitor(key)
+        if obs.quality_slo_target > 0:
+            # Quality-SLO burn monitors (ISSUE 8): GOOD = matched with
+            # quality >= target. Same SloMonitor machinery, pointed at the
+            # ledger's quality_good/quality_total counter pair — a quality
+            # regression burns on /healthz exactly like a latency SLO.
+            for name in self._runtimes:
+                self._slo_monitors[f"{name}#quality"] = SloMonitor(
+                    f"{name}#quality",
+                    target_ms=obs.quality_slo_target,
+                    objective=obs.quality_slo_objective,
+                    fast_window_s=obs.slo_fast_window_s,
+                    slow_window_s=obs.slo_slow_window_s,
+                    burn_threshold=obs.slo_burn_threshold,
+                    events=self.events, metrics=self.metrics,
+                    good_key=f"quality_good[{name}]",
+                    total_key=f"quality_total[{name}]",
+                    kind="quality")
         if obs.snapshot_interval_s > 0:
             self._telemetry_task = asyncio.create_task(self._telemetry_loop())
         elif self._slo_monitors:
@@ -2297,6 +2383,10 @@ class MatchmakingApp:
             good, total = self.attribution.slo_counts(name)
             vals[f"slo_good[{name}]"] = float(good)
             vals[f"slo_total[{name}]"] = float(total)
+            if self.cfg.observability.quality_slo_target > 0:
+                qg, qt = self.quality.slo_counts(name)
+                vals[f"quality_good[{name}]"] = float(qg)
+                vals[f"quality_total[{name}]"] = float(qt)
             if self.cfg.overload.tiers > 1:
                 # Per-tier SLO series (slo_good[queue@tN]) — what the
                 # per-tier burn monitors difference: tier-0 attainment must

@@ -133,6 +133,13 @@ class SearchResponse:
     error_code: str = ""
     error_reason: str = ""
     latency_ms: float = 0.0
+    #: Engine-observed wait-at-match (ms): the match window's DISPATCH
+    #: time minus the request's first-received stamp — what the engine
+    #: actually made the player wait for the match they got (ISSUE 8).
+    #: ``latency_ms`` additionally counts collect + publish queueing, so
+    #: waited_ms ≤ latency_ms; clients cross-check the two (loadgen does).
+    #: Carried on ``matched`` responses only; 0.0 elsewhere.
+    waited_ms: float = 0.0
     #: Back-off hint on ``shed`` responses (overload admission control —
     #: service/overload.py): retry this queue after this many ms.
     retry_after_ms: float = 0.0
@@ -280,6 +287,8 @@ def encode_response(resp: SearchResponse) -> bytes:
             "teams": [list(t) for t in resp.match.teams],
             "quality": round(resp.match.quality, 6),
         }
+        if resp.status == "matched":
+            payload["waited_ms"] = round(resp.waited_ms, 3)
     if resp.status == "error":
         payload["error"] = {"code": resp.error_code, "reason": resp.error_reason}
     if resp.status == "shed":
@@ -310,6 +319,7 @@ def decode_response(body: bytes | str) -> SearchResponse:
         error_code=err.get("code", ""),
         error_reason=err.get("reason", ""),
         latency_ms=float(payload.get("latency_ms", 0.0)),
+        waited_ms=float(payload.get("waited_ms", 0.0)),
         retry_after_ms=float(payload.get("retry_after_ms", 0.0)),
         trace_id=str(payload.get("trace_id", "")),
         tier=(int(payload["tier"]) if "tier" in payload else None),

@@ -32,6 +32,7 @@ a CLI flag that wins when both are given):
     MM_LOADGEN_SEED         arrival/rating RNG seed      (--seed)
     MM_LOADGEN_DEADLINE_MS  per-request deadline, 0=off  (--deadline-ms)
     MM_LOADGEN_TIER_MIX     tier mix, "" = untiered      (--tier-mix)
+    MM_LOADGEN_QUALITY      "1" = quality accounting     (--quality)
     MM_LOADGEN_OUT          path for the JSON result     (--out)
 """
 
@@ -75,7 +76,9 @@ async def offered_load(app, queue: str, *, rate: float, duration: float,
                        seed: int, deadline_s: float = 0.0,
                        tier_mix: "dict[int, float] | None" = None,
                        reply_q: str = "loadgen.replies",
-                       drain_polls: int = 200) -> dict:
+                       drain_polls: int = 200,
+                       quality_stats: bool = False,
+                       rating_sigma: float | None = None) -> dict:
     """Offer a seeded Poisson load to ``app``'s broker and account for
     every response class. Reusable by the CLI below, bench.py's workers,
     and the overload soak (tests/test_overload.py) — one load driver, not
@@ -90,6 +93,13 @@ async def offered_load(app, queue: str, *, rate: float, duration: float,
     and splits the accounting per tier (statuses + matched-latency p99) —
     correlation ids carry the assignment, so the per-tier split is exact
     even for response bodies that don't echo the tier.
+
+    ``quality_stats`` (ISSUE 8) parses every MATCHED reply for the match
+    ``quality``, the engine-observed ``waited_ms``, and the wire
+    ``latency_ms`` — the client-observed/engine-observed wait cross-check:
+    ``wait_gap_ms_mean`` = mean(latency − waited), the collect+publish
+    queueing the engine did NOT charge the match for. Costs one json.loads
+    per matched reply (like tiered runs).
     """
     from matchmaking_tpu.service.broker import Properties
     from matchmaking_tpu.service.overload import stamp_deadline, stamp_tier
@@ -104,6 +114,10 @@ async def offered_load(app, queue: str, *, rate: float, duration: float,
                         "offered": 0, "latencies_ms": []}
                     for t in tier_mix}
 
+    #: quality_stats rows: (quality, waited_ms, latency_ms) per matched
+    #: reply.
+    q_rows: list[tuple[float, float, float]] = []
+
     async def on_reply(delivery) -> None:
         tally["replies"] += 1
         body = bytes(delivery.body)
@@ -113,6 +127,15 @@ async def offered_load(app, queue: str, *, rate: float, duration: float,
                 tally[name] += 1
                 status = name
                 break
+        if quality_stats and status == "matched":
+            try:
+                d = json.loads(body)
+                q_rows.append((
+                    float((d.get("match") or {}).get("quality", 0.0)),
+                    float(d.get("waited_ms", 0.0)),
+                    float(d.get("latency_ms", 0.0))))
+            except (ValueError, TypeError):
+                pass
         if not per_tier or not status:
             return
         t = tier_of_corr.get(delivery.properties.correlation_id)
@@ -144,7 +167,14 @@ async def offered_load(app, queue: str, *, rate: float, duration: float,
 
     rng = np.random.default_rng(seed)
     n_max = int(rate * duration * 2) + 16
-    ratings = np.repeat(rng.normal(1500.0, 300.0, size=n_max // 2 + 1), 2)
+    # Default (rating_sigma=None): consecutive near-equal ratings, so the
+    # measured cost is ingress/admission (see the docstring). A quality/
+    # frontier run wants the OPPOSITE — iid diverse ratings, so the rating
+    # threshold actually bites and wait/quality trade off.
+    if rating_sigma is None:
+        ratings = np.repeat(rng.normal(1500.0, 300.0, size=n_max // 2 + 1), 2)
+    else:
+        ratings = rng.normal(1500.0, rating_sigma, size=n_max)
     gaps = rng.exponential(1.0 / rate, size=n_max)
     sched = np.cumsum(gaps)
     tiers = None
@@ -199,6 +229,29 @@ async def offered_load(app, queue: str, *, rate: float, duration: float,
         "shed_requests": int(counters.get("shed_requests") - shed0),
         "expired_requests": int(counters.get("expired_requests") - expired0),
     }
+    if quality_stats:
+        if q_rows:
+            # np.array, not asarray: the blocking-call rule flags asarray
+            # in async bodies (device-sync hazard); this is host data.
+            arr = np.array(q_rows, np.float64)
+            qual, waited, lat = arr[:, 0], arr[:, 1], arr[:, 2]
+            gap = lat - waited
+            result["quality"] = {
+                "matched": len(q_rows),
+                "quality_mean": round(float(qual.mean()), 6),
+                "quality_p10": round(float(np.percentile(qual, 10)), 6),
+                "quality_p50": round(float(np.percentile(qual, 50)), 6),
+                "waited_ms_p50": round(float(np.percentile(waited, 50)), 3),
+                "waited_ms_p99": round(float(np.percentile(waited, 99)), 3),
+                "latency_ms_p99": round(float(np.percentile(lat, 99)), 3),
+                # Client-observed minus engine-observed wait: the
+                # collect/publish queueing the engine did not charge the
+                # match for — cross-checkable against attribution's
+                # publish_lag/readback categories.
+                "wait_gap_ms_mean": round(float(gap.mean()), 3),
+            }
+        else:
+            result["quality"] = {"matched": 0}
     if per_tier:
         result["tiers"] = {
             str(t): {
@@ -233,7 +286,8 @@ async def _run(args) -> dict:
         app, cfg.queues[0].name,
         rate=args.offered_rate, duration=args.seconds, seed=args.seed,
         deadline_s=args.deadline_ms / 1e3 if args.deadline_ms > 0 else 0.0,
-        tier_mix=parse_tier_mix(args.tier_mix))
+        tier_mix=parse_tier_mix(args.tier_mix),
+        quality_stats=bool(args.quality))
     result["pid"] = os.getpid()
     await app.stop()
     return result
@@ -265,6 +319,11 @@ def _parse_args(argv=None):
                    help="per-class offered load, e.g. '0:0.2,1:0.5,2:0.3' "
                         "— stamps a seeded x-tier per arrival and splits "
                         "the response accounting per tier ('' = untiered)")
+    p.add_argument("--quality", action="store_true",
+                   default=env.get("MM_LOADGEN_QUALITY", "") == "1",
+                   help="parse matched replies for match quality + the "
+                        "engine-observed waited_ms and report the "
+                        "client/engine wait cross-check (ISSUE 8)")
     p.add_argument("--out", default=env.get("MM_LOADGEN_OUT", ""),
                    help="path for the one-line JSON result")
     return p.parse_args(argv)

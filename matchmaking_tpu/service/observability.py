@@ -19,6 +19,9 @@ SURVEY.md §7 [ENV]). Surfaces:
 - ``/debug/attribution`` — critical-path attribution (service/attribution):
   per-queue wait-vs-work decomposition of settled spans, device idle
   fraction, SLO burn state, and the p99 exemplar's exact gap waterfall.
+- ``/debug/quality`` — the match-quality & fairness observatory (ISSUE 8):
+  per-queue/per-tier quality + wait-at-match histograms, per-rating-bucket
+  conditional means, disparity gaps, quality-SLO burn state.
 - ``/debug/telemetry`` — the continuous telemetry ring
   (utils/timeseries.py): periodic snapshots with ``?n=``/``?key=`` filters.
 - ``/debug/profile?secs=N`` — a jax.profiler capture of the live serving
@@ -112,6 +115,20 @@ def build_report(app) -> dict[str, Any]:
         latest = telemetry.latest()
         if latest is not None:
             report["telemetry_last"] = latest
+    # Match-quality & fairness (ISSUE 8): the service-level per-queue/
+    # per-tier ledger plus each engine's per-rating-bucket report (device
+    # accumulator snapshot / host equivalent — lock-free cached reads).
+    quality = getattr(app, "quality", None)
+    if quality is not None:
+        report["quality"] = quality.snapshot()
+    engine_quality = {}
+    for name, rt in app._runtimes.items():
+        rep = (rt.engine.quality_report()
+               if hasattr(rt.engine, "quality_report") else None)
+        if rep is not None:
+            engine_quality[name] = rep
+    if engine_quality:
+        report["quality_engine"] = engine_quality
     return report
 
 
@@ -217,6 +234,50 @@ def _flatten_prom(report: dict[str, Any]) -> str:
                      {"queue": queue}, rescan["total_s"])
             fams.add("matchmaking_rescan_windows", "counter",
                      {"queue": queue}, rescan["windows"])
+    # Match-quality & fairness families (ISSUE 8). Per-queue/per-tier
+    # quality histogram from the service ledger…
+    q_meta = report.get("quality", {})
+    n_q = int(q_meta.get("quality_buckets", 0) or 0)
+    for queue, entry in q_meta.get("queues", {}).items():
+        for tier, tq in entry.get("tiers", {}).items():
+            labels = {"queue": queue, "tier": tier}
+            counts = tq.get("quality_hist", [])
+            cum = 0
+            for k, c in enumerate(counts):
+                cum += int(c)
+                le = format((k + 1) / max(1, n_q or len(counts)), ".6g")
+                fams.add("matchmaking_match_quality", "histogram",
+                         {**labels, "le": le}, cum, suffix="_bucket")
+            fams.add("matchmaking_match_quality", "histogram",
+                     {**labels, "le": "+Inf"}, cum, suffix="_bucket")
+            fams.add("matchmaking_match_quality", "histogram", labels,
+                     tq.get("quality_sum", 0.0), suffix="_sum")
+            fams.add("matchmaking_match_quality", "histogram", labels,
+                     tq.get("count", 0), suffix="_count")
+    # …and the per-RATING-BUCKET wait-at-match histogram from the engine
+    # accumulators (the fairness axis), plus the disparity gauges.
+    for queue, rep in report.get("quality_engine", {}).items():
+        for b in rep.get("buckets", ()):
+            if not b.get("count"):
+                continue
+            labels = {"queue": queue, "bucket": b["bucket"]}
+            for le, cum in b.get("wait_le", {}).items():
+                fams.add("matchmaking_wait_at_match_seconds", "histogram",
+                         {**labels, "le": le}, cum, suffix="_bucket")
+            fams.add("matchmaking_wait_at_match_seconds", "histogram",
+                     labels, b.get("wait_sum_s", 0.0), suffix="_sum")
+            fams.add("matchmaking_wait_at_match_seconds", "histogram",
+                     labels, b["count"], suffix="_count")
+            fams.add("matchmaking_bucket_quality_mean", "gauge", labels,
+                     b.get("quality_mean") or 0.0)
+        disp = rep.get("disparity", {})
+        fams.add("matchmaking_quality_disparity", "gauge", {"queue": queue},
+                 disp.get("quality_gap", 0.0))
+        fams.add("matchmaking_wait_p90_disparity_seconds", "gauge",
+                 {"queue": queue}, disp.get("wait_p90_gap_s", 0.0))
+        if rep.get("quality_mean") is not None:
+            fams.add("matchmaking_quality_mean", "gauge", {"queue": queue},
+                     rep["quality_mean"])
     # True per-stage latency histograms (the flight recorder's output) as a
     # proper histogram family: cumulative le buckets + _sum + _count.
     for queue, stages in report.get("stage_seconds", {}).items():
@@ -286,6 +347,11 @@ class ObservabilityServer:
                          if k.startswith(name + "@t")}
             if tier_mons:
                 entry["slo_tiers"] = tier_mons
+            # Quality SLO (ISSUE 8): GOOD = matched with quality >= target
+            # — a quality regression burns here like a latency SLO.
+            q_mon = monitors.get(name + "#quality")
+            if q_mon is not None:
+                entry["slo_quality"] = q_mon.snapshot()
             queues[name] = entry
         # Burning keys include tier monitors ("queue@tN"): routing reacts
         # to the aggregate, placement/QoS tooling to the tier split.
@@ -374,6 +440,41 @@ class ObservabilityServer:
                 entry[f"p{p:g}_exemplar"] = decompose(exemplar)
         return web.json_response(body)
 
+    async def _debug_quality(self, request) -> "web.Response":
+        """Match-quality & fairness observatory (ISSUE 8): per queue —
+        the service ledger's per-tier quality/wait histograms, the
+        engine's per-rating-bucket conditional report (device accumulator
+        snapshot or host equivalent — cached, never a device sync on the
+        loop), the explicit disparity gaps, and the quality-SLO burn
+        state. ``?queue=`` filters."""
+        queue = request.query.get("queue") or None
+        ledger = self.app.quality.snapshot(queue=queue)
+        body: "dict[str, Any]" = {
+            "quality_buckets": ledger["quality_buckets"],
+            "wait_edges_s": ledger["wait_edges_s"],
+            "queues": {},
+        }
+        monitors = getattr(self.app, "_slo_monitors", {})
+        names = ([queue] if queue is not None
+                 else sorted(self.app._runtimes))
+        for name in names:
+            rt = self.app._runtimes.get(name)
+            if rt is None:
+                continue
+            entry: dict[str, Any] = {
+                "service": ledger["queues"].get(name, {}),
+            }
+            rep = (rt.engine.quality_report()
+                   if hasattr(rt.engine, "quality_report") else None)
+            if rep is not None:
+                entry["engine"] = rep
+                entry["disparity"] = rep.get("disparity")
+            mon = monitors.get(name + "#quality")
+            if mon is not None:
+                entry["slo_quality"] = mon.snapshot()
+            body["queues"][name] = entry
+        return web.json_response(body)
+
     async def _debug_telemetry(self, request) -> "web.Response":
         """The continuous telemetry ring (utils/timeseries.py): ``?n=``
         tail length, ``?key=`` comma-separated key-prefix filter
@@ -454,6 +555,7 @@ class ObservabilityServer:
         http_app.router.add_get("/metrics", self._metrics)
         http_app.router.add_get("/debug/traces", self._debug_traces)
         http_app.router.add_get("/debug/attribution", self._debug_attribution)
+        http_app.router.add_get("/debug/quality", self._debug_quality)
         http_app.router.add_get("/debug/telemetry", self._debug_telemetry)
         http_app.router.add_get("/debug/events", self._debug_events)
         http_app.router.add_get("/debug/profile", self._debug_profile)

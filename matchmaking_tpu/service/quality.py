@@ -1,0 +1,160 @@
+"""Service-level match-quality ledger: per-queue / per-tier outcome
+accounting fed at response-publish time (ISSUE 8).
+
+The engine accumulators (engine/quality.py + the device kernel) answer the
+FAIRNESS question — is quality/wait conditionally worse for some rating
+bucket — because rating lives in the pool columns. This ledger answers the
+QoS question — which queue and which priority TIER is getting what — because
+tier is a transport concept the engine never needs: the publish path already
+holds each matched player's quality, engine-observed wait, and tier
+(ColumnarOutcome ``m_quality``/``m_wait_*``/``m_tier_*``; the object path's
+Match + request), so folding them here is one vectorized histogram add per
+window, zero extra engine work.
+
+Also the quality-SLO substrate: when ``ObservabilityConfig.
+quality_slo_target`` is set, the ledger counts per-queue cumulative
+``good``/``total`` matched players (good = quality ≥ target); the telemetry
+sampler publishes them as ``quality_good[q]``/``quality_total[q]`` series
+and a per-queue ``SloMonitor`` (kind="quality", key ``<queue>#quality``)
+burns on /healthz exactly like the latency monitors.
+
+Loop-confined like Attribution: ``observe`` runs on the event loop (every
+publish path does); there is deliberately no lock here.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from matchmaking_tpu.engine.quality import QualitySpec, _hist_percentile
+
+
+class _TierQuality:
+    __slots__ = ("q_hist", "w_hist", "count", "q_sum", "w_sum")
+
+    def __init__(self, spec: QualitySpec):
+        self.q_hist = np.zeros(spec.n_quality, np.int64)
+        self.w_hist = np.zeros(spec.n_wait, np.int64)
+        self.count = 0
+        self.q_sum = 0.0
+        self.w_sum = 0.0
+
+
+class _QueueQuality:
+    __slots__ = ("tiers", "good", "total")
+
+    def __init__(self) -> None:
+        self.tiers: dict[int, _TierQuality] = {}
+        self.good = 0   # matched players with quality >= target
+        self.total = 0  # matched players
+
+
+class QualityLedger:
+    """Per-queue/per-tier quality + wait-at-match histograms over matched
+    players, plus the quality-SLO good/total counters. All counters are
+    monotone — the telemetry ring and prom scrapes delta cleanly."""
+
+    def __init__(self, spec: QualitySpec, quality_target: float = 0.0):
+        self.spec = spec
+        self.quality_target = quality_target
+        self._queues: dict[str, _QueueQuality] = {}
+
+    def _queue(self, q: str) -> _QueueQuality:
+        qq = self._queues.get(q)
+        if qq is None:
+            qq = self._queues[q] = _QueueQuality()
+        return qq
+
+    def observe(self, queue: str, quality, wait_s, tiers=None) -> None:
+        """Record matched-player samples (vectorized: one call per window).
+        ``tiers`` None → all tier 0."""
+        quality = np.atleast_1d(np.asarray(quality, np.float32))
+        n = quality.shape[0]
+        if n == 0:
+            return
+        wait_s = np.maximum(np.broadcast_to(
+            np.atleast_1d(np.asarray(wait_s, np.float64)), (n,)), 0.0)
+        tier_arr = (np.zeros(n, np.int64) if tiers is None
+                    else np.broadcast_to(
+                        np.atleast_1d(np.asarray(tiers, np.int64)), (n,)))
+        spec = self.spec
+        qb = spec.quality_bucket(quality)
+        wb = spec.wait_bucket(wait_s)
+        qq = self._queue(queue)
+        qq.total += n
+        if self.quality_target > 0:
+            qq.good += int((quality >= self.quality_target).sum())
+        for t in np.unique(tier_arr).tolist():
+            sel = tier_arr == t
+            tq = qq.tiers.get(t)
+            if tq is None:
+                tq = qq.tiers[t] = _TierQuality(spec)
+            np.add.at(tq.q_hist, qb[sel], 1)
+            np.add.at(tq.w_hist, wb[sel], 1)
+            tq.count += int(sel.sum())
+            tq.q_sum += float(quality[sel].sum())
+            tq.w_sum += float(wait_s[sel].sum())
+
+    # ---- reads -------------------------------------------------------------
+
+    def slo_counts(self, queue: str) -> tuple[int, int]:
+        """(good, total) cumulative matched-player counters — what the
+        ``<queue>#quality`` burn monitor differences."""
+        qq = self._queues.get(queue)
+        return (qq.good, qq.total) if qq is not None else (0, 0)
+
+    def queues(self) -> list[str]:
+        return sorted(self._queues)
+
+    def _tier_dict(self, tq: _TierQuality) -> dict[str, Any]:
+        spec = self.spec
+        q_edges = tuple((k + 1) / spec.n_quality
+                        for k in range(spec.n_quality))
+        return {
+            "count": tq.count,
+            # Exact monotone sums (NOT mean × count — the prom histogram
+            # _sum must be a true cumulative counter or rate() misreads
+            # rounding jitter as counter resets).
+            "quality_sum": round(tq.q_sum, 9),
+            "wait_sum_s": round(tq.w_sum, 9),
+            "quality_mean": (round(tq.q_sum / tq.count, 6)
+                             if tq.count else None),
+            "wait_mean_s": (round(tq.w_sum / tq.count, 6)
+                            if tq.count else None),
+            "quality_p10": _hist_percentile(tq.q_hist, q_edges, 10.0),
+            "quality_p50": _hist_percentile(tq.q_hist, q_edges, 50.0),
+            "wait_p99_s": _hist_percentile(tq.w_hist, spec.wait_edges, 99.0),
+            "quality_hist": tq.q_hist.tolist(),
+            "wait_hist": tq.w_hist.tolist(),
+        }
+
+    def snapshot(self, queue: str | None = None) -> dict[str, Any]:
+        """JSON-ready per-queue/per-tier view (the /debug/quality
+        ``service`` block and the prom histogram source)."""
+        names = [queue] if queue is not None else self.queues()
+        out: dict[str, Any] = {}
+        for q in names:
+            qq = self._queues.get(q)
+            if qq is None:
+                continue
+            entry: dict[str, Any] = {
+                "matched_players": qq.total,
+                "tiers": {str(t): self._tier_dict(tq)
+                          for t, tq in sorted(qq.tiers.items())},
+            }
+            if self.quality_target > 0:
+                entry["quality_slo"] = {
+                    "target": self.quality_target,
+                    "good": qq.good,
+                    "total": qq.total,
+                    "attainment": (round(qq.good / qq.total, 4)
+                                   if qq.total else None),
+                }
+            out[q] = entry
+        return {
+            "quality_buckets": self.spec.n_quality,
+            "wait_edges_s": list(self.spec.wait_edges),
+            "queues": out,
+        }

@@ -123,14 +123,24 @@ class TelemetryRing:
 
 
 class SloMonitor:
-    """Per-queue multi-window burn-rate monitor over the telemetry ring's
-    cumulative ``slo_good[q]``/``slo_total[q]`` counters."""
+    """Per-queue multi-window burn-rate monitor over a pair of cumulative
+    good/total counters in the telemetry ring. Default series are the
+    latency-SLO ``slo_good[q]``/``slo_total[q]``; ``good_key``/``total_key``
+    point a monitor at any other counter pair — the quality-SLO monitors
+    (ISSUE 8) difference ``quality_good[q]``/``quality_total[q]`` with
+    ``kind="quality"`` and are otherwise identical (same burn math, same
+    events, same /healthz surfacing)."""
 
     def __init__(self, queue: str, target_ms: float, objective: float,
                  fast_window_s: float, slow_window_s: float,
-                 burn_threshold: float = 1.0, events=None, metrics=None):
+                 burn_threshold: float = 1.0, events=None, metrics=None,
+                 good_key: str | None = None, total_key: str | None = None,
+                 kind: str = "latency"):
         self.queue = queue
         self.target_ms = target_ms
+        self.kind = kind
+        self._good_key = good_key or f"slo_good[{queue}]"
+        self._total_key = total_key or f"slo_total[{queue}]"
         # Clamp away objective=1.0: a zero error budget makes burn infinite
         # on the first miss, which is an alerting footgun, not a policy.
         self.objective = min(0.9999, max(0.0, objective))
@@ -147,8 +157,8 @@ class SloMonitor:
 
     def _attainment(self, ring: TelemetryRing, span_s: float,
                     now: float) -> float | None:
-        good = ring.delta(f"slo_good[{self.queue}]", span_s, now)
-        total = ring.delta(f"slo_total[{self.queue}]", span_s, now)
+        good = ring.delta(self._good_key, span_s, now)
+        total = ring.delta(self._total_key, span_s, now)
         if good is None or total is None or total[0] <= 0:
             return None  # no traffic settled in the window
         return max(0.0, min(1.0, good[0] / total[0]))
@@ -171,12 +181,15 @@ class SloMonitor:
             self.burning = burning
             if self._events is not None:
                 if burning:
+                    target = (f"{self.target_ms:.0f} ms"
+                              if self.kind == "latency"
+                              else f"quality {self.target_ms:g}")
                     self._events.append(
                         "slo_burn", self.queue,
                         f"burn fast={self.burn_fast:.2f} "
                         f"slow={self.burn_slow:.2f} "
                         f"(threshold {self.burn_threshold:.2f}, target "
-                        f"{self.target_ms:.0f} ms, objective "
+                        f"{target}, objective "
                         f"{self.objective:.4f})")
                 else:
                     self._events.append("slo_burn_clear", self.queue)
@@ -198,6 +211,7 @@ class SloMonitor:
     def snapshot(self) -> dict[str, Any]:
         rnd = lambda v: None if v is None else round(v, 4)  # noqa: E731
         return {
+            "kind": self.kind,
             "target_ms": self.target_ms,
             "objective": self.objective,
             "fast_window_s": self.fast_window_s,

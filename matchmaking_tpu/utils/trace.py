@@ -50,7 +50,8 @@ class TraceContext:
     list) — it is allocated on EVERY broker publish."""
 
     __slots__ = ("trace_id", "queue", "correlation_id", "player_id",
-                 "redelivered", "status", "tier", "marks")
+                 "redelivered", "status", "tier", "marks", "quality",
+                 "waited_s")
 
     def __init__(self, queue: str, correlation_id: str = "",
                  redelivered: bool = False, t: float | None = None):
@@ -63,6 +64,13 @@ class TraceContext:
         #: QoS priority tier (service/overload.py; 0 = untiered default),
         #: stamped at admission so attribution can split per tier.
         self.tier = 0
+        #: Outcome values stamped at publish for MATCHED traces (ISSUE 8):
+        #: the match's quality scalar and the engine-observed wait-at-match
+        #: (dispatch − enqueue, seconds). -1.0 = not matched / not stamped
+        #: — lets the quality reconciliation soak recompute histograms
+        #: from settled traces.
+        self.quality = -1.0
+        self.waited_s = -1.0
         self.marks: list[tuple[str, float]] = [
             ("enqueue", time.time() if t is None else t)]
 
@@ -86,6 +94,9 @@ class TraceContext:
             "redelivered": self.redelivered,
             "status": self.status,
             "tier": self.tier,
+            **({"quality": round(self.quality, 6),
+                "waited_ms": round(self.waited_s * 1e3, 3)}
+               if self.quality >= 0.0 else {}),
             "enqueue_t": t0,
             "total_ms": round(self.total_s * 1e3, 3),
             #: absolute wall-clock marks (monotone non-decreasing)

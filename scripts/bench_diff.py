@@ -1,0 +1,201 @@
+#!/usr/bin/env python
+"""Gate a fresh BENCH json against the committed trajectory.
+
+The BENCH_r*.json trajectory was append-only: a PR could halve throughput
+or quality and nothing would fail until a human read the numbers. This
+tool compares a fresh bench result against the newest committed round and
+exits nonzero on any metric regressing more than ``--threshold`` (10% by
+default):
+
+    python scripts/bench_diff.py /tmp/BENCH_fresh.json
+    python scripts/bench_diff.py fresh.json --baseline BENCH_r04.json
+    MM_BENCH_JSON=/tmp/BENCH_fresh.json scripts/check.sh   # the CI hook
+
+Input formats (both sides): a raw bench result object (the final JSON line
+``bench.py`` prints), a JSON-lines file whose last parseable object wins,
+or a driver artifact wrapping the result under ``"parsed"`` (the committed
+BENCH_r*.json shape). Metrics present on only one side are skipped — the
+gate compares what both rounds measured, so adding a new bench phase never
+fails old baselines.
+
+Compared metrics (direction-aware):
+    higher is better:  value (headline matches/s), e2e_matched_per_s,
+                       e2e_knee_req_s, e2e_slo_attainment,
+                       frontier quality_mean
+    lower is better:   p99_ms, e2e_p99_ms, frontier wait_at_match_ms_p99,
+                       frontier quality_disparity
+Frontier rows (``e2e_frontier``, ISSUE 8) are matched by threshold.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+#: metric name → True when HIGHER is better.
+TOP_LEVEL_METRICS: dict[str, bool] = {
+    "value": True,
+    "e2e_matched_per_s": True,
+    "e2e_knee_req_s": True,
+    "e2e_slo_attainment": True,
+    "p99_ms": False,
+    "e2e_p99_ms": False,
+}
+
+FRONTIER_METRICS: dict[str, bool] = {
+    "quality_mean": True,
+    "wait_at_match_ms_p99": False,
+    "quality_disparity": False,
+}
+
+
+def load_result(path: str) -> dict:
+    """One bench result dict from any of the accepted shapes."""
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        # JSON-lines: last parseable object wins.
+        doc = None
+        for line in text.splitlines():
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                doc = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+        if doc is None:
+            raise SystemExit(f"{path}: no JSON object found")
+    if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
+        doc = doc["parsed"]  # driver artifact (BENCH_r*.json)
+    if not isinstance(doc, dict):
+        raise SystemExit(f"{path}: expected a JSON object")
+    return doc
+
+
+def newest_committed_baseline(root: str) -> str | None:
+    """The newest BENCH_r*.json whose result carries a usable headline
+    ``value`` (r05 recorded a backend outage — value null — and must not
+    become the bar)."""
+    candidates = sorted(glob.glob(os.path.join(root, "BENCH_r*.json")),
+                        reverse=True)
+    for path in candidates:
+        try:
+            row = load_result(path)
+        except SystemExit:
+            continue
+        if row.get("value") is not None:
+            return path
+    return None
+
+
+def _compare_one(name: str, base, fresh, higher_better: bool,
+                 threshold: float) -> dict | None:
+    """None when not comparable; a row dict otherwise (``regressed`` set
+    when the fresh value is worse by more than ``threshold``)."""
+    if not isinstance(base, (int, float)) or not isinstance(
+            fresh, (int, float)):
+        return None
+    if isinstance(base, bool) or isinstance(fresh, bool):
+        return None
+    if base == 0:
+        # Ratio undefined. For lower-is-better metrics (disparity, p99 of
+        # an empty round) a zero baseline is the BEST possible bar — any
+        # absolute worsening beyond the threshold regresses (disparity is
+        # bounded in [0,1], so the absolute scale is meaningful); a
+        # zero-baseline higher-is-better metric can only improve.
+        worse_abs = 0.0 if higher_better else fresh
+        return {
+            "metric": name,
+            "baseline": base,
+            "fresh": fresh,
+            "change": round(float(fresh - base), 4),
+            "regressed": worse_abs > threshold,
+        }
+    change = (fresh - base) / abs(base)
+    worse = -change if higher_better else change
+    return {
+        "metric": name,
+        "baseline": base,
+        "fresh": fresh,
+        "change": round(change, 4),
+        "regressed": worse > threshold,
+    }
+
+
+def diff(baseline: dict, fresh: dict,
+         threshold: float = 0.10) -> list[dict]:
+    """All comparable metric rows between two bench results."""
+    rows: list[dict] = []
+    for name, higher in TOP_LEVEL_METRICS.items():
+        row = _compare_one(name, baseline.get(name), fresh.get(name),
+                           higher, threshold)
+        if row is not None:
+            rows.append(row)
+    # Frontier rows matched by threshold value (ISSUE 8).
+    base_frontier = {r.get("threshold"): r
+                     for r in baseline.get("e2e_frontier", [])
+                     if isinstance(r, dict)}
+    for fr in fresh.get("e2e_frontier", []):
+        if not isinstance(fr, dict):
+            continue
+        br = base_frontier.get(fr.get("threshold"))
+        if br is None:
+            continue
+        for name, higher in FRONTIER_METRICS.items():
+            row = _compare_one(
+                f"e2e_frontier[thr={fr.get('threshold'):g}].{name}",
+                br.get(name), fr.get(name), higher, threshold)
+            if row is not None:
+                rows.append(row)
+    return rows
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("fresh", help="fresh BENCH json (bench.py output)")
+    ap.add_argument("--baseline", default="",
+                    help="committed baseline (default: newest BENCH_r*.json "
+                         "with a usable headline value)")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="relative regression tolerance (0.10 = 10%%)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report")
+    args = ap.parse_args(argv)
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    baseline_path = args.baseline or newest_committed_baseline(root)
+    if baseline_path is None:
+        print("bench_diff: no committed baseline found — nothing to gate")
+        return 0
+    baseline = load_result(baseline_path)
+    fresh = load_result(args.fresh)
+    rows = diff(baseline, fresh, threshold=args.threshold)
+    regressions = [r for r in rows if r["regressed"]]
+    if args.json:
+        print(json.dumps({"baseline": baseline_path, "rows": rows,
+                          "regressions": len(regressions)}, indent=1))
+    else:
+        print(f"baseline: {baseline_path}")
+        for r in rows:
+            flag = "REGRESSED" if r["regressed"] else "ok"
+            print(f"  {r['metric']:<44} {r['baseline']:>12} -> "
+                  f"{r['fresh']:>12}  ({r['change']:+.1%})  {flag}")
+        if not rows:
+            print("  (no comparable metrics — baselines predate this "
+                  "bench's phases)")
+    if regressions:
+        print(f"bench_diff: {len(regressions)} regression(s) beyond "
+              f"{args.threshold:.0%}", file=sys.stderr)
+        return 1
+    print("bench_diff: within tolerance")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -32,6 +32,25 @@ echo "== qos =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'qos and not slow' \
     --continue-on-collection-errors -p no:cacheprovider
 
+echo "== quality =="
+# Match-quality & fairness suite (ISSUE 8): device-vs-host accumulator
+# reconciliation / disparity detection / quality-SLO burn / waited_ms
+# wire contract regressions fail fast and by name.
+JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'quality and not slow' \
+    --continue-on-collection-errors -p no:cacheprovider
+
+echo "== bench diff =="
+# Trajectory gate (ISSUE 8 satellite): when a fresh BENCH json is supplied
+# (MM_BENCH_JSON=/path scripts/check.sh), compare it against the newest
+# committed BENCH_r*.json and fail on >10% regression in throughput / p99
+# / quality / disparity. Skipped when no fresh run is on hand — check.sh
+# must stay a seconds-scale gate, not a bench run.
+if [ -n "${MM_BENCH_JSON:-}" ]; then
+    python scripts/bench_diff.py "$MM_BENCH_JSON"
+else
+    echo "(skipped: set MM_BENCH_JSON=<fresh BENCH json> to gate)"
+fi
+
 echo "== tier-1 =="
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider

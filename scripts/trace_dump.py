@@ -20,6 +20,11 @@ request slow" workflow:
     # per-queue attribution summary (/debug/attribution)
     python scripts/trace_dump.py --attribution
 
+    # match-quality & fairness summary (/debug/quality, ISSUE 8) — live,
+    # or offline from a BENCH json's e2e_frontier rows
+    python scripts/trace_dump.py --quality
+    python scripts/trace_dump.py --quality --bench-json BENCH_r06.json
+
 Stdlib (urllib) transport — usable inside the service container; the
 ``--gaps`` classifier imports matchmaking_tpu.service.attribution, which is
 on the path wherever the service runs.
@@ -139,6 +144,74 @@ def render_attribution(body: dict, out=sys.stdout) -> None:
         print("", file=out)
 
 
+def render_quality(body: dict, out=sys.stdout) -> None:
+    """Per-queue quality/wait/disparity summary (/debug/quality shape)."""
+    for queue, entry in sorted(body.get("queues", {}).items()):
+        eng = entry.get("engine") or {}
+        svc = entry.get("service") or {}
+        print(f"== {queue}: {eng.get('samples', 0)} matched-player "
+              f"samples", file=out)
+        if eng.get("samples"):
+            print(f"   quality: mean {eng.get('quality_mean')}  "
+                  f"p10 {eng.get('quality_p10')}  "
+                  f"p50 {eng.get('quality_p50')}  "
+                  f"spread mean {eng.get('spread_mean')}", file=out)
+            print(f"   wait-at-match: p50 {eng.get('wait_p50_s')}s  "
+                  f"p90 {eng.get('wait_p90_s')}s  "
+                  f"p99 {eng.get('wait_p99_s')}s", file=out)
+            for b in eng.get("buckets", ()):
+                if not b.get("count"):
+                    continue
+                print(f"     [{b['bucket']:>10}] n={b['count']:<7} "
+                      f"quality {b.get('quality_mean')}  "
+                      f"wait p90 {b.get('wait_p90_s')}s", file=out)
+        disp = entry.get("disparity") or {}
+        if disp:
+            print(f"   disparity: quality gap {disp.get('quality_gap')} "
+                  f"({disp.get('quality_gap_bucket') or '-'}), "
+                  f"wait p90 gap {disp.get('wait_p90_gap_s')}s "
+                  f"({disp.get('wait_gap_bucket') or '-'})", file=out)
+        for tier, tq in (svc.get("tiers") or {}).items():
+            print(f"   tier {tier}: n={tq.get('count')} "
+                  f"quality mean {tq.get('quality_mean')} "
+                  f"p10 {tq.get('quality_p10')}  "
+                  f"wait p99 {tq.get('wait_p99_s')}s", file=out)
+        slo = entry.get("slo_quality")
+        if slo:
+            print(f"   quality slo: target {slo.get('target_ms')}  "
+                  f"attainment fast={slo.get('attainment_fast')} "
+                  f"slow={slo.get('attainment_slow')}"
+                  f"{'  BURNING' if slo.get('burning') else ''}", file=out)
+        print("", file=out)
+
+
+def render_frontier(doc: dict, out=sys.stdout) -> None:
+    """The quality-vs-latency frontier from a BENCH json (e2e_frontier
+    rows, ISSUE 8)."""
+    if isinstance(doc.get("parsed"), dict):
+        doc = doc["parsed"]
+    rows = doc.get("e2e_frontier", [])
+    if not rows:
+        print("no e2e_frontier rows in this BENCH json "
+              "(run bench.py --e2e-quality)", file=out)
+        return
+    print("quality-vs-latency frontier (stricter threshold -> closer "
+          "matches, longer waits):", file=out)
+    print(f"  {'thr':>6} {'matched':>8} {'q_mean':>8} {'q_p10':>8} "
+          f"{'spread':>8} {'waitp50ms':>10} {'waitp99ms':>10} "
+          f"{'disparity':>10}", file=out)
+    for r in sorted(rows, key=lambda r: r.get("threshold", 0.0)):
+        print(f"  {r.get('threshold', 0):>6g} {r.get('matched', 0):>8} "
+              f"{r.get('quality_mean')!s:>8} {r.get('quality_p10')!s:>8} "
+              f"{r.get('spread_mean')!s:>8} "
+              f"{r.get('wait_at_match_ms_p50')!s:>10} "
+              f"{r.get('wait_at_match_ms_p99')!s:>10} "
+              f"{r.get('quality_disparity')!s:>10}", file=out)
+    for key in ("e2e_frontier_spread_monotone", "e2e_frontier_wait_monotone"):
+        if key in doc:
+            print(f"  {key}: {doc[key]}", file=out)
+
+
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--host", default="127.0.0.1")
@@ -156,10 +229,33 @@ def main(argv=None) -> None:
     ap.add_argument("--attribution", action="store_true",
                     help="per-queue attribution summary "
                          "(/debug/attribution)")
+    ap.add_argument("--quality", action="store_true",
+                    help="match-quality & fairness summary "
+                         "(/debug/quality; with --bench-json, the "
+                         "e2e_frontier rows of a BENCH artifact)")
+    ap.add_argument("--bench-json", default="",
+                    help="read a BENCH json instead of a live service "
+                         "(with --quality)")
     ap.add_argument("--json", action="store_true",
                     help="raw JSON instead of the waterfall rendering")
     args = ap.parse_args(argv)
     base = f"http://{args.host}:{args.port}"
+
+    if args.quality:
+        if args.bench_json:
+            with open(args.bench_json, encoding="utf-8") as f:
+                doc = json.load(f)
+            if args.json:
+                print(json.dumps(doc.get("e2e_frontier", []), indent=2))
+            else:
+                render_frontier(doc)
+            return
+        body = _get(base, "/debug/quality", {"queue": args.queue})
+        if args.json:
+            print(json.dumps(body, indent=2))
+        else:
+            render_quality(body)
+        return
 
     if args.attribution:
         body = _get(base, "/debug/attribution", {"queue": args.queue})

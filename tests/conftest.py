@@ -56,6 +56,11 @@ def pytest_configure(config):
         "markers", "overload: overload-control suite (admission/shed/"
         "deadline/drain — scripts/check.sh runs it by marker; the fast "
         "ones are tier-1, soaks additionally carry `slow`)")
+    config.addinivalue_line(
+        "markers", "quality: match-quality & fairness suite (device-vs-"
+        "host accumulator reconciliation / disparity / quality SLO / "
+        "waited_ms wire contract — scripts/check.sh runs it by marker; "
+        "the fast ones are tier-1, soaks additionally carry `slow`)")
 
 
 @pytest.fixture

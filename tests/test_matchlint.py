@@ -436,3 +436,75 @@ def edf_key(item, deadline_of):
             deadline if deadline is not None else float("inf"))
 ''', path="matchmaking_tpu/service/fixture.py")
     assert clean == []
+
+
+# ---- perf (ISSUE 8: O(pool)/O(matches) scans on the hot path) --------------
+
+def test_perf_flags_pool_scan_in_hot_path_function():
+    """A for-loop over a pool mirror column inside a hot-path-named
+    function is the O(pool) wall the columnar path exists to avoid."""
+    findings = analyze_source('''
+class Engine:
+    def _flush_window(self, now):
+        total = 0.0
+        for r in self.pool.m_rating:
+            total += r
+        return total
+''', path="matchmaking_tpu/engine/fixture.py")
+    assert _rules(findings) == ["perf"]
+    assert "m_rating" in findings[0].message
+
+
+def test_perf_flags_waiting_scan_and_full_column_asarray():
+    findings = analyze_source('''
+import numpy as np
+
+class Engine:
+    def _dispatch_cols(self, cols, now):
+        ages = [now - r.enqueued_at for r in self.engine.waiting()]
+        col = np.asarray(self.pool.m_enqueued)
+        return ages, col
+''', path="matchmaking_tpu/engine/fixture.py")
+    assert sorted(_rules(findings)) == ["perf", "perf"]
+
+
+def test_perf_flags_request_at_inside_loop():
+    findings = analyze_source('''
+class Engine:
+    def _finalize_window(self, slots):
+        return [self.pool.request_at(s) for s in slots]
+''', path="matchmaking_tpu/engine/fixture.py")
+    assert _rules(findings) == ["perf"]
+    assert "request_at" in findings[0].message
+
+
+def test_perf_accepts_vectorized_hot_path_and_cold_scans():
+    """Indexed column reads (col[slots]) are the sanctioned vectorized
+    form; window-sized loops are fine; and the same scan OUTSIDE a
+    hot-path-named function (sweepers, eviction policy) is out of scope."""
+    clean = analyze_source('''
+import numpy as np
+
+class Engine:
+    def _finalize_columnar(self, qs, now):
+        eff = np.maximum(0.0, now - self.pool.m_enqueued[qs])
+        ids = self.pool.m_id[qs]
+        return eff, ids
+
+    def _flush_inner(self, window):
+        return [req for req, _d in window]
+
+    def _evict_policy(self):
+        return sorted(self.engine.waiting(), key=lambda r: r.enqueued_at)
+''', path="matchmaking_tpu/engine/fixture.py")
+    assert clean == []
+
+
+def test_perf_inline_ignore_with_reason_suppresses():
+    body = '''
+class Engine:
+    def _finalize_window(self, slots):
+        return [self.pool.request_at(s) for s in slots]  # matchlint: ignore[perf] object path by contract
+'''
+    assert analyze_source(
+        body, path="matchmaking_tpu/engine/fixture.py") == []
