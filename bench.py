@@ -698,6 +698,16 @@ def bench_e2e(args) -> dict:
         out["e2e_slo_target_ms"] = float(args.e2e_slo_ms)
         out["e2e_slo_attainment"] = attr.get("slo_attainment")
         out["e2e_wait_fraction"] = attr.get("wait_fraction")
+        # Per-category attribution shares (ISSUE 9): where the e2e span
+        # went — publish_lag/encode/middleware/ingress vs device work —
+        # recorded into the BENCH json so the hot-path trajectory
+        # ("publish_lag + middleware/ingress share reduced") is diffable
+        # round over round, not just the headline rate.
+        out["e2e_attribution"] = {
+            name: {"kind": cat.get("kind"), "share": cat.get("share"),
+                   "p99_ms": cat.get("p99_ms")}
+            for name, cat in (attr.get("categories") or {}).items()
+        }
         if hasattr(rt.engine, "util_report"):
             u = rt.engine.util_report()
             out["e2e_idle_fraction"] = u["idle_fraction"]

@@ -25,11 +25,20 @@ deliberately out of scope). Inside those:
   vectorized read and is not flagged;
 - ``<pool column>.tolist()`` — same full-column materialization;
 - a ``request_at(...)`` call inside any loop — per-element object
-  materialization, O(elements)·(10-20 µs each).
+  materialization, O(elements)·(10-20 µs each);
+- **per-delivery wire work inside the window loops** (ISSUE 9 — the
+  window-granular hot path must STAY window-granular): a
+  ``headers[...]`` subscript or ``headers.get(...)`` call inside a loop
+  (parse once at admission, cache on the Delivery — ``tier`` /
+  ``deadline`` / ``first_received``), and an ``encode_response(...)``
+  call inside a loop (bodies come from the native batch encoder; the
+  Python encoder is the per-ROW fallback, sanctioned by an inline
+  ignore). Hot scope additionally covers ``handle``-named functions
+  (``_handle_columnar_out`` is the egress hot loop).
 
 Sanctioned object-path sites (team finalize, object 1v1 finalize — whole
-code paths whose contract IS per-object work) carry
-``# matchlint: ignore[perf] <reason>``.
+code paths whose contract IS per-object work; NEEDS_PYTHON fallback rows)
+carry ``# matchlint: ignore[perf] <reason>``.
 """
 
 from __future__ import annotations
@@ -49,7 +58,8 @@ RULE = "perf"
 
 #: Function-name predicate for the hot path.
 _HOT_NAME = re.compile(
-    r"(flush|dispatch|collect|settle|finalize|submit|accum)|^_?search_columns")
+    r"(flush|dispatch|collect|settle|finalize|submit|accum|handle)"
+    r"|^_?search_columns")
 
 #: Attribute names that ARE the pool surface.
 _POOL_COL = re.compile(r"^m_[a-z_]+$")
@@ -139,9 +149,43 @@ class _HotScanner(ast.NodeVisitor):
 
     # ---- full-column materialization + per-element object builds -----------
 
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        if (self._hot_depth > 0 and self._loop_depth > 0
+                and isinstance(node.value, ast.Attribute)
+                and node.value.attr == "headers"):
+            self.findings.append(Finding(
+                RULE, self.sf.path, node.lineno,
+                "per-delivery header parse: headers[...] inside a loop in "
+                "a hot-path function — parse once at admission and cache "
+                "on the Delivery (tier/deadline/first_received)",
+                qualname_of(self._stack)))
+        self.generic_visit(node)
+
     def visit_Call(self, node: ast.Call) -> None:
         if self._hot_depth > 0:
             name = dotted_name(node.func)
+            if (self._loop_depth > 0
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "get"
+                    and isinstance(node.func.value, ast.Attribute)
+                    and node.func.value.attr == "headers"):
+                self.findings.append(Finding(
+                    RULE, self.sf.path, node.lineno,
+                    "per-delivery header parse: headers.get(...) inside a "
+                    "loop in a hot-path function — parse once at admission "
+                    "and cache on the Delivery",
+                    qualname_of(self._stack)))
+            if (self._loop_depth > 0
+                    and (name == "encode_response"
+                         or name.endswith(".encode_response"))):
+                self.findings.append(Finding(
+                    RULE, self.sf.path, node.lineno,
+                    "per-element response encode: encode_response() inside "
+                    "a loop in a hot-path function — use the native batch "
+                    "encoder (codec.encode_matched_batch / "
+                    "encode_simple_batch); the Python encoder is the "
+                    "per-ROW fallback only (ignore[perf] with a reason)",
+                    qualname_of(self._stack)))
             if (name.endswith((".asarray", ".array"))
                     and node.args
                     and isinstance(node.args[0], ast.Attribute)

@@ -213,6 +213,14 @@ class BrokerConfig:
     prefetch: int = 2048
     #: Redelivery attempts for nacked/dropped deliveries (at-least-once).
     max_redelivery: int = 3
+    #: Window-granular egress (ISSUE 9): the service publishes a whole
+    #: window's responses through one ``publish_batch`` broker call instead
+    #: of one ``publish`` per response — publish_lag collapses from
+    #: O(matches) callbacks to O(windows). Per-message semantics (trace
+    #: stamping, chaos seq accounting, dup faults) are preserved: items
+    #: needing them take the full publish() path inside the batch. False =
+    #: the per-response path, byte for byte.
+    batch_publish: bool = True
     # Fault-injection hooks (SURVEY.md §5 "Failure detection").
     drop_prob: float = 0.0
     dup_prob: float = 0.0
@@ -387,6 +395,16 @@ class OverloadConfig:
     #: into this directory (utils/checkpoint.py); a restarted app restores
     #: it — zero waiting players lost. "" = drain without checkpointing.
     drain_checkpoint_dir: str = ""
+    #: Window-granular admission (ISSUE 9): run the credit/occupancy ladder
+    #: ONCE per cut window inside the flush (arrival-order pass over the
+    #: window's cached tier/deadline columns) instead of per delivery at
+    #: ingress. The per-delivery ingress keeps only the pre-checks that
+    #: cannot wait for a cut (already-expired-at-receive, drain-mode shed,
+    #: tier/deadline header caching for the EDF cut key). Ladder semantics
+    #: are identical over the same count sequence — batching never reorders
+    #: decisions within the stream. False = the per-delivery PR 5/7 path,
+    #: byte for byte.
+    batch_admission: bool = True
 
     def enabled(self) -> bool:
         """Any admission/deadline/drain machinery configured? The ingress

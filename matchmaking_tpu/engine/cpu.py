@@ -157,6 +157,18 @@ class CpuEngine(Engine):
                     for m in members],
             spread=spread)
 
+    def quality_checkpoint(self) -> dict:
+        """Copy of the accumulator arrays for a revive/breaker handoff —
+        a DEGRADED period's matches must survive re-promotion to the
+        device engine (ISSUE 9 satellite)."""
+        return {k: v.copy() for k, v in self.quality_accum.arrays.items()}
+
+    def quality_restore(self, arrays: "dict | None") -> None:
+        from matchmaking_tpu.engine.quality import add_arrays
+
+        if arrays is not None:
+            add_arrays(self.quality_accum.arrays, arrays)
+
     def pool_tier_counts(self, n_tiers: int) -> list[int]:
         out = [0] * max(1, n_tiers)
         for t, n in self._tier_n.items():

@@ -210,6 +210,17 @@ class Engine(abc.ABC):
         lock, like ``util_report``)."""
         return None
 
+    def quality_checkpoint(self) -> "dict | None":
+        """Quality-accumulator arrays to hand a successor engine across a
+        crash revive / breaker swap (ISSUE 9 satellite: /debug/quality
+        counters are monotone across engine rebuilds, not reset). None
+        when the engine tracks no quality."""
+        return None
+
+    def quality_restore(self, arrays: "dict | None") -> None:
+        """Fold a predecessor engine's ``quality_checkpoint`` into this
+        engine's accounting. Default: nothing tracked, nothing restored."""
+
     def deadline_count(self) -> int:
         """Waiting players carrying a stamped ``x-deadline`` — the O(1)
         gate the sweep loop checks per tick: deadline-less traffic must

@@ -709,8 +709,17 @@ class TpuEngine(Engine):
             return None
         slots_all = pool.waiting_slots()
         if slots_all.size > max_window:
+            # Tier-aware selection (ISSUE 9 satellite, PR 7 follow-up):
+            # when the tick can't cover the whole pool, rescan the
+            # lowest-(tier, deadline) slots first — the EDF cut key over
+            # the QoS mirror columns, oldest-first within ties — so a
+            # near-deadline tier-0 waiter widens before a fresh tier-2
+            # one. Untiered deadline-less pools (all zeros → all +inf)
+            # reduce to the old oldest-first order exactly.
             enq = pool.m_enqueued[slots_all]
-            order = np.argsort(enq, kind="stable")[:max_window]
+            dl = pool.m_deadline[slots_all]
+            order = np.lexsort((enq, np.where(dl > 0.0, dl, np.inf),
+                                pool.m_tier[slots_all]))[:max_window]
             chosen = np.sort(slots_all[order]).astype(np.int32)
         else:
             chosen = np.sort(slots_all).astype(np.int32)
@@ -954,6 +963,34 @@ class TpuEngine(Engine):
         if d is not None and hasattr(d, "quality_accum"):
             add_arrays(arrays, d.quality_accum.arrays)
         return build_report(arrays, self._q_spec)
+
+    def quality_checkpoint(self) -> "dict[str, np.ndarray] | None":
+        """Merged quality-accumulator arrays for a revive/breaker handoff
+        (ISSUE 9 satellite): the LAST materialized device snapshot + the
+        host fallback accumulator + a live delegate's. Tries a blocking
+        device readback first so the handoff is exact; a wedged device —
+        the very thing the revive is for — falls back to the last async
+        snapshot (at most ``quality_report_every`` windows stale), so
+        /debug/quality counters stay monotone across the swap rather than
+        resetting to zero."""
+        try:
+            self._quality_force_sync()
+        except Exception:
+            logger.warning("quality checkpoint: device readback failed; "
+                           "using the last async snapshot")
+        arrays = empty_arrays(self._q_spec)
+        add_arrays(arrays, self._q_host_accum.arrays)
+        add_arrays(arrays, self._q_host)
+        d = self._team_delegate
+        if d is not None and hasattr(d, "quality_accum"):
+            add_arrays(arrays, d.quality_accum.arrays)
+        return arrays
+
+    def quality_restore(self, arrays: "dict[str, np.ndarray] | None") -> None:
+        """Fold a predecessor engine's quality checkpoint into this
+        engine's host accumulator (merged into every quality_report)."""
+        if arrays is not None:
+            add_arrays(self._q_host_accum.arrays, arrays)
 
     def inflight(self) -> int:
         """Windows dispatched but not yet finalized (caller-thread view)."""

@@ -1,10 +1,18 @@
-"""ctypes binding for the native batch wire decoder (native/codec.cc).
+"""ctypes binding for the native batch wire codec (native/codec.cc).
 
-One C call decodes a window of raw AMQP JSON bodies into RequestColumns
-arrays (the engine's columnar fast path); rows flagged NEEDS_PYTHON (parties,
-roles, string escapes) or invalid fall back to ``contract.decode_request`` —
-the semantic source of truth whose validation the C++ mirrors (equivalence
-pinned by tests/test_native_codec.py).
+Ingress: one C call decodes a window of raw AMQP JSON bodies into
+RequestColumns arrays (the engine's columnar fast path); rows flagged
+NEEDS_PYTHON (parties, roles, string escapes) or invalid fall back to
+``contract.decode_request`` — the semantic source of truth whose validation
+the C++ mirrors (equivalence pinned by tests/test_native_codec.py).
+
+Egress: one C call encodes a window of response bodies — matched pairs
+(``encode_matched_batch``) and queued/timeout/shed rows
+(``encode_simple_batch``) — BYTE-IDENTICAL to ``contract.encode_response``
+(pinned by the seeded fuzz corpus in tests/test_codec_fuzz.py). Rows the
+exact contract cannot express natively (non-ASCII ids, non-finite floats,
+embedded NULs) come back as ``None`` and the caller re-encodes just those
+through the Python contract module.
 
 The library builds lazily with g++ (no deps; ~1 s once, cached next to the
 source). Everything degrades to pure Python when g++ or the build is
@@ -38,9 +46,25 @@ _ERROR_CODES = {
     6: "bad_threshold",
 }
 
+#: Row kinds for the simple-response encoder (keep in sync with codec.cc).
+KIND_QUEUED = 0
+KIND_TIMEOUT = 1
+KIND_SHED = 2
+
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
 _build_failed = False
+
+
+def _build_locked() -> None:
+    """Compile libmmcodec.so from source when stale or missing (caller
+    holds ``_lock``). CI rebuilds through here (scripts/check.sh codec
+    section) so nothing ever depends on a checked-in binary."""
+    if (not os.path.exists(_LIB)
+            or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-o", _LIB, _SRC],
+            check=True, capture_output=True, timeout=120)
 
 
 def _load() -> ctypes.CDLL | None:
@@ -52,11 +76,7 @@ def _load() -> ctypes.CDLL | None:
         if _lib is not None or _build_failed:
             return _lib
         try:
-            if (not os.path.exists(_LIB)
-                    or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
-                subprocess.run(
-                    ["g++", "-O2", "-shared", "-fPIC", "-o", _LIB, _SRC],
-                    check=True, capture_output=True, timeout=120)
+            _build_locked()
             lib = ctypes.CDLL(_LIB)
             lib.mm_decode_requests.restype = ctypes.c_int64
             lib.mm_decode_requests.argtypes = [
@@ -82,18 +102,51 @@ def _load() -> ctypes.CDLL | None:
                 np.ctypeslib.ndpointer(np.float64),       # lat_a
                 np.ctypeslib.ndpointer(np.float64),       # lat_b
                 np.ctypeslib.ndpointer(np.float64),       # quality
+                np.ctypeslib.ndpointer(np.float64),       # waited_a
+                np.ctypeslib.ndpointer(np.float64),       # waited_b
+                ctypes.POINTER(ctypes.c_char_p),          # trace_a (or None)
+                ctypes.POINTER(ctypes.c_char_p),          # trace_b (or None)
                 ctypes.c_char_p,                          # arena
                 ctypes.c_int64,                           # cap
                 np.ctypeslib.ndpointer(np.int64),         # off
+                np.ctypeslib.ndpointer(np.int32),         # status
+            ]
+            lib.mm_encode_simple.restype = ctypes.c_int64
+            lib.mm_encode_simple.argtypes = [
+                np.ctypeslib.ndpointer(np.int32),         # kind
+                ctypes.POINTER(ctypes.c_char_p),          # player_id
+                np.ctypeslib.ndpointer(np.float64),       # lat_ms
+                np.ctypeslib.ndpointer(np.float64),       # retry_ms
+                ctypes.POINTER(ctypes.c_char_p),          # trace_id (or None)
+                np.ctypeslib.ndpointer(np.int32),         # tier
+                ctypes.c_int32,                           # n
+                ctypes.c_char_p,                          # arena
+                ctypes.c_int64,                           # cap
+                np.ctypeslib.ndpointer(np.int64),         # off
+                np.ctypeslib.ndpointer(np.int32),         # status
             ]
             _lib = lib
         except Exception:
-            log.exception("native codec unavailable; using pure-Python decode")
+            log.exception("native codec unavailable; using pure-Python codec")
             _build_failed = True
     return _lib
 
 
 def available() -> bool:
+    return _load() is not None
+
+
+def rebuild(force: bool = False) -> bool:
+    """Rebuild libmmcodec.so from codec.cc (the CI seam: check.sh calls
+    this so the parity fuzz gate never runs against a stale checked-in
+    binary). ``force`` unlinks first. Returns availability afterwards."""
+    global _lib, _build_failed
+    with _lock:
+        if force and os.path.exists(_LIB):
+            if _lib is not None:
+                return True  # already loaded in this process: can't unlink
+            os.unlink(_LIB)
+        _build_failed = False
     return _load() is not None
 
 
@@ -143,15 +196,37 @@ def error_code(status: int) -> str:
     return _ERROR_CODES.get(int(status), "bad_json")
 
 
-def encode_matched_batch(ids_a, ids_b, match_ids, lat_a_ms, lat_b_ms,
-                         quality):
-    """Encode 2n matched-response bodies natively (a0, b0, a1, b1, ...).
+def _cstr_array(strings, n: int):
+    """str sequence → (c_char_p array, needs_python_rows): rows with an
+    embedded NUL would be silently truncated by c_char_p (corrupting the
+    body AND its dedup-replay copy) — they take the Python encoder."""
+    out = (ctypes.c_char_p * n)()
+    bad: list[int] = []
+    for i, s in enumerate(strings):
+        b = s.encode()
+        if b"\x00" in b:
+            bad.append(i)
+            b = b""
+        out[i] = b
+    return out, bad
 
-    Inputs are sequences of str (ids) and float64 arrays (latencies in ms,
-    match quality). Returns a list of 2n ``bytes`` bodies matching
-    ``contract.encode_response``'s schema (parsed-value equivalence pinned
-    by tests/test_native_codec.py), or None when the native library is
-    unavailable — callers fall back to the Python encoder.
+
+def _slice_bodies(raw: bytes, off: np.ndarray, status: np.ndarray,
+                  n: int) -> list[bytes | None]:
+    return [raw[off[j]:off[j + 1]] if status[j] == OK else None
+            for j in range(n)]
+
+
+def encode_matched_batch(ids_a, ids_b, match_ids, lat_a_ms, lat_b_ms,
+                         quality, waited_a_ms, waited_b_ms,
+                         trace_a=None, trace_b=None):
+    """Encode 2n matched-response bodies natively (a0, b0, a1, b1, ...),
+    byte-identical to ``contract.encode_response`` including the
+    ``waited_ms`` field and the optional per-side ``trace_id``.
+
+    Returns a list of 2n entries, each ``bytes`` or ``None`` (NEEDS_PYTHON:
+    non-ASCII id / non-finite float / embedded NUL — re-encode that row via
+    the Python contract), or None when the native library is unavailable.
     """
     lib = _load()
     if lib is None:
@@ -162,31 +237,89 @@ def encode_matched_batch(ids_a, ids_b, match_ids, lat_a_ms, lat_b_ms,
     lat_a_ms = np.ascontiguousarray(lat_a_ms, np.float64)
     lat_b_ms = np.ascontiguousarray(lat_b_ms, np.float64)
     quality = np.ascontiguousarray(quality, np.float64)
-    if not (np.isfinite(lat_a_ms).all() and np.isfinite(lat_b_ms).all()
-            and np.isfinite(quality).all()):
-        return None  # NaN/inf are not strict JSON; Python encoder handles
-    a_bytes = [s.encode() for s in ids_a]
-    b_bytes = [s.encode() for s in ids_b]
-    m_bytes = [s.encode() for s in match_ids]
-    if any(b"\x00" in s for s in a_bytes) or any(b"\x00" in s for s in b_bytes):
-        # c_char_p is NUL-terminated: an embedded NUL in an id would be
-        # silently truncated, corrupting the body AND its dedup-replay
-        # copy. Pathological ids take the Python encoder.
-        return None
-    a_ptrs = (ctypes.c_char_p * n)(*a_bytes)
-    b_ptrs = (ctypes.c_char_p * n)(*b_bytes)
-    m_ptrs = (ctypes.c_char_p * n)(*m_bytes)
-    lat_a, lat_b, qual = lat_a_ms, lat_b_ms, quality
+    waited_a_ms = np.ascontiguousarray(waited_a_ms, np.float64)
+    waited_b_ms = np.ascontiguousarray(waited_b_ms, np.float64)
+    a_ptrs, bad_a = _cstr_array(ids_a, n)
+    b_ptrs, bad_b = _cstr_array(ids_b, n)
+    m_ptrs, bad_m = _cstr_array(match_ids, n)
+    tr_a = tr_b = None
+    bad_ta: list[int] = []
+    bad_tb: list[int] = []
+    if trace_a is not None:
+        tr_a, bad_ta = _cstr_array(trace_a, n)
+    if trace_b is not None:
+        tr_b, bad_tb = _cstr_array(trace_b, n)
     off = np.empty(2 * n + 1, np.int64)
-    # Fixed part ≈ 120 B/response + 4 id copies + match id; escapes can at
-    # worst 6x a string, hence the generous per-row bound with retry.
-    cap = 256 * 2 * n + 8 * sum(len(s) for s in a_bytes + b_bytes + m_bytes)
+    status = np.empty(2 * n, np.int32)
+    # Fixed part ≈ 160 B/response + 4 id copies + match/trace ids; escapes
+    # can at worst 6x a string, hence the generous bound with retry.
+    cap = 320 * 2 * n + 8 * sum(
+        len(a_ptrs[i] or b"") + len(b_ptrs[i] or b"") + len(m_ptrs[i] or b"")
+        for i in range(n))
+    if tr_a is not None:
+        cap += 8 * sum(len(tr_a[i] or b"") for i in range(n))
+    if tr_b is not None:
+        cap += 8 * sum(len(tr_b[i] or b"") for i in range(n))
     for _ in range(2):
         arena = ctypes.create_string_buffer(cap)
-        used = lib.mm_encode_matched(a_ptrs, b_ptrs, m_ptrs, n, lat_a, lat_b,
-                                     qual, arena, cap, off)
+        used = lib.mm_encode_matched(
+            a_ptrs, b_ptrs, m_ptrs, n, lat_a_ms, lat_b_ms, quality,
+            waited_a_ms, waited_b_ms, tr_a, tr_b, arena, cap, off, status)
         if used >= 0:
-            raw = arena.raw
-            return [raw[off[j]:off[j + 1]] for j in range(2 * n)]
+            bodies = _slice_bodies(arena.raw, off, status, 2 * n)
+            # NUL-carrying rows were encoded from a blanked string: force
+            # them to Python. A bad player/match id poisons BOTH sides
+            # (each body embeds the whole pair); a bad trace id only its
+            # own side.
+            for i in bad_a + bad_b + bad_m:
+                bodies[2 * i] = None
+                bodies[2 * i + 1] = None
+            for i in bad_ta:
+                bodies[2 * i] = None
+            for i in bad_tb:
+                bodies[2 * i + 1] = None
+            return bodies
+        cap *= 4
+    return None  # pragma: no cover - bound above cannot be exceeded twice
+
+
+def encode_simple_batch(kinds, player_ids, lat_ms, retry_ms=None,
+                        trace_ids=None, tiers=None):
+    """Encode n queued/timeout/shed bodies natively (``kinds`` of
+    KIND_QUEUED/KIND_TIMEOUT/KIND_SHED), byte-identical to
+    ``contract.encode_response``. ``tiers`` entries < 0 (or None) omit the
+    tier key (untiered services). Same None-row fallback contract as
+    ``encode_matched_batch``; None when the library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(player_ids)
+    if n == 0:
+        return []
+    kinds = np.ascontiguousarray(kinds, np.int32)
+    lat_ms = np.ascontiguousarray(lat_ms, np.float64)
+    retry_ms = (np.zeros(n, np.float64) if retry_ms is None
+                else np.ascontiguousarray(retry_ms, np.float64))
+    tiers = (np.full(n, -1, np.int32) if tiers is None
+             else np.ascontiguousarray(tiers, np.int32))
+    p_ptrs, bad_p = _cstr_array(player_ids, n)
+    tr = None
+    bad_t: list[int] = []
+    if trace_ids is not None:
+        tr, bad_t = _cstr_array(trace_ids, n)
+    off = np.empty(n + 1, np.int64)
+    status = np.empty(n, np.int32)
+    cap = 256 * n + 8 * sum(len(p_ptrs[i] or b"") for i in range(n))
+    if tr is not None:
+        cap += 8 * sum(len(tr[i] or b"") for i in range(n))
+    for _ in range(2):
+        arena = ctypes.create_string_buffer(cap)
+        used = lib.mm_encode_simple(kinds, p_ptrs, lat_ms, retry_ms, tr,
+                                    tiers, n, arena, cap, off, status)
+        if used >= 0:
+            bodies = _slice_bodies(arena.raw, off, status, n)
+            for i in bad_p + bad_t:
+                bodies[i] = None
+            return bodies
         cap *= 4
     return None  # pragma: no cover - bound above cannot be exceeded twice

@@ -282,6 +282,37 @@ class AmqpBroker:
         if action == "resume":
             self._resume(queue)
 
+    def publish_batch(self, items) -> None:
+        """One channel op for a whole window of responses (the window-
+        granular egress seam, ISSUE 9): the per-publish lock acquire +
+        reconnect bookkeeping of ``_with_channel`` collapses to one per
+        window. Items needing per-message treatment — a reply_to set
+        (trace-stamped request publishes) or a chaos schedule covering the
+        queue (seq accounting) — take the full publish() path. At-least-
+        once caveat shared with publish(): a reconnect mid-batch may
+        re-send a prefix; consumers dedupe by correlation id."""
+        plain: list[tuple[str, bytes, Any]] = []
+        for queue, body, props in items:
+            props = props or Properties()
+            if (props.reply_to
+                    or (self.chaos is not None and self.chaos.applies(queue))):
+                self.publish(queue, body, props)
+                continue
+            plain.append((queue, body, self._pika.BasicProperties(
+                reply_to=None,
+                correlation_id=props.correlation_id or None,
+                headers=dict(props.headers) if props.headers else None)))
+        if not plain:
+            return
+
+        def op(ch):
+            for q, body, p in plain:
+                ch.basic_publish(exchange="", routing_key=q, body=body,
+                                 properties=p)
+
+        self._with_channel(op)
+        self.stats["published"] += len(plain)
+
     # ---- chaos partitions (gate the consumer thread) ----------------------
 
     def _gate(self, queue: str) -> threading.Event:

@@ -58,24 +58,6 @@ from matchmaking_tpu.utils.trace import EventLog, FlightRecorder, TraceContext
 log = logging.getLogger(__name__)
 
 
-def _body_with_trace_id(body: bytes, trace_id: str) -> bytes:
-    """Splice ``"trace_id": ...`` into an already-encoded JSON response
-    body (the native batch encoder builds matched bodies in C and knows
-    nothing of tracing; re-encoding in Python would forfeit the batch win
-    for every response, this costs one concat for the traced few)."""
-    import json
-
-    return body[:-1] + b',"trace_id":' + json.dumps(trace_id).encode() + b"}"
-
-
-def _body_with_waited(body: bytes, waited_ms: float) -> bytes:
-    """Splice ``"waited_ms": ...`` into a native-encoded matched body —
-    same trick as ``_body_with_trace_id`` (ISSUE 8: the C encoder knows
-    nothing of the engine-observed wait; one bytes concat per matched
-    response keeps the batch-encode win)."""
-    return body[:-1] + b',"waited_ms":%.3f}' % waited_ms
-
-
 class _QueueRuntime:
     """Everything one matchmaking queue owns (consumer, batcher, engine)."""
 
@@ -128,6 +110,31 @@ class _QueueRuntime:
         #: during a long first-window compile both it and batcher.depth read
         #: 0 — drain/quiesce checks must consult this too.
         self._flushing = 0
+        #: Overload admission control (service/overload.py): credit
+        #: limiter + deadline gate + adaptive shedding. None when no
+        #: OverloadConfig knob is set — the ingress path then pays nothing.
+        #: Created BEFORE the engine binds: _bind_engine derives the
+        #: inline-ingress fast path from the admission mode.
+        self.admission: AdmissionController | None = (
+            AdmissionController(app.cfg.overload, queue_cfg.name,
+                                app.metrics, app.events,
+                                default_tier=queue_cfg.default_tier)
+            if app.cfg.overload.enabled() else None)
+        #: Window-granular admission (ISSUE 9, OverloadConfig.
+        #: batch_admission): per-delivery ingress keeps only pre_decide's
+        #: pre-checks; the credit/occupancy ladder runs ONCE per cut
+        #: window at the top of the flush (_admission_cut), in arrival
+        #: order, with batched shed responses.
+        self._batch_admission = (self.admission is not None
+                                 and app.cfg.overload.batch_admission)
+        #: Arrival stamp for batcher submits (Delivery.arrival): the
+        #: admission pass orders the EDF-sorted window back into consume
+        #: order with it.
+        self._arrival_seq = 0
+        #: Window-granular egress (BrokerConfig.batch_publish): one
+        #: publish_batch broker call per window of responses.
+        self._batch_publish = (app.cfg.broker.batch_publish
+                               and hasattr(app.broker, "publish_batch"))
         self._bind_engine(self._make_engine())
         # At-least-once dedup: player id → (encoded terminal response BODY,
         # expiry). Bytes, not SearchResponse: the body is built exactly once
@@ -135,14 +142,6 @@ class _QueueRuntime:
         # verbatim — a player always sees a self-consistent response.
         self._recent: dict[str, tuple[bytes, float]] = {}
         self._next_prune = 0.0
-        #: Overload admission control (service/overload.py): credit
-        #: limiter + deadline gate + adaptive shedding. None when no
-        #: OverloadConfig knob is set — the ingress path then pays nothing.
-        self.admission: AdmissionController | None = (
-            AdmissionController(app.cfg.overload, queue_cfg.name,
-                                app.metrics, app.events,
-                                default_tier=queue_cfg.default_tier)
-            if app.cfg.overload.enabled() else None)
         #: Previous "total"-stage histogram snapshot (counts, overflow,
         #: count) for the adaptive limiter's per-window DELTA p99 — the
         #: lifetime-cumulative histogram would tighten on stale history
@@ -250,6 +249,16 @@ class _QueueRuntime:
             if self._columnar
             else default_pipeline(self.app.cfg.auth, self.app.broker)
         )
+        # Inline ingress (ISSUE 9): with no auth configured the columnar
+        # pipeline is just the first-received stamp — running it as a
+        # middleware chain costs a MessageContext + 3 coroutine frames +
+        # nested closures PER DELIVERY. Inline the stamp in _on_delivery
+        # instead (same headers, same trace marks); any real middleware
+        # (auth rpc/static) keeps the full chain. Legacy per-delivery
+        # admission also keeps the chain — that path stays byte-identical.
+        self._inline_ingress = (
+            self._columnar and self.app.cfg.auth.mode == "none"
+            and (self.admission is None or self._batch_admission))
         # Pipelining applies to BOTH ingress shapes: the columnar 1v1 fast
         # path and the object path (device team queues, config #3) — any
         # engine with the pipelined window API (search_async/collect_ready;
@@ -523,24 +532,114 @@ class _QueueRuntime:
         deadline = _QueueRuntime._delivery_deadline(delivery)
         return (delivery.tier, deadline if deadline else float("inf"))
 
+    # ---- window-granular admission (ISSUE 9) ------------------------------
+
+    def _admission_cut(self, deliveries: list[Delivery],
+                       now: float) -> "set[int] | None":
+        """The batched admission ladder over one cut window: ONE
+        pool_tier_counts/pool_size read + one decide_batch pass in ARRIVAL
+        order (the EDF sort reordered the window for dispatch, never for
+        admission), sheds settled with batch-encoded responses and one
+        batch publish. Returns the delivery TAGS to drop from the flush
+        (None = keep all). Runs before decode, so a shed request still
+        costs no decode work — the per-delivery semantics, window-granular."""
+        ac = self.admission
+        if ac is None or not self._batch_admission:
+            return None
+        ordered = sorted(deliveries, key=lambda d: d.arrival)
+        pool_tiers = (self.engine.pool_tier_counts(ac.tiers)
+                      if ac.tiers > 1 else None)
+        decisions = ac.decide_batch(ordered, now, self.engine.pool_size(),
+                                    pool_tiers)
+        shed = [d for d, dec in zip(ordered, decisions) if dec is not ADMIT]
+        if not shed:
+            return None
+        self._shed_deliveries(shed)
+        return {d.delivery_tag for d in shed}
+
+    def _shed_deliveries(self, deliveries: list[Delivery]) -> None:
+        """Batched twin of ``_shed_delivery`` for a window's shed rows:
+        identical per-row accounting (one record_shed EVENT per row — the
+        soaks count them), but bodies come from the native batch encoder
+        and the responses leave in one publish_batch call."""
+        import numpy as np
+
+        from matchmaking_tpu.native import codec
+
+        ac = self.admission
+        assert ac is not None
+        tiered = ac.tiers > 1
+        retry = self.app.cfg.overload.retry_after_ms
+        metas: list[tuple[Delivery, Any]] = []
+        for d in deliveries:
+            tr = self._trace(d)
+            if tr is not None:
+                tr.tier = d.tier
+                tr.mark("shed")
+            ac.record_shed(f"window cut tag={d.delivery_tag}", tier=d.tier)
+            metas.append((d, tr))
+        n = len(metas)
+        bodies = None
+        if codec.available():
+            bodies = codec.encode_simple_batch(
+                np.full(n, codec.KIND_SHED, np.int32), [""] * n,
+                np.zeros(n, np.float64), np.full(n, retry, np.float64),
+                [tr.trace_id if tr is not None else "" for _, tr in metas],
+                np.fromiter((d.tier if tiered else -1 for d, _ in metas),
+                            np.int32, n))
+        rows: list[tuple[str, str, bytes, Any]] = []
+        for j, (d, tr) in enumerate(metas):
+            body = bodies[j] if bodies is not None else None
+            if body is None:  # codec off or NEEDS_PYTHON row: exact contract
+                body = encode_response(SearchResponse(
+                    status="shed", player_id="", retry_after_ms=retry,
+                    trace_id=tr.trace_id if tr is not None else "",
+                    tier=d.tier if tiered else None))
+            if tr is not None:
+                tr.mark("encode")
+            rows.append((d.properties.reply_to,
+                         d.properties.correlation_id, body, tr))
+        self._publish_batch(rows)
+        for d, tr in metas:
+            self._ack(d)
+            if tr is not None:
+                self._settle_trace(d, "shed")
+
     # ---- ingress ----------------------------------------------------------
 
     async def _on_delivery(self, delivery: Delivery) -> None:
-        ctx = MessageContext(delivery=delivery, queue=self.queue_cfg.name)
+        received_at = time.time()
         tr = self._trace(delivery)
         if tr is not None:
-            tr.mark("consume", ctx.received_at)
-        if self.admission is not None:
-            # Admission runs FIRST — before decode and before any auth RPC
-            # round trip: an overloaded queue must not spend middleware
-            # work on a request it is about to shed. Tiered queues also
-            # hand the per-tier pool composition in, so the nested-ladder
-            # partition check can count only same-or-higher-priority
-            # occupancy (and oldest-policy preemption knows whether a
-            # lower-priority victim exists).
+            tr.mark("consume", received_at)
+        if self._batch_admission:
+            # Window-granular admission (ISSUE 9): only the pre-checks run
+            # per delivery — default-deadline stamp, tier/deadline caching
+            # (the EDF cut key reads them), already-expired-at-receive,
+            # drain-mode shed. The credit/occupancy ladder runs once per
+            # cut window inside the flush (_admission_cut).
+            assert self.admission is not None
+            decision = self.admission.pre_decide(delivery, received_at)
+            if tr is not None:
+                tr.tier = delivery.tier
+            if decision is EXPIRED:
+                self._expire_delivery(delivery, received_at)
+                return
+            if decision is not ADMIT:  # draining
+                self._shed_delivery(delivery)
+                return
+        elif self.admission is not None:
+            # Per-delivery admission (batch_admission=False — the PR 5/7
+            # path, byte for byte). Admission runs FIRST — before decode
+            # and before any auth RPC round trip: an overloaded queue must
+            # not spend middleware work on a request it is about to shed.
+            # Tiered queues also hand the per-tier pool composition in, so
+            # the nested-ladder partition check can count only
+            # same-or-higher-priority occupancy (and oldest-policy
+            # preemption knows whether a lower-priority victim exists).
             pool_tiers = (self.engine.pool_tier_counts(self.admission.tiers)
                           if self.admission.tiers > 1 else None)
-            decision = self.admission.decide(delivery, ctx.received_at,
+            decision = self.admission.decide(delivery, received_at,
                                              self.engine.pool_size(),
                                              pool_tiers)
             if tr is not None:
@@ -555,11 +654,32 @@ class _QueueRuntime:
                 decision = ADMIT
             if decision is not ADMIT:
                 if decision is EXPIRED:
-                    self._expire_delivery(delivery, ctx.received_at)
+                    self._expire_delivery(delivery, received_at)
                 else:
                     self._shed_delivery(delivery)
                 return
             self.admission.admit(delivery.delivery_tag, delivery.tier)
+        if self._inline_ingress:
+            # Columnar + auth "none" (ISSUE 9): the whole middleware chain
+            # is the first-received stamp — run it inline instead of
+            # paying a MessageContext + nested coroutine frames per
+            # delivery. Same headers, same marks (middleware/batch), same
+            # deferred decode; auth-configured services keep the chain.
+            headers = delivery.properties.headers
+            first = headers.setdefault("x-first-received", received_at)
+            try:
+                delivery.first_received = float(first)
+            except (TypeError, ValueError):
+                delivery.first_received = received_at
+            if tr is not None:
+                tr.mark("middleware")
+                tr.mark("batch")
+            delivery.arrival = self._arrival_seq
+            self._arrival_seq += 1
+            self.batcher.submit((None, delivery))
+            return
+        ctx = MessageContext(delivery=delivery, queue=self.queue_cfg.name,
+                             received_at=received_at)
         try:
             await self.pipeline.run(ctx)
         except MiddlewareReject as e:
@@ -582,6 +702,13 @@ class _QueueRuntime:
             raise
         if tr is not None:
             tr.mark("batch")
+        # Arrival stamp: the batched admission pass re-orders the (possibly
+        # EDF-sorted) cut window back into consume order with it, so
+        # batching cannot reorder admission decisions. Re-stamped per
+        # submit — a redelivery takes its re-consume position, exactly as
+        # per-delivery admission decided it.
+        delivery.arrival = self._arrival_seq
+        self._arrival_seq += 1
         try:
             if ctx.request is None:
                 # Columnar ingress: the pipeline left decoding to the
@@ -623,13 +750,22 @@ class _QueueRuntime:
         if self._columnar:
             await self._flush_columnar([d for _, d in window])
             return
+        now = time.time()
+        if self._batch_admission:
+            # Window-granular admission (ISSUE 9) — before the straggler
+            # decode below, so a shed request costs no decode work.
+            dropped = self._admission_cut([d for _, d in window], now)
+            if dropped:
+                window = [(r, d) for r, d in window
+                          if d.delivery_tag not in dropped]
+                if not window:
+                    return
         if any(req is None for req, _ in window):
             # Transition stragglers: these deliveries entered through the
             # columnar ingress (decode deferred to the batched codec), but
             # the engine has since been demoted to the host oracle — decode
             # them per object here; the shapes may be mixed in one window.
             window = self._decode_deferred(window)
-        now = time.time()
         # At-least-once dedup: a redelivered copy of a request whose player
         # already reached a terminal state must not re-enter the pool (the
         # player could end up in two matches); replay the cached response.
@@ -751,14 +887,23 @@ class _QueueRuntime:
         self.app.metrics.counters.inc("requests_batched", len(window))
 
     def _first_received(self, delivery: Delivery, now: float) -> float:
-        """Client-settable ``x-first-received`` header; a non-numeric value
-        must not crash the whole window flush (it would strand every
-        delivery in it)."""
+        """Client-settable ``x-first-received`` stamp, from the cache the
+        ingress middleware filled (Delivery.first_received) — the columnar
+        flush reads this per lane, and a header parse per lane is exactly
+        the per-delivery hot-path work ISSUE 9 removed (matchlint's perf
+        rule now flags it). Lazy header fallback for paths that bypass the
+        middleware; a non-numeric value must not crash the whole window
+        flush (it would strand every delivery in it)."""
+        cached = delivery.first_received
+        if cached >= 0.0:
+            return cached
         try:
-            return float(delivery.properties.headers.get(
+            first = float(delivery.properties.headers.get(
                 "x-first-received", now))
         except (TypeError, ValueError):
-            return now
+            first = now
+        delivery.first_received = first
+        return first
 
     def _decode_or_reject(self, delivery: Delivery,
                           now: float) -> SearchRequest | None:
@@ -804,12 +949,15 @@ class _QueueRuntime:
         return out
 
     async def _flush_columnar(self, deliveries: list[Delivery]) -> None:
-        """Columnar window flush: batched native decode → RequestColumns →
-        pipelined columnar engine step → responses from ColumnarOutcome.
+        """Columnar window flush, window-granular end to end (ISSUE 9):
+        batched admission pass → batched native decode → batch dedup probe
+        → vectorized column assembly → pipelined columnar engine step →
+        batch-encoded responses in one publish call.
 
-        Per-delivery Python is reduced to dict lookups (dedup cache) and the
-        rows the native codec flags NEEDS_PYTHON (parties/escapes), which
-        re-decode through contract.decode_request — the semantic truth."""
+        Per-delivery Python is reduced to the dedup probe's dict lookups
+        and the rows the native codec flags NEEDS_PYTHON (parties/escapes),
+        which re-decode through contract.decode_request — the semantic
+        truth."""
         import numpy as np
 
         from matchmaking_tpu.native import codec
@@ -817,74 +965,83 @@ class _QueueRuntime:
 
         now = time.time()
         self._prune_recent(now)
+        if self._batch_admission:
+            # Admission ladder once per window, before decode — a shed
+            # request costs no decode work, exactly like the per-delivery
+            # flow (which also shed pre-decode).
+            dropped = self._admission_cut(deliveries, now)
+            if dropped:
+                deliveries = [d for d in deliveries
+                              if d.delivery_tag not in dropped]
+                if not deliveries:
+                    return
         bodies = [bytes(d.body) for d in deliveries]
         native = codec.decode_batch(bodies) if codec.available() else None
 
-        # Lane rows: (id, rating, rd, thr, region, mode, first_received,
-        # delivery, tier, deadline) — QoS metadata resolved ONCE per lane
-        # here (tier was cached on the delivery at admission; the deadline
-        # is the stamped header) and mirrored into the pool columns below.
-        stamp_qos = self.admission is not None
-        lanes: list[tuple] = []
+        traced = any(d.trace is not None for d in deliveries)
+        if traced:
+            for d in deliveries:
+                if d.trace is not None:
+                    d.trace.mark("flush", now)
+
+        # Row resolution: native-OK rows stay columnar end to end; only
+        # NEEDS_PYTHON rows materialize a SearchRequest, and only
+        # malformed rows pay a response here. ``rows``: (source index,
+        # player id, fallback request or None).
+        if native is not None:
+            ids_n, rating_n, rd_n, thr_n, regions_n, modes_n, status_n = native
+            status_l = status_n.tolist()
+        rows: list[tuple[int, str, SearchRequest | None]] = []
         for i, delivery in enumerate(deliveries):
-            if delivery.trace is not None:
-                delivery.trace.mark("flush", now)
-            if native is not None and native[6][i] == codec.OK:
-                ids, rating, rd, thr, regions, modes, _status = (
-                    native[0], native[1], native[2], native[3], native[4],
-                    native[5], native[6])
-                row = (ids[i], float(rating[i]), float(rd[i]), float(thr[i]),
-                       regions[i], modes[i],
-                       self._first_received(delivery, now), delivery,
-                       delivery.tier,
-                       self._delivery_deadline(delivery)
-                       if stamp_qos else 0.0)
-            elif native is not None and native[6][i] not in (codec.OK,
-                                                             codec.NEEDS_PYTHON):
+            st = int(status_l[i]) if native is not None else codec.NEEDS_PYTHON
+            if st == codec.OK:
+                rows.append((i, ids_n[i], None))
+                continue
+            if st != codec.NEEDS_PYTHON:
                 self.app.metrics.counters.inc("rejected_by_middleware")
-                self._respond_error(delivery, codec.error_code(native[6][i]),
+                self._respond_error(delivery, codec.error_code(st),
                                     "malformed payload")
                 self._ack(delivery)
                 if delivery.trace is not None:
                     delivery.trace.mark("reject")
                     self._settle_trace(delivery, "rejected")
                 continue
-            else:
-                # Python fallback (codec unavailable or NEEDS_PYTHON row).
-                req = self._decode_or_reject(delivery, now)
-                if req is None:
-                    continue
-                if req.party_size > 1:
-                    # 1v1 queue: parties are unservable (oracle semantics).
-                    self.app.metrics.counters.inc("rejected_by_engine")
-                    self._respond_error(delivery, "party_not_supported",
-                                        "engine rejected request: party_not_supported")
-                    self._ack(delivery)
-                    if delivery.trace is not None:
-                        delivery.trace.mark("reject")
-                        self._settle_trace(delivery, "rejected")
-                    continue
-                row = (req.id, req.rating, req.rating_deviation,
-                       (np.nan if req.rating_threshold is None
-                        else req.rating_threshold),
-                       "" if req.region == "*" else req.region,
-                       "" if req.game_mode == "*" else req.game_mode,
-                       req.enqueued_at, delivery,
-                       delivery.tier,
-                       self._delivery_deadline(delivery)
-                       if stamp_qos else 0.0)
-            if delivery.trace is not None:
-                delivery.trace.player_id = row[0]
-                delivery.trace.tier = delivery.tier
-            # At-least-once dedup: replay terminal responses.
-            cached = self._recent.get(row[0])
+            # Python fallback (codec unavailable or NEEDS_PYTHON row).
+            req = self._decode_or_reject(delivery, now)
+            if req is None:
+                continue
+            if req.party_size > 1:
+                # 1v1 queue: parties are unservable (oracle semantics).
+                self.app.metrics.counters.inc("rejected_by_engine")
+                self._respond_error(delivery, "party_not_supported",
+                                    "engine rejected request: party_not_supported")
+                self._ack(delivery)
+                if delivery.trace is not None:
+                    delivery.trace.mark("reject")
+                    self._settle_trace(delivery, "rejected")
+                continue
+            rows.append((i, req.id, req))
+        if traced:
+            for src, pid, _req in rows:
+                tr = deliveries[src].trace
+                if tr is not None:
+                    tr.player_id = pid
+                    tr.tier = deliveries[src].tier
+
+        # Batch dedup probe (at-least-once terminal replay) + deadline
+        # check #2 (batch formation). Terminal replay BEFORE the deadline
+        # check — see the object-path twin: "matched" must never be
+        # followed by a contradictory post-deadline "timeout".
+        recent = self._recent
+        check_deadline = self.admission is not None
+        keep: list[tuple[int, str, SearchRequest | None]] = []
+        for src, pid, req in rows:
+            delivery = deliveries[src]
+            cached = recent.get(pid)
             if cached is not None and cached[1] <= now:
-                del self._recent[row[0]]
+                del recent[pid]  # expired: a genuine re-queue
                 cached = None
             if cached is not None:
-                # Terminal replay BEFORE the deadline check — see the
-                # object-path twin: "matched" must never be followed by a
-                # contradictory post-deadline "timeout".
                 self.app.metrics.counters.inc("deduped_replays")
                 self._publish_body(delivery.properties.reply_to,
                                    delivery.properties.correlation_id,
@@ -893,55 +1050,104 @@ class _QueueRuntime:
                 if delivery.trace is not None:
                     delivery.trace.mark("dedup_replay")
                     self._settle_trace(delivery, "deduped")
-                continue
-            if self._deadline_expired(delivery, now):
-                # Deadline check #2 (batch formation), columnar twin —
-                # after decode, so the timeout quotes the player id.
-                self._expire_delivery(delivery, now, player_id=row[0])
-                continue
-            lanes.append(row)
-
-        if not lanes:
+            elif check_deadline and self._deadline_expired(delivery, now):
+                # Columnar twin of deadline check #2 — after decode, so
+                # the timeout quotes the player id.
+                self._expire_delivery(delivery, now, player_id=pid)
+            else:
+                keep.append((src, pid, req))
+        if not keep:
             return
-        if self.app.cfg.overload.edf and len(lanes) > 1:
+
+        # QoS columns from the per-delivery caches (tier/deadline were
+        # parsed at most once, at admission) — mirrored into the pool for
+        # priority-aware eviction + the per-slot deadline sweep; None when
+        # overload control is off so the pool stores plain zeros.
+        stamp_qos = self.admission is not None
+        k = len(keep)
+        tier_col = (np.fromiter((deliveries[s].tier for s, _, _ in keep),
+                                np.int32, k) if stamp_qos else None)
+        dl_col = (np.fromiter(
+            (self._delivery_deadline(deliveries[s]) for s, _, _ in keep),
+            np.float64, k) if stamp_qos else None)
+        if self.app.cfg.overload.edf and stamp_qos and k > 1:
             # EDF, flush side: the batcher already cut by (tier, deadline),
             # but dedup/expiry/reject filtering just rewrote the lane set —
             # re-establish the order so when this window splits into bucket
-            # CHUNKS, the near-deadline tier-0 lanes ride the first chunk
-            # (one chunk = one device step; chunk order is dispatch order).
-            # Stable: FIFO within equal keys, pure function of lane rows.
-            lanes.sort(key=lambda r: (r[8], r[9] if r[9] else float("inf")))
-        n = len(lanes)
+            # CHUNKS, the near-deadline tier-0 lanes ride the first chunk.
+            # Stable (arange tiebreak): FIFO within equal keys. Gated on
+            # stamp_qos: edf without any admission knob leaves the QoS
+            # columns None, and every key is (0, inf) then anyway — the
+            # pre-PR lane sort was the same no-op.
+            dl_eff = np.where(dl_col > 0.0, dl_col, np.inf)
+            order = np.lexsort((np.arange(k), dl_eff, tier_col))
+            keep = [keep[j] for j in order.tolist()]
+            tier_col = tier_col[order]
+            dl_col = dl_col[order]
+
+        # Column assembly: pure numpy takes of the native decode arrays in
+        # the common all-native case; element-wise only for the rare
+        # fallback rows.
         interner_r = self.engine.pool.regions.code
         interner_m = self.engine.pool.modes.code
-        cols = RequestColumns(
-            ids=np.fromiter((r[0] for r in lanes), object, n),
-            rating=np.fromiter((r[1] for r in lanes), np.float32, n),
-            rd=np.fromiter((r[2] for r in lanes), np.float32, n),
-            region=np.fromiter(
-                (0 if r[4] in ("", "*") else interner_r(r[4]) for r in lanes),
-                np.int32, n),
-            mode=np.fromiter(
-                (0 if r[5] in ("", "*") else interner_m(r[5]) for r in lanes),
-                np.int32, n),
-            threshold=np.fromiter((r[3] for r in lanes), np.float32, n),
-            enqueued_at=np.fromiter((r[6] for r in lanes), np.float64, n),
-            reply_to=np.fromiter(
-                (r[7].properties.reply_to for r in lanes), object, n),
-            correlation_id=np.fromiter(
-                (r[7].properties.correlation_id for r in lanes), object, n),
-            # QoS mirror columns (priority-aware eviction + the per-slot
-            # deadline sweep); None when overload control is off so the
-            # pool stores plain zeros without per-lane work.
-            tier=(np.fromiter((r[8] for r in lanes), np.int32, n)
-                  if stamp_qos else None),
-            deadline=(np.fromiter((r[9] for r in lanes), np.float64, n)
-                      if stamp_qos else None),
-        )
-        by_id = {r[0]: r[7] for r in lanes}
+        enq_col = np.fromiter(
+            (self._first_received(deliveries[s], now) for s, _, _ in keep),
+            np.float64, k)
+        reply_col = np.fromiter(
+            (deliveries[s].properties.reply_to for s, _, _ in keep),
+            object, k)
+        corr_col = np.fromiter(
+            (deliveries[s].properties.correlation_id for s, _, _ in keep),
+            object, k)
+        all_native = native is not None and all(
+            req is None for _, _, req in keep)
+        if all_native:
+            sel = np.fromiter((s for s, _, _ in keep), np.int64, k)
+            cols = RequestColumns(
+                ids=ids_n[sel],
+                rating=rating_n[sel],
+                rd=rd_n[sel],
+                region=np.fromiter(
+                    (0 if r == "" else interner_r(r)
+                     for r in regions_n[sel].tolist()), np.int32, k),
+                mode=np.fromiter(
+                    (0 if m == "" else interner_m(m)
+                     for m in modes_n[sel].tolist()), np.int32, k),
+                threshold=thr_n[sel],
+                enqueued_at=enq_col, reply_to=reply_col,
+                correlation_id=corr_col, tier=tier_col, deadline=dl_col,
+            )
+        else:
+            rating_a = np.empty(k, np.float32)
+            rd_a = np.empty(k, np.float32)
+            thr_a = np.empty(k, np.float32)
+            reg_a = np.empty(k, np.int32)
+            mode_a = np.empty(k, np.int32)
+            for j, (s, _pid, req) in enumerate(keep):
+                if req is None:
+                    rating_a[j] = rating_n[s]
+                    rd_a[j] = rd_n[s]
+                    thr_a[j] = thr_n[s]
+                    r, m = regions_n[s], modes_n[s]
+                else:
+                    rating_a[j] = req.rating
+                    rd_a[j] = req.rating_deviation
+                    thr_a[j] = (np.nan if req.rating_threshold is None
+                                else req.rating_threshold)
+                    r = "" if req.region == "*" else req.region
+                    m = "" if req.game_mode == "*" else req.game_mode
+                reg_a[j] = 0 if r == "" else interner_r(r)
+                mode_a[j] = 0 if m == "" else interner_m(m)
+            cols = RequestColumns(
+                ids=np.fromiter((pid for _, pid, _ in keep), object, k),
+                rating=rating_a, rd=rd_a, region=reg_a, mode=mode_a,
+                threshold=thr_a, enqueued_at=enq_col, reply_to=reply_col,
+                correlation_id=corr_col, tier=tier_col, deadline=dl_col,
+            )
+        by_id = {pid: deliveries[s] for s, pid, _ in keep}
 
         if not self._pipelined:
-            deliveries_in = [r[7] for r in lanes]
+            deliveries_in = [deliveries[s] for s, _, _ in keep]
 
             def run_engine():
                 # Dispatch + flush OFF the event loop: first-window jit
@@ -962,18 +1168,21 @@ class _QueueRuntime:
                         # before the dispatch opens a window (remove()
                         # requires _open == 0).
                         evict_debt = self.admission.eviction_debt(
-                            len(lanes), self.engine.pool_size())
+                            k, self.engine.pool_size())
                         drop = await self._pay_debt_locked(
-                            [(r[0], r[8], r[6], r[7]) for r in lanes],
+                            [(pid, d.tier, enq, d) for (_s, pid, _), d, enq
+                             in zip(keep, deliveries_in,
+                                    cols.enqueued_at.tolist())],
                             evict_debt, now)
                         if drop:
-                            keep = np.fromiter(
+                            mask = np.fromiter(
                                 (pid not in drop
                                  for pid in cols.ids.tolist()),
                                 bool, len(cols))
-                            cols = cols.take(keep)
-                            deliveries_in = [r[7] for r in lanes
-                                             if r[0] not in drop]
+                            cols = cols.take(mask)
+                            deliveries_in = [
+                                deliveries[s] for s, pid, _ in keep
+                                if pid not in drop]
                             if not len(cols):
                                 return
                     outs = await asyncio.to_thread(run_engine)
@@ -1005,14 +1214,14 @@ class _QueueRuntime:
         def dispatch(drop: set[str]):
             c = cols
             if drop:
-                keep = np.fromiter((i not in drop for i in c.ids.tolist()),
+                mask = np.fromiter((i not in drop for i in c.ids.tolist()),
                                    bool, len(c))
-                c = c.take(keep)
+                c = c.take(mask)
             # matchlint: ignore[guarded-by] closure runs under _engine_lock inside _dispatch_pipelined (via to_thread)
             return self.engine.search_columns_async(c, now)
 
         await self._dispatch_pipelined(
-            dispatch, [(r[0], r[7]) for r in lanes], now)
+            dispatch, [(pid, deliveries[s]) for s, pid, _ in keep], now)
 
     # ---- pipelined collection ---------------------------------------------
 
@@ -1358,15 +1567,38 @@ class _QueueRuntime:
         traces = self._trace_map(deliveries)
         self._publish_columnar_matches(out, now, trace_ids=trace_ids,
                                        traces=traces)
-        if self.queue_cfg.send_queued_ack:
-            for pid in out.q_ids:
-                d = by_id.get(pid)
-                if d is not None:
-                    self._respond_raw(
-                        d.properties.reply_to, d.properties.correlation_id,
-                        SearchResponse(status="queued", player_id=pid,
-                                       trace_id=trace_ids.get(pid, "")),
-                        trace=d.trace)
+        if self.queue_cfg.send_queued_ack and len(out.q_ids):
+            # Queued acks ride the batch path too (ISSUE 9): one native
+            # encode + one publish_batch per window instead of an
+            # encode_response + publish per newly pooled player.
+            import numpy as np
+
+            from matchmaking_tpu.native import codec
+
+            metas = [(pid, by_id[pid]) for pid in out.q_ids.tolist()
+                     if pid in by_id]
+            if metas:
+                nq = len(metas)
+                bodies_q = None
+                if codec.available():
+                    bodies_q = codec.encode_simple_batch(
+                        np.full(nq, codec.KIND_QUEUED, np.int32),
+                        [pid for pid, _ in metas],
+                        np.zeros(nq, np.float64), None,
+                        [trace_ids.get(pid, "") for pid, _ in metas], None)
+                rows: list[tuple[str, str, bytes, Any]] = []
+                for j, (pid, d) in enumerate(metas):
+                    body = bodies_q[j] if bodies_q is not None else None
+                    if body is None:  # codec off or NEEDS_PYTHON row
+                        body = encode_response(SearchResponse(  # matchlint: ignore[perf] per-ROW fallback: codec off or NEEDS_PYTHON rows only
+                            status="queued", player_id=pid,
+                            trace_id=trace_ids.get(pid, "")))
+                    if d.trace is not None:
+                        d.trace.mark("encode")
+                    rows.append((d.properties.reply_to,
+                                 d.properties.correlation_id, body,
+                                 d.trace))
+                self._publish_batch(rows)
         for pid, code in out.rejected:
             m.counters.inc("rejected_by_engine")
             d = by_id.get(pid)
@@ -1376,7 +1608,7 @@ class _QueueRuntime:
         for d in deliveries:
             self._ack(d)
         if any(d.trace is not None for d in deliveries):
-            matched_ids = set(out.m_id_a.tolist()) | set(out.m_id_b.tolist())
+            matched_ids = set(out.m_id_a.tolist()) | set(out.m_id_b.tolist())  # matchlint: ignore[perf] O(window matches) OUTCOME columns, traced windows only — not a pool scan
             rejected_ids = {pid for pid, _ in out.rejected}
             t_settle = time.time()
             for d in deliveries:
@@ -1475,13 +1707,15 @@ class _QueueRuntime:
                                   ) -> None:
         """Matched responses for one ColumnarOutcome (window flush AND
         rescan both come through here). Bodies are built by the native
-        batch encoder when available (one C call per window — at grouped-
-        readback match rates the per-response dict+json.dumps is the
-        service's next hot loop); the Python path is the fallback and the
-        semantic source of truth (parsed-value equivalence pinned by
-        tests/test_native_codec.py). ``trace_ids`` maps this window's
-        traced players to flight-recorder ids quoted in their responses
-        (spliced into native bodies — only traced players pay)."""
+        batch encoder when available — one C call per window with
+        trace_id/waited_ms INCLUDED, byte-identical to
+        contract.encode_response (pinned by tests/test_codec_fuzz.py; the
+        PR 8 splice helpers are gone) — and the whole window leaves in ONE
+        publish_batch call, so publish_lag collapses from O(matches)
+        publish callbacks to O(windows). The Python path is the fallback
+        and the semantic source of truth; rows the C encoder flags
+        NEEDS_PYTHON (non-ASCII ids, non-finite floats) re-encode through
+        it individually."""
         import numpy as np
 
         from matchmaking_tpu.native import codec
@@ -1503,85 +1737,99 @@ class _QueueRuntime:
                 np.concatenate([out.m_wait_a, out.m_wait_b]),
                 (np.concatenate([out.m_tier_a, out.m_tier_b])
                  if len(out.m_tier_a) == n else None))
-        bodies = None
-        if codec.available():
-            lat_a = np.where(out.m_enq_a != 0.0, (now - out.m_enq_a) * 1e3, 0.0)
-            lat_b = np.where(out.m_enq_b != 0.0, (now - out.m_enq_b) * 1e3, 0.0)
-            bodies = codec.encode_matched_batch(
-                out.m_id_a.tolist(), out.m_id_b.tolist(),
-                out.m_match_id.tolist(), lat_a, lat_b,
-                out.m_quality.astype(np.float64))
-        if bodies is not None:
-            m = self.app.metrics
-            m.counters.inc("players_matched", 2 * n)
-            rec = m.latency["match_wait"]
-            q = self.queue_cfg.name
-            for enq in (out.m_enq_a, out.m_enq_b):
-                for w in (now - enq[enq != 0.0]).tolist():
-                    rec.record(w)
-                    # The same sample feeds the bucketed histogram, so its
-                    # p99-from-buckets is checkable against the recorder.
-                    m.observe_stage(q, "e2e", w)
-            ids_a, ids_b = out.m_id_a.tolist(), out.m_id_b.tolist()
-            reply_a, reply_b = out.m_reply_a.tolist(), out.m_reply_b.tolist()
-            corr_a, corr_b = out.m_corr_a.tolist(), out.m_corr_b.tolist()
-            wa_ms = ((out.m_wait_a * 1e3).tolist() if have_wait
-                     else [0.0] * n)
-            wb_ms = ((out.m_wait_b * 1e3).tolist() if have_wait
-                     else [0.0] * n)
-            qual_l = out.m_quality.tolist()
-            traces = traces or {}
-            for j in range(n):
-                body_a, body_b = bodies[2 * j], bodies[2 * j + 1]
-                if have_wait:
-                    # waited_ms rides every matched body (wire contract,
-                    # ISSUE 8) — spliced like trace_id, one concat each.
-                    body_a = _body_with_waited(body_a, wa_ms[j])
-                    body_b = _body_with_waited(body_b, wb_ms[j])
-                if trace_ids:
-                    tid = trace_ids.get(ids_a[j])
-                    if tid:
-                        body_a = _body_with_trace_id(body_a, tid)
-                    tid = trace_ids.get(ids_b[j])
-                    if tid:
-                        body_b = _body_with_trace_id(body_b, tid)
-                tr_a, tr_b = traces.get(ids_a[j]), traces.get(ids_b[j])
-                if tr_a is not None:
-                    tr_a.quality = qual_l[j]
-                    tr_a.waited_s = wa_ms[j] / 1e3
-                if tr_b is not None:
-                    tr_b.quality = qual_l[j]
-                    tr_b.waited_s = wb_ms[j] / 1e3
-                self._remember(ids_a[j], body_a, now)
-                self._remember(ids_b[j], body_b, now)
-                self._publish_body(reply_a[j], corr_a[j], body_a,
-                                   trace=tr_a)
-                self._publish_body(reply_b[j], corr_b[j], body_b,
-                                   trace=tr_b)
-            return
         trace_ids = trace_ids or {}
         traces = traces or {}
+        if not codec.available():
+            # Codec off: the per-request Python path, byte-identical to
+            # the pre-batch behavior.
+            for j in range(n):
+                id_a, id_b = out.m_id_a[j], out.m_id_b[j]
+                result = MatchResult(
+                    match_id=out.m_match_id[j], players=(id_a, id_b),
+                    teams=((id_a,), (id_b,)),
+                    quality=float(out.m_quality[j]),
+                )
+                self._publish_matched(id_a, out.m_reply_a[j],
+                                      out.m_corr_a[j],
+                                      float(out.m_enq_a[j]), result, now,
+                                      trace_id=trace_ids.get(id_a, ""),
+                                      trace=traces.get(id_a),
+                                      waited_ms=(float(out.m_wait_a[j]) * 1e3
+                                                 if have_wait else None),
+                                      record_quality=not have_wait)
+                self._publish_matched(id_b, out.m_reply_b[j],
+                                      out.m_corr_b[j],
+                                      float(out.m_enq_b[j]), result, now,
+                                      trace_id=trace_ids.get(id_b, ""),
+                                      trace=traces.get(id_b),
+                                      waited_ms=(float(out.m_wait_b[j]) * 1e3
+                                                 if have_wait else None),
+                                      record_quality=not have_wait)
+            return
+        lat_a = np.where(out.m_enq_a != 0.0, (now - out.m_enq_a) * 1e3, 0.0)
+        lat_b = np.where(out.m_enq_b != 0.0, (now - out.m_enq_b) * 1e3, 0.0)
+        # waited_ms parity with the Python encoder: the engine-observed
+        # wait when the outcome carries one, publish-time latency
+        # otherwise (what _publish_matched reports in that case).
+        wa_ms = out.m_wait_a * 1e3 if have_wait else lat_a
+        wb_ms = out.m_wait_b * 1e3 if have_wait else lat_b
+        ids_a, ids_b = out.m_id_a.tolist(), out.m_id_b.tolist()
+        mids = out.m_match_id.tolist()
+        qual = out.m_quality.astype(np.float64)
+        tr_a = ([trace_ids.get(p, "") for p in ids_a] if trace_ids else None)
+        tr_b = ([trace_ids.get(p, "") for p in ids_b] if trace_ids else None)
+        bodies = codec.encode_matched_batch(
+            ids_a, ids_b, mids, lat_a, lat_b, qual, wa_ms, wb_ms, tr_a, tr_b)
+        if bodies is None:  # library load raced away: full Python fallback
+            bodies = [None] * (2 * n)
+        m = self.app.metrics
+        m.counters.inc("players_matched", 2 * n)
+        rec = m.latency["match_wait"]
+        q = self.queue_cfg.name
+        for enq in (out.m_enq_a, out.m_enq_b):
+            for w in (now - enq[enq != 0.0]).tolist():
+                rec.record(w)
+                # The same sample feeds the bucketed histogram, so its
+                # p99-from-buckets is checkable against the recorder.
+                m.observe_stage(q, "e2e", w)
+        reply_a, reply_b = out.m_reply_a.tolist(), out.m_reply_b.tolist()
+        corr_a, corr_b = out.m_corr_a.tolist(), out.m_corr_b.tolist()
+        wa_l, wb_l = wa_ms.tolist(), wb_ms.tolist()
+        lat_al, lat_bl = lat_a.tolist(), lat_b.tolist()
+        qual_l = qual.tolist()
+        rows: list[tuple[str, str, bytes, Any]] = []
         for j in range(n):
-            id_a, id_b = out.m_id_a[j], out.m_id_b[j]
-            result = MatchResult(
-                match_id=out.m_match_id[j], players=(id_a, id_b),
-                teams=((id_a,), (id_b,)),
-                quality=float(out.m_quality[j]),
-            )
-            self._publish_matched(id_a, out.m_reply_a[j], out.m_corr_a[j],
-                                  float(out.m_enq_a[j]), result, now,
-                                  trace_id=trace_ids.get(id_a, ""),
-                                  trace=traces.get(id_a),
-                                  waited_ms=(float(out.m_wait_a[j]) * 1e3
-                                             if have_wait else None),
-                                  record_quality=not have_wait)
-            self._publish_matched(id_b, out.m_reply_b[j], out.m_corr_b[j],
-                                  float(out.m_enq_b[j]), result, now,
-                                  trace_id=trace_ids.get(id_b, ""),
-                                  trace=traces.get(id_b),
-                                  waited_ms=(float(out.m_wait_b[j]) * 1e3
-                                             if have_wait else None),
-                                  record_quality=not have_wait)
+            body_a, body_b = bodies[2 * j], bodies[2 * j + 1]
+            if body_a is None or body_b is None:
+                # NEEDS_PYTHON row: exact contract via the Python encoder.
+                result = MatchResult(
+                    match_id=mids[j], players=(ids_a[j], ids_b[j]),
+                    teams=((ids_a[j],), (ids_b[j],)), quality=qual_l[j])
+                if body_a is None:
+                    body_a = encode_response(SearchResponse(
+                        status="matched", player_id=ids_a[j], match=result,
+                        latency_ms=lat_al[j], waited_ms=wa_l[j],
+                        trace_id=tr_a[j] if tr_a else ""))
+                if body_b is None:
+                    body_b = encode_response(SearchResponse(
+                        status="matched", player_id=ids_b[j], match=result,
+                        latency_ms=lat_bl[j], waited_ms=wb_l[j],
+                        trace_id=tr_b[j] if tr_b else ""))
+            tr_ja = traces.get(ids_a[j]) if traces else None
+            tr_jb = traces.get(ids_b[j]) if traces else None
+            if tr_ja is not None:
+                tr_ja.quality = qual_l[j]
+                tr_ja.waited_s = wa_l[j] / 1e3
+                tr_ja.mark("encode")
+            if tr_jb is not None:
+                tr_jb.quality = qual_l[j]
+                tr_jb.waited_s = wb_l[j] / 1e3
+                tr_jb.mark("encode")
+            self._remember(ids_a[j], body_a, now)
+            self._remember(ids_b[j], body_b, now)
+            rows.append((reply_a[j], corr_a[j], body_a, tr_ja))
+            rows.append((reply_b[j], corr_b[j], body_b, tr_jb))
+        self._publish_batch(rows)
 
     def _publish_matched(self, pid: str, reply_to: str, correlation_id: str,
                          enqueued_at: float, result, now: float,
@@ -1639,6 +1887,28 @@ class _QueueRuntime:
         self.app.broker.publish(reply_to, body,
                                 Properties(correlation_id=correlation_id))
 
+    def _publish_batch(self, rows: "list[tuple[str, str, bytes, Any]]") -> None:
+        """Window-granular twin of ``_publish_body`` (ISSUE 9): one broker
+        ``publish_batch`` call for a whole window of responses (rows:
+        reply_to, correlation_id, body, trace). Each traced row gets its
+        "respond" mark as the batch publish starts — publish_lag keeps its
+        queueing semantics (…→respond WAIT) and the publish itself is the
+        respond→publish WORK gap, now amortized over the window."""
+        items = []
+        for reply_to, corr, body, trace in rows:
+            if not reply_to:
+                continue  # replyless requests pay nothing
+            if trace is not None:
+                trace.mark("respond")
+            items.append((reply_to, body, Properties(correlation_id=corr)))
+        if not items:
+            return
+        if self._batch_publish:
+            self.app.broker.publish_batch(items)
+        else:
+            for reply_to, body, props in items:
+                self.app.broker.publish(reply_to, body, props)
+
     # holds-lock: _engine_lock
     def _revive_engine(self, now: float) -> None:
         """Elastic recovery: rebuild the engine and resubmit the pool from
@@ -1658,12 +1928,22 @@ class _QueueRuntime:
         except Exception:
             snapshot = []
             log.exception("mirror unreadable; pool lost (broker will redeliver)")
+        # Quality accounting survives the rebuild (ISSUE 9 satellite):
+        # /debug/quality counters are monotone across a crash revive or
+        # breaker demotion — the fresh engine starts from the dead one's
+        # accumulated histograms instead of zero.
+        try:
+            q_snapshot = self.engine.quality_checkpoint()
+        except Exception:
+            q_snapshot = None
+            log.exception("quality checkpoint unreadable; counters reset")
         try:
             self.engine.close()
         except Exception:
             log.exception("old engine close failed")
         self._bind_engine(self._make_engine())
         self.engine.restore(snapshot, now)
+        self.engine.quality_restore(q_snapshot)
         self.app.events.append("engine_revive", self.queue_cfg.name,
                                f"{len(snapshot)} players restored from mirror")
 
@@ -1955,6 +2235,9 @@ class _QueueRuntime:
                 # failure (the same flaky device the breaker exists for)
                 # must leave the old engine intact and serving.
                 candidate.restore(snapshot, swap_now)
+                # Degraded-period matches ride along: /debug/quality stays
+                # monotone across the re-promotion (ISSUE 9 satellite).
+                candidate.quality_restore(old.quality_checkpoint())
                 try:
                     old.close()
                 except Exception:

@@ -32,6 +32,9 @@ dispatch→oracle_…   work   oracle_step — delegated team/role oracle window
 device_step→seal    wait   readback_group_wait — results waiting for their
                            readback group to fill/go stale
 seal→collect        wait   readback_transfer — D2H in flight + collect poll
+*→encode            work   encode — batch response-body building (native
+                           batch encoder / Python fallback) for the
+                           window this trace settles in (ISSUE 9)
 *→respond           wait   publish_lag — outcome handling queued on the loop
                            BEFORE the actual broker publish started
 respond→publish     work   respond — the broker publish + settle itself
@@ -89,6 +92,7 @@ _BY_TARGET: dict[str, tuple[str, str]] = {
     "oracle_step": ("oracle_step", WORK),
     "readback_seal": ("readback_group_wait", WAIT),
     "collect": ("readback_transfer", WAIT),
+    "encode": ("encode", WORK),
     "respond": ("publish_lag", WAIT),
     "publish": ("publish_lag", WAIT),
     "dedup_replay": ("dedup_replay", WORK),

@@ -70,6 +70,18 @@ class Delivery:
     #: Safe to cache: the header is stamped once (setdefault) and survives
     #: redelivery on the same object.
     deadline: float = -1.0
+    #: Cached parse of the ``x-first-received`` header (stamped setdefault-
+    #: once by the ingress middleware, which fills this cache) — the
+    #: columnar flush reads it per lane, and a header parse per lane is
+    #: exactly the per-delivery hot-path work ISSUE 9 removes (matchlint's
+    #: perf rule now flags it). -1.0 = not cached.
+    first_received: float = -1.0
+    #: Batcher-submit sequence (per queue runtime): the batched admission
+    #: pass decides a cut window in ARRIVAL order even after the EDF sort
+    #: reordered it — batching must not reorder admission decisions.
+    #: Re-stamped on every submit, so redeliveries order by re-consume
+    #: time exactly as per-delivery admission did.
+    arrival: int = -1
 
 
 class _Queue:
@@ -441,6 +453,32 @@ class InProcBroker:
             self._pause(q)
         elif action == "resume":
             self._resume(q)
+
+    def publish_batch(self, items) -> None:
+        """Publish a whole window of RESPONSE messages in one call — the
+        window-granular egress seam (ISSUE 9): per-response publish()
+        bookkeeping (trace sampling, chaos seq accounting, fault rolls)
+        collapses to one loop of queue pushes. Items that DO need the
+        per-message machinery — a reply_to set (request publishes stamp
+        traces), a chaos schedule covering the queue (seq counters must
+        advance), or any publish-side fault injection armed — take the
+        full publish() path, so batching never changes semantics, only
+        per-call overhead. ``items``: (queue, body, Properties|None)."""
+        for queue, body, props in items:
+            props = props or Properties()
+            if (self.publish_faults_enabled
+                    or (self.chaos is not None and self.chaos.applies(queue))
+                    or (self.trace_enabled and props.reply_to)):
+                self.publish(queue, body, props)
+                continue
+            q = self._queues.get(queue)
+            if q is None:
+                self.stats["unroutable"] += 1
+                continue
+            self.stats["published"] += 1
+            q.messages.put_nowait(Delivery(
+                body=bytes(body), properties=props, queue=queue,
+                delivery_tag=next(self._tags)))
 
     def basic_consume(self, queue: str,
                       callback: Callable[[Delivery], Awaitable[None]],

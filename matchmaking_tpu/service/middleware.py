@@ -77,6 +77,10 @@ class DecodeMiddleware(Middleware):
             "x-first-received", ctx.received_at
         )
         try:
+            ctx.delivery.first_received = float(first_received)
+        except (TypeError, ValueError):
+            ctx.delivery.first_received = ctx.received_at
+        try:
             ctx.request = decode_request(
                 ctx.delivery.body,
                 reply_to=ctx.delivery.properties.reply_to,
@@ -132,8 +136,15 @@ class StampMiddleware(Middleware):
     time must still be stamped here so redelivery keeps the wait clock."""
 
     async def call(self, ctx: MessageContext, next: Next) -> None:  # noqa: A002
-        ctx.delivery.properties.headers.setdefault(
+        first = ctx.delivery.properties.headers.setdefault(
             "x-first-received", ctx.received_at)
+        # Cache the parse on the delivery: the columnar flush reads the
+        # stamp once per lane, and a header parse per lane is per-delivery
+        # hot-path work (ISSUE 9; matchlint perf rule).
+        try:
+            ctx.delivery.first_received = float(first)
+        except (TypeError, ValueError):
+            ctx.delivery.first_received = ctx.received_at
         await next()
 
 

@@ -276,6 +276,89 @@ class AdmissionController:
                 return SHED
         return ADMIT
 
+    def pre_decide(self, delivery, now: float) -> str:
+        """Batched-admission ingress pre-check (OverloadConfig.
+        batch_admission): the ONLY per-delivery admission work before the
+        window cut — stamp the default deadline, cache tier + deadline on
+        the delivery (the batcher's EDF cut key reads both), and settle
+        the two decisions that must not wait for a flush: already-expired-
+        at-receive (cancelled before any decode, exactly where the
+        per-delivery decide() cancelled it) and drain-mode shed. The
+        credit/occupancy ladder runs once per cut window in
+        ``decide_batch``."""
+        headers = delivery.properties.headers
+        if self.cfg.default_deadline_ms > 0:
+            # Stamp relative to first receive, not now (see decide()).
+            try:
+                first = float(headers.get("x-first-received", now))
+            except (TypeError, ValueError):
+                first = now
+            stamp_deadline(headers, first, self.cfg.default_deadline_ms / 1e3)
+        tier = self.tier_of_delivery(delivery)
+        delivery.tier = tier
+        deadline = deadline_of(headers)
+        delivery.deadline = deadline if deadline is not None else 0.0
+        if (deadline is not None and now >= deadline
+                and not delivery.redelivered):
+            # Redelivered expired copies flow through to the flush, where
+            # the terminal-replay probe wins over a contradictory
+            # post-deadline timeout (same carve-out as decide()'s caller).
+            return EXPIRED
+        if self.draining:
+            return SHED
+        return ADMIT
+
+    def decide_batch(self, deliveries, now: float, pool_size: int,
+                     pool_tiers: "Sequence[int] | None" = None) -> list[str]:
+        """One admission pass over a cut window (ISSUE 9): the exact
+        decide()/admit() ladder walk applied sequentially over the window's
+        CACHED tier/deadline columns — one ``pool_tier_counts`` read and
+        one Python loop per window instead of per delivery. Callers pass
+        deliveries in ARRIVAL order (batching must not reorder decisions);
+        per-tier held-credit counts evolve through the pass exactly as they
+        would have per delivery, so two identical ingress sequences shed
+        identically.
+
+        Returns ADMIT/SHED per row. Deadline-expired rows ADMIT with a
+        credit — the flush's post-decode deadline check cancels them after
+        the terminal-replay probe (identical to the per-delivery flow,
+        where they were admitted live and expired at batch formation);
+        their credit releases at that settle."""
+        decisions: list[str] = []
+        cap_in = self._eff(self.cfg.max_inflight)
+        cap_wait = self._eff(self.cfg.max_waiting)
+        for d in deliveries:
+            tier = d.tier
+            if d.deadline > 0.0 and now >= d.deadline:
+                self.admit(d.delivery_tag, tier)
+                decisions.append(ADMIT)
+                continue
+            if self.draining:
+                decisions.append(SHED)
+                continue
+            if cap_in and self._held_upto(tier) >= self._tier_cap(cap_in,
+                                                                  tier):
+                decisions.append(SHED)
+                continue
+            if cap_wait:
+                if pool_tiers is None or self.tiers == 1:
+                    pool_upto = pool_size
+                else:
+                    pool_upto = sum(pool_tiers[: tier + 1])
+                if (pool_upto + self._held_upto(tier)
+                        >= self._tier_cap(cap_wait, tier)):
+                    # shed_policy="oldest": admit over cap when a same-or-
+                    # lower-priority victim exists (debt settles at the
+                    # dispatch) — the decide() semantics, verbatim.
+                    if not (self.cfg.shed_policy == "oldest"
+                            and (self.tiers == 1 or pool_tiers is None
+                                 or any(pool_tiers[tier:]))):
+                        decisions.append(SHED)
+                        continue
+            self.admit(d.delivery_tag, tier)
+            decisions.append(ADMIT)
+        return decisions
+
     def admit(self, delivery_tag: int, tier: int = 0) -> None:
         if delivery_tag not in self._credits:
             tier = min(max(tier, 0), self.tiers - 1)

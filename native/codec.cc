@@ -362,31 +362,122 @@ int64_t mm_decode_requests(const char** bufs, const int32_t* lens, int32_t n,
 
 }  // extern "C"
 
-// ---- batch matched-response encoder ---------------------------------------
+// ---- batch response encoder ------------------------------------------------
 //
 // The egress twin of mm_decode_requests: one call builds the JSON bodies for
-// BOTH players of a window of matches (2 responses per match — at grouped-
-// readback match rates the per-response Python dict+json.dumps becomes the
-// service's next hot loop). Matches contract.encode_response's schema and
-// key order:
-//   {"status":"matched","player_id":P,"latency_ms":L,
-//    "match":{"match_id":M,"players":[A,B],"teams":[[A],[B]],"quality":Q}}
-// Float formatting: trailing-zero-stripped fixed decimals (3 for latency,
-// 6 for quality). Python emits repr(round(x, k)) which prints the shortest
-// digits; the two agree on the PARSED value (pinned by tests) though not
-// always byte-for-byte (e.g. "1.500"→"1.5" both ways, but Python can print
-// "0.1" where fixed gives "0.100000"→"0.1"). Replay caches store the
-// encoded bytes, so a player always sees a self-consistent body.
+// a whole window of responses (matched pairs, queued acks, timeouts, sheds —
+// at grouped-readback match rates the per-response Python dict+json.dumps is
+// the service's next hot loop). Bodies are BYTE-IDENTICAL to
+// contract.encode_response (pinned by the fuzz corpus in
+// tests/test_codec_fuzz.py): same key order, and floats formatted exactly as
+// Python's json.dumps(round(x, k)) — py_round replicates round()'s
+// correctly-rounded half-even decimal rounding via printf ("%.*f" is
+// correctly rounded with ties-to-even under glibc) + strtod, and py_repr
+// replicates float.__repr__'s shortest-round-trip digits + CPython's
+// fixed-vs-scientific threshold (fixed for -4 < dp <= 16). Rows the exact
+// contract cannot express natively (non-ASCII — json.dumps escapes to
+// \uXXXX from decoded text, which bytes-level C cannot see — or non-finite
+// floats) are flagged NEEDS_PYTHON per row and re-encoded by the Python
+// contract module, never approximated.
 
 namespace {
 
-// Escape one UTF-8 string into JSON (quotes added by caller's context).
-// Returns bytes written or -1 on overflow. Control chars use \u00XX.
+enum EncResult {
+  E_OK = 0,
+  E_OVERFLOW = 1,   // arena too small: caller retries with a bigger one
+  E_PY = 2,         // row needs the Python encoder (exact-contract fallback)
+};
+
+// round(x, k) as CPython computes it: correctly-rounded k-digit decimal
+// (ties to even) re-parsed to the nearest double.
+double py_round(double v, int decimals) {
+  char buf[512];
+  int len = snprintf(buf, sizeof buf, "%.*f", decimals, v);
+  if (len <= 0 || len >= (int)sizeof buf) return v;  // |v| ~ 1e308 handled;
+                                                     // unreachable otherwise
+  return strtod(buf, nullptr);
+}
+
+// float.__repr__(v): shortest digit string that round-trips, formatted with
+// CPython's fixed/scientific threshold. Returns bytes written, -1 on
+// overflow, -2 for non-finite input (NEEDS_PYTHON).
+int64_t py_repr(double v, char* out, int64_t cap) {
+  if (!std::isfinite(v)) return -2;
+  char digits[32];
+  int exp10 = 0;
+  {
+    char buf[64];
+    int prec;
+    for (prec = 0; prec < 17; ++prec) {  // prec+1 significant digits
+      int len = snprintf(buf, sizeof buf, "%.*e", prec, v);
+      if (len <= 0 || len >= (int)sizeof buf) return -2;
+      char* endp = nullptr;
+      double back = strtod(buf, &endp);
+      if (endp == buf + len && memcmp(&back, &v, sizeof v) == 0) break;
+    }
+    if (prec == 17) --prec;  // %.16e (17 digits) always round-trips
+    // Parse "[-]d.ddddde±XX" into bare digits + decimal exponent.
+    const char* p = buf;
+    if (*p == '-') ++p;
+    int nd = 0;
+    digits[nd++] = *p++;
+    if (*p == '.') {
+      ++p;
+      while (*p && *p != 'e' && *p != 'E') digits[nd++] = *p++;
+    }
+    while (*p && *p != 'e' && *p != 'E') ++p;
+    if (*p) exp10 = (int)strtol(p + 1, nullptr, 10);
+    // Strip trailing zeros the round-trip search may have kept (e.g. 10.0
+    // needs 1 digit but %.0e prints "1e+01" — already minimal; 1230.0
+    // prints "1.23e+03" at prec 2 — minimal too; zeros only survive when
+    // a shorter form failed to round-trip, where they are significant).
+    digits[nd] = '\0';
+  }
+  int nd = (int)strlen(digits);
+  int dp = exp10 + 1;  // digits before the decimal point (CPython's "dp")
+  char buf[64];
+  int w = 0;
+  if (v < 0.0 || (v == 0.0 && std::signbit(v))) buf[w++] = '-';
+  if (-4 <= exp10 && dp <= 16) {
+    // Fixed notation (CPython: -4 < dp <= 16, dp = exp10 + 1).
+    if (dp <= 0) {
+      buf[w++] = '0'; buf[w++] = '.';
+      for (int i = 0; i < -dp; ++i) buf[w++] = '0';
+      memcpy(buf + w, digits, nd); w += nd;
+    } else if (dp >= nd) {
+      memcpy(buf + w, digits, nd); w += nd;
+      for (int i = nd; i < dp; ++i) buf[w++] = '0';
+      buf[w++] = '.'; buf[w++] = '0';
+    } else {
+      memcpy(buf + w, digits, dp); w += dp;
+      buf[w++] = '.';
+      memcpy(buf + w, digits + dp, nd - dp); w += nd - dp;
+    }
+  } else {
+    // Scientific notation, CPython style: d[.ddd]e±XX (>= 2 exp digits).
+    buf[w++] = digits[0];
+    if (nd > 1) {
+      buf[w++] = '.';
+      memcpy(buf + w, digits + 1, nd - 1); w += nd - 1;
+    }
+    w += snprintf(buf + w, sizeof buf - w, "e%+03d", exp10);
+  }
+  if (w > cap) return -1;
+  memcpy(out, buf, w);
+  return w;
+}
+
+// Escape one ASCII string exactly as json.dumps (ensure_ascii default)
+// does. Returns bytes written, -1 on overflow, -2 when a byte >= 0x80 is
+// seen — json.dumps escapes non-ASCII from DECODED text (\uXXXX over code
+// points), which a bytes-level encoder cannot replicate; those rows take
+// the Python encoder.
 int64_t esc_json(const char* s, char* out, int64_t cap) {
   static const char* hex = "0123456789abcdef";
   int64_t w = 0;
   for (const char* p = s; *p; ++p) {
     unsigned char ch = (unsigned char)*p;
+    if (ch >= 0x80) return -2;
     if (ch == '"' || ch == '\\') {
       if (w + 2 > cap) return -1;
       out[w++] = '\\'; out[w++] = (char)ch;
@@ -403,61 +494,58 @@ int64_t esc_json(const char* s, char* out, int64_t cap) {
       }
     } else {
       if (w + 1 > cap) return -1;
-      out[w++] = (char)ch;  // UTF-8 bytes pass through (json allows raw)
+      out[w++] = (char)ch;
     }
   }
   return w;
-}
-
-// Fixed-decimal float with trailing zeros stripped (keeps >=1 fractional
-// digit so the JSON value stays a float, like Python's "0.0").
-int64_t fmt_float(double v, int decimals, char* out, int64_t cap) {
-  if (!std::isfinite(v)) return -1;  // "nan"/"inf" are not JSON; caller
-                                     // falls back to the Python encoder
-  char buf[64];
-  int len = snprintf(buf, sizeof buf, "%.*f", decimals, v);
-  if (len <= 0 || len >= (int)sizeof buf) return -1;
-  const char* dot = strchr(buf, '.');
-  if (dot) {
-    while (len > 0 && buf[len - 1] == '0') --len;
-    if (len > 0 && buf[len - 1] == '.') ++len;  // keep "x.0"
-  }
-  if (len > cap) return -1;
-  memcpy(out, buf, len);
-  return len;
 }
 
 struct Writer {
   char* out;
   int64_t cap;
   int64_t w = 0;
-  bool ok = true;
+  EncResult err = E_OK;
 
+  bool ok() const { return err == E_OK; }
   void lit(const char* s) {
     int64_t n = (int64_t)strlen(s);
-    if (!ok || w + n > cap) { ok = false; return; }
+    if (err != E_OK) return;
+    if (w + n > cap) { err = E_OVERFLOW; return; }
     memcpy(out + w, s, n); w += n;
   }
   void str(const char* s) {
-    if (!ok || w + 1 > cap) { ok = false; return; }
+    if (err != E_OK) return;
+    if (w + 1 > cap) { err = E_OVERFLOW; return; }
     out[w++] = '"';
     int64_t n = esc_json(s, out + w, cap - w);
-    if (n < 0) { ok = false; return; }
+    if (n < 0) { err = n == -1 ? E_OVERFLOW : E_PY; return; }
     w += n;
-    if (w + 1 > cap) { ok = false; return; }
+    if (w + 1 > cap) { err = E_OVERFLOW; return; }
     out[w++] = '"';
   }
+  // json.dumps(round(v, decimals)) byte for byte.
   void num(double v, int decimals) {
-    if (!ok) return;
-    int64_t n = fmt_float(v, decimals, out + w, cap - w);
-    if (n < 0) { ok = false; return; }
+    if (err != E_OK) return;
+    int64_t n = py_repr(py_round(v, decimals), out + w, cap - w);
+    if (n < 0) { err = n == -1 ? E_OVERFLOW : E_PY; return; }
     w += n;
+  }
+  void integer(int32_t v) {
+    if (err != E_OK) return;
+    char buf[16];
+    int n = snprintf(buf, sizeof buf, "%d", v);
+    if (w + n > cap) { err = E_OVERFLOW; return; }
+    memcpy(out + w, buf, n); w += n;
   }
 };
 
+// {"status":"matched","player_id":P,"latency_ms":L,"match":{"match_id":M,
+//  "players":[A,B],"teams":[[A],[B]],"quality":Q},"waited_ms":W
+//  [,"trace_id":T]} — contract.encode_response key order exactly.
 void encode_one_matched(Writer& wr, const char* pid, const char* mid,
                         const char* a, const char* b, double lat_ms,
-                        double quality) {
+                        double quality, double waited_ms,
+                        const char* trace_id) {
   wr.lit("{\"status\":\"matched\",\"player_id\":");
   wr.str(pid);
   wr.lit(",\"latency_ms\":");
@@ -470,7 +558,59 @@ void encode_one_matched(Writer& wr, const char* pid, const char* mid,
   wr.str(a); wr.lit("],["); wr.str(b);
   wr.lit("]],\"quality\":");
   wr.num(quality, 6);
-  wr.lit("}}");
+  wr.lit("},\"waited_ms\":");
+  wr.num(waited_ms, 3);
+  if (trace_id && trace_id[0]) {
+    wr.lit(",\"trace_id\":");
+    wr.str(trace_id);
+  }
+  wr.lit("}");
+}
+
+const char* kSimpleStatus[] = {"queued", "timeout", "shed"};
+
+// queued:  {"status":"queued","player_id":P,"latency_ms":L[,"trace_id":T]
+//           [,"tier":N]}
+// timeout: {"status":"timeout","player_id":P,"latency_ms":L[,"trace_id":T]
+//           [,"tier":N]}
+// shed:    {"status":"shed","player_id":P,"latency_ms":L,
+//           "retry_after_ms":R[,"trace_id":T][,"tier":N]}
+void encode_one_simple(Writer& wr, int32_t kind, const char* pid,
+                       double lat_ms, double retry_ms, const char* trace_id,
+                       int32_t tier) {
+  wr.lit("{\"status\":\"");
+  wr.lit(kSimpleStatus[kind]);
+  wr.lit("\",\"player_id\":");
+  wr.str(pid);
+  wr.lit(",\"latency_ms\":");
+  wr.num(lat_ms, 3);
+  if (kind == 2) {
+    wr.lit(",\"retry_after_ms\":");
+    wr.num(retry_ms, 3);
+  }
+  if (trace_id && trace_id[0]) {
+    wr.lit(",\"trace_id\":");
+    wr.str(trace_id);
+  }
+  if (tier >= 0) {
+    wr.lit(",\"tier\":");
+    wr.integer(tier);
+  }
+  wr.lit("}");
+}
+
+// Shared per-row epilogue: E_PY rows rewind to the row start and are
+// flagged NEEDS_PYTHON (status[j] = 1; Python re-encodes just that row);
+// E_OVERFLOW aborts the whole call (caller retries with a bigger arena).
+bool finish_row(Writer& wr, int64_t row_start, int32_t* status, int64_t j) {
+  if (wr.err == E_PY) {
+    wr.w = row_start;
+    wr.err = E_OK;
+    status[j] = 1;
+  } else {
+    status[j] = 0;
+  }
+  return wr.err == E_OK;
 }
 
 }  // namespace
@@ -479,24 +619,52 @@ extern "C" {
 
 // Encode 2n matched responses (players a and b of n matches) into `arena`;
 // body j spans arena[off[j] .. off[j+1]) with order a0,b0,a1,b1,...
-// Returns bytes used, or -1 if the arena overflowed (caller retries
-// bigger). Strings are NUL-terminated UTF-8.
+// status[j]: 0 = OK, 1 = NEEDS_PYTHON (empty span; re-encode row j via the
+// Python contract). trace_a/trace_b may be NULL (no trace ids at all); ""
+// entries omit the key. Returns bytes used, or -1 if the arena overflowed
+// (caller retries bigger). Strings are NUL-terminated ASCII/UTF-8.
 int64_t mm_encode_matched(const char** id_a, const char** id_b,
                           const char** match_id, int32_t n,
                           const double* lat_a, const double* lat_b,
                           const double* quality,
-                          char* arena, int64_t cap, int64_t* off) {
+                          const double* waited_a, const double* waited_b,
+                          const char** trace_a, const char** trace_b,
+                          char* arena, int64_t cap, int64_t* off,
+                          int32_t* status) {
   Writer wr{arena, cap};
   for (int32_t i = 0; i < n; ++i) {
     off[2 * i] = wr.w;
     encode_one_matched(wr, id_a[i], match_id[i], id_a[i], id_b[i],
-                       lat_a[i], quality[i]);
+                       lat_a[i], quality[i], waited_a[i],
+                       trace_a ? trace_a[i] : nullptr);
+    if (!finish_row(wr, off[2 * i], status, 2 * i)) return -1;
     off[2 * i + 1] = wr.w;
     encode_one_matched(wr, id_b[i], match_id[i], id_a[i], id_b[i],
-                       lat_b[i], quality[i]);
-    if (!wr.ok) return -1;
+                       lat_b[i], quality[i], waited_b[i],
+                       trace_b ? trace_b[i] : nullptr);
+    if (!finish_row(wr, off[2 * i + 1], status, 2 * i + 1)) return -1;
   }
   off[2 * n] = wr.w;
+  return wr.w;
+}
+
+// Encode n queued/timeout/shed responses (kind[i]: 0/1/2). tier[i] < 0
+// omits the key (untiered services); trace_id may be NULL. Same status /
+// retry contract as mm_encode_matched.
+int64_t mm_encode_simple(const int32_t* kind, const char** player_id,
+                         const double* lat_ms, const double* retry_ms,
+                         const char** trace_id, const int32_t* tier,
+                         int32_t n, char* arena, int64_t cap, int64_t* off,
+                         int32_t* status) {
+  Writer wr{arena, cap};
+  for (int32_t i = 0; i < n; ++i) {
+    off[i] = wr.w;
+    if (kind[i] < 0 || kind[i] > 2) { status[i] = 1; continue; }
+    encode_one_simple(wr, kind[i], player_id[i], lat_ms[i], retry_ms[i],
+                      trace_id ? trace_id[i] : nullptr, tier[i]);
+    if (!finish_row(wr, off[i], status, i)) return -1;
+  }
+  off[n] = wr.w;
   return wr.w;
 }
 

@@ -19,9 +19,10 @@ gate compares what both rounds measured, so adding a new bench phase never
 fails old baselines.
 
 Compared metrics (direction-aware):
-    higher is better:  value (headline matches/s), e2e_matched_per_s,
-                       e2e_knee_req_s, e2e_slo_attainment,
-                       frontier quality_mean
+    higher is better:  value (headline matches/s), e2e_rate_req_s
+                       (ISSUE 9: the service-path headline the 8x-gap work
+                       moves), e2e_matched_per_s, e2e_knee_req_s,
+                       e2e_slo_attainment, frontier quality_mean
     lower is better:   p99_ms, e2e_p99_ms, frontier wait_at_match_ms_p99,
                        frontier quality_disparity
 Frontier rows (``e2e_frontier``, ISSUE 8) are matched by threshold.
@@ -38,6 +39,7 @@ import sys
 #: metric name → True when HIGHER is better.
 TOP_LEVEL_METRICS: dict[str, bool] = {
     "value": True,
+    "e2e_rate_req_s": True,
     "e2e_matched_per_s": True,
     "e2e_knee_req_s": True,
     "e2e_slo_attainment": True,

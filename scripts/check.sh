@@ -12,6 +12,19 @@ cd "$(dirname "$0")/.."
 echo "== matchlint =="
 JAX_PLATFORMS=cpu python -m matchmaking_tpu.analysis
 
+echo "== codec parity =="
+# ISSUE 9 gate: rebuild libmmcodec.so FROM SOURCE (force — CI must never
+# gate against the checked-in binary), then fuzz the native batch codec
+# vs the Python contract module: decode field-parity, encode
+# BYTE-identity (tests/test_codec_fuzz.py, `codec` marker).
+JAX_PLATFORMS=cpu python -c '
+from matchmaking_tpu.native import codec
+ok = codec.rebuild(force=True)
+print("libmmcodec.so rebuilt from source:", ok)
+raise SystemExit(0 if ok else 1)'
+JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'codec and not slow' \
+    --continue-on-collection-errors -p no:cacheprovider
+
 echo "== attribution smoke =="
 # ISSUE 6 fast gate: a seeded 400-player soak must decompose every settled
 # trace into work + wait that sums to its e2e span (telescoping identity),

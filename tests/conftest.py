@@ -61,6 +61,11 @@ def pytest_configure(config):
         "host accumulator reconciliation / disparity / quality SLO / "
         "waited_ms wire contract — scripts/check.sh runs it by marker; "
         "the fast ones are tier-1, soaks additionally carry `slow`)")
+    config.addinivalue_line(
+        "markers", "codec: native-codec parity fuzz (byte/field equality "
+        "vs the Python contract module over a seeded corpus — "
+        "scripts/check.sh runs it by marker after rebuilding "
+        "libmmcodec.so from source; part of tier-1)")
 
 
 @pytest.fixture

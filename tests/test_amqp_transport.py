@@ -360,3 +360,27 @@ async def test_amqp_chaos_partition_pause_and_resume():
         await drain(0.01)
     assert sorted(d.body for d in got) == [b"a", b"b", b"c", b"d"]
     broker.close()
+
+
+@pytest.mark.asyncio
+async def test_publish_batch_one_channel_op():
+    """ISSUE 9: publish_batch delivers a whole window of responses through
+    ONE _with_channel op; items with reply_to (trace-stamped requests)
+    fall back to the per-message publish() path."""
+    broker, server = make_broker()
+    broker.declare_queue("replies")
+    broker.declare_queue("req")
+    broker.publish_batch([
+        ("replies", b"r1", Properties(correlation_id="c1")),
+        ("replies", b"r2", Properties(correlation_id="c2")),
+        ("req", b"q1", Properties(reply_to="replies", correlation_id="c3")),
+    ])
+    assert broker.stats["published"] == 3
+    assert broker.queue_depth("replies") == 2
+    assert broker.queue_depth("req") == 1
+    got = await broker.get("replies", timeout=1.0)
+    assert got.body == b"r1" and got.properties.correlation_id == "c1"
+    # The reply_to item took the publish() path → trace header stamped.
+    req = await broker.get("req", timeout=1.0)
+    assert "x-trace-enqueue" in req.properties.headers
+    broker.close()

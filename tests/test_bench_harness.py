@@ -109,3 +109,28 @@ def test_init_backend_happy_path_unchanged():
                           text=True, timeout=300, env=env, cwd=REPO)
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert proc.stdout.startswith("OK")
+
+
+def test_bench_diff_gates_e2e_rate_and_p99():
+    """ISSUE 9 satellite: ``e2e_rate_req_s`` and ``e2e_p99_ms`` are
+    first-class direction-aware headline gates — a 20% rate drop or p99
+    rise regresses; improvements never do."""
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import bench_diff as bd
+    finally:
+        sys.path.pop(0)
+    base = {"e2e_rate_req_s": 10000.0, "e2e_p99_ms": 100.0}
+    worse = {"e2e_rate_req_s": 8000.0, "e2e_p99_ms": 130.0}
+    rows = bd.diff(base, worse, threshold=0.10)
+    flags = {r["metric"]: r["regressed"] for r in rows}
+    assert flags == {"e2e_rate_req_s": True, "e2e_p99_ms": True}
+    better = {"e2e_rate_req_s": 13000.0, "e2e_p99_ms": 60.0}
+    rows = bd.diff(base, better, threshold=0.10)
+    assert not any(r["regressed"] for r in rows)
+    # Direction-awareness: a HIGHER rate with a higher p99 regresses only
+    # on the p99 axis.
+    mixed = {"e2e_rate_req_s": 13000.0, "e2e_p99_ms": 130.0}
+    flags = {r["metric"]: r["regressed"]
+             for r in bd.diff(base, mixed, threshold=0.10)}
+    assert flags == {"e2e_rate_req_s": False, "e2e_p99_ms": True}

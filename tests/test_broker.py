@@ -273,3 +273,52 @@ async def test_trace_sample_n_wired_from_observability_config():
         assert rtq._trace(d) is None
     finally:
         await app.stop()
+
+
+@pytest.mark.asyncio
+async def test_publish_batch_delivers_and_counts(broker):
+    """ISSUE 9: publish_batch delivers a window of responses in one call —
+    same routing/unroutable semantics as publish(), one loop of pushes."""
+    broker.declare_queue("replies.a")
+    broker.declare_queue("replies.b")
+    before = broker.stats["published"]
+    broker.publish_batch([
+        ("replies.a", b"r1", Properties(correlation_id="c1")),
+        ("replies.b", b"r2", Properties(correlation_id="c2")),
+        ("replies.a", b"r3", None),
+        ("nowhere", b"lost", None),  # unroutable, counted not raised
+    ])
+    assert broker.stats["published"] == before + 3
+    assert broker.stats["unroutable"] == 1
+    d1 = await broker.get("replies.a", timeout=0.5)
+    d3 = await broker.get("replies.a", timeout=0.5)
+    d2 = await broker.get("replies.b", timeout=0.5)
+    assert (d1.body, d1.properties.correlation_id) == (b"r1", "c1")
+    assert d3.body == b"r3"
+    assert d2.properties.correlation_id == "c2"
+
+
+@pytest.mark.asyncio
+async def test_publish_batch_falls_back_for_faulty_or_stamped_items():
+    """Items needing per-message machinery (dup faults armed; reply_to set
+    → trace stamping) take the full publish() path inside the batch, so
+    batching changes overhead, never semantics."""
+    b = InProcBroker(BrokerConfig(dup_prob=1.0), seed=1)
+    try:
+        b.declare_queue("q")
+        b.publish_batch([("q", b"x", None)])
+        # dup_prob=1.0 duplicated through the publish() fallback.
+        assert b.stats["duplicated"] == 1
+        assert b.queue_depth("q") == 2
+    finally:
+        b.close()
+    b2 = InProcBroker(BrokerConfig())
+    try:
+        b2.declare_queue("req")
+        b2.publish_batch([
+            ("req", b"y", Properties(reply_to="rq", correlation_id="c")),
+        ])
+        d = await b2.get("req", timeout=0.5)
+        assert d.trace is not None  # request publishes still stamp traces
+    finally:
+        b2.close()

@@ -1,0 +1,237 @@
+"""Native-codec parity fuzz (ISSUE 9 satellite; ``codec`` marker).
+
+A seeded generator round-trips random requests and responses through the
+native batch codec vs the Python contract module — the semantic source of
+truth. The bar:
+
+- **decode**: every native-OK row field-equal to ``decode_request``; every
+  error row maps to the same ContractError class; every NEEDS_PYTHON row
+  must decode successfully in Python (the fallback path cannot dead-end);
+- **encode**: every native body BYTE-identical to ``encode_response`` —
+  including the float formatting (``repr(round(x, k))``: shortest
+  round-trip digits, half-even decimal rounding, CPython's
+  fixed-vs-scientific threshold) — and every None row (non-ASCII /
+  non-finite / NUL) re-encodable through the Python contract.
+
+scripts/check.sh runs this by marker after rebuilding libmmcodec.so from
+source, so CI never depends on the checked-in binary.
+"""
+
+import json
+import math
+import random
+
+import numpy as np
+import pytest
+
+from matchmaking_tpu.native import codec
+from matchmaking_tpu.service.contract import (
+    ANY,
+    ContractError,
+    MatchResult,
+    SearchResponse,
+    decode_request,
+    encode_response,
+)
+
+pytestmark = [
+    pytest.mark.codec,
+    pytest.mark.skipif(not codec.available(),
+                       reason="native codec unavailable (no g++?)"),
+]
+
+#: Corpus size per direction; ~milliseconds per thousand rows.
+N = 1500
+
+
+def _rand_float(rng: random.Random) -> float:
+    """Floats spanning the formats repr can produce: subnormal-ish tiny,
+    fixed-range, integral, huge (scientific), negative, decimal-tie
+    values, and exact binary fractions."""
+    k = rng.random()
+    if k < 0.18:
+        return rng.uniform(0.0, 1.0)
+    if k < 0.36:
+        return rng.uniform(0.0, 1e5)
+    if k < 0.46:
+        return float(rng.randint(0, 10**6))
+    if k < 0.56:
+        return rng.uniform(0.0, 1e-4)
+    if k < 0.66:
+        return rng.uniform(1e10, 1e18)
+    if k < 0.76:
+        return -rng.uniform(0.0, 1e4)
+    if k < 0.86:
+        return rng.randint(0, 10**6) / 2.0 ** rng.randint(0, 12)
+    return rng.choice([0.0, -0.0, 0.0625, 2.675, 0.1 + 0.2, 1e16,
+                       9999999999999998.0, 1e-5, 1.0005, 2.5e-3])
+
+
+def _rand_id(rng: random.Random) -> str:
+    pool = ("plain", 'quo"te', "back\\slash", "tab\there", "nl\ninside",
+            "ctl\x01\x1f", "sp ace", "unicode-é", "emoji-🎮", "")
+    if rng.random() < 0.7:
+        return f"p{rng.randrange(10**6)}"
+    return rng.choice(pool) + str(rng.randrange(100))
+
+
+# ---------------------------------------------------------------------------
+# decode: requests
+
+
+def test_fuzz_decode_requests_vs_contract():
+    rng = random.Random(20260803)
+    bodies: list[bytes] = []
+    for i in range(N):
+        roll = rng.random()
+        if roll < 0.08:
+            # Malformed/garbled payloads.
+            bodies.append(rng.choice([
+                b"not json", b"[1,2]", b'{"rating":1}', b'{"id":"x"}',
+                b'{"id":"x","rating":"hi"}', b'{"id":7,"rating":1}',
+                b'{"id":"x","rating":+5}', b'{"id":"x","rating":5.}',
+                b'{"id":"x","rating":1e7}',
+                b'{"id":"x","rating":1,"rating_deviation":-2}',
+                b'{"id":"x","rating":1,"rating_threshold":0}',
+            ]))
+            continue
+        payload: dict = {"id": _rand_id(rng),
+                         "rating": _rand_float(rng) % 9e4}
+        if rng.random() < 0.5:
+            payload["rating_deviation"] = rng.uniform(0.0, 350.0)
+        if rng.random() < 0.4:
+            payload["region"] = rng.choice(["eu", "na", "apac", "*"])
+        if rng.random() < 0.4:
+            payload["game_mode"] = rng.choice(["ranked", "casual"])
+        if rng.random() < 0.3:
+            payload["rating_threshold"] = rng.uniform(0.5, 400.0)
+        if rng.random() < 0.1:
+            payload["roles"] = ["tank", "dps"]
+        if rng.random() < 0.1:
+            payload["party"] = [{"id": f"q{i}", "rating": 1500}]
+        if rng.random() < 0.15:
+            payload["junk"] = {"nested": [1, None, {"a": "b"}]}
+        bodies.append(json.dumps(payload).encode())
+    out = codec.decode_batch(bodies)
+    assert out is not None
+    ids, rating, rd, thr, regions, modes, status = out
+    n_ok = n_py = 0
+    for i, body in enumerate(bodies):
+        st = int(status[i])
+        try:
+            py = decode_request(body)
+        except ContractError as err:
+            # Python rejects: native must reject with the same class, or
+            # punt to Python (which reports the same error downstream).
+            assert st != codec.OK, body
+            if st != codec.NEEDS_PYTHON:
+                assert codec.error_code(st) == err.code, body
+            continue
+        # Python accepts: native must accept with equal fields, or punt.
+        assert st in (codec.OK, codec.NEEDS_PYTHON), body
+        if st == codec.NEEDS_PYTHON:
+            n_py += 1
+            continue
+        n_ok += 1
+        assert ids[i] == py.id
+        assert rating[i] == pytest.approx(py.rating, rel=1e-6, abs=1e-6)
+        assert rd[i] == pytest.approx(py.rating_deviation, rel=1e-6)
+        if py.rating_threshold is None:
+            assert math.isnan(thr[i])
+        else:
+            assert thr[i] == pytest.approx(py.rating_threshold, rel=1e-6)
+        assert (regions[i] or ANY) == py.region
+        assert (modes[i] or ANY) == py.game_mode
+    assert n_ok > N // 2  # the fast path must carry the bulk of the corpus
+
+
+# ---------------------------------------------------------------------------
+# encode: matched pairs
+
+
+def test_fuzz_encode_matched_byte_identical():
+    rng = random.Random(99)
+    ids_a = [_rand_id(rng) for _ in range(N)]
+    ids_b = [_rand_id(rng) for _ in range(N)]
+    mids = [f"m{rng.randrange(16**12):012x}" for _ in range(N)]
+    lat_a = np.array([_rand_float(rng) for _ in range(N)])
+    lat_b = np.array([_rand_float(rng) for _ in range(N)])
+    qual = np.array([rng.uniform(0.0, 1.0) for _ in range(N)])
+    wa = np.array([_rand_float(rng) for _ in range(N)])
+    wb = np.array([_rand_float(rng) for _ in range(N)])
+    # Sprinkle non-finite floats: those SIDES must come back None.
+    for j in rng.sample(range(N), 20):
+        lat_a[j] = rng.choice([float("nan"), float("inf"), -float("inf")])
+    tr_a = ["" if rng.random() < 0.5 else f"tr{j}" for j in range(N)]
+    bodies = codec.encode_matched_batch(ids_a, ids_b, mids, lat_a, lat_b,
+                                        qual, wa, wb, tr_a, None)
+    assert bodies is not None and len(bodies) == 2 * N
+    n_py = 0
+    for j in range(N):
+        result = MatchResult(match_id=mids[j], players=(ids_a[j], ids_b[j]),
+                             teams=((ids_a[j],), (ids_b[j],)),
+                             quality=float(qual[j]))
+        for side, (pid, lat, w, tid) in enumerate((
+                (ids_a[j], lat_a[j], wa[j], tr_a[j]),
+                (ids_b[j], lat_b[j], wb[j], ""))):
+            native = bodies[2 * j + side]
+            if not math.isfinite(lat):
+                # json.dumps would emit non-strict Infinity/NaN — the
+                # native encoder refuses rather than approximating.
+                if side == 0:
+                    assert native is None
+                continue
+            ascii_pair = all(ord(c) < 128 for c in ids_a[j] + ids_b[j])
+            py = encode_response(SearchResponse(
+                status="matched", player_id=pid, latency_ms=float(lat),
+                waited_ms=float(w), trace_id=tid, match=result))
+            if native is None:
+                n_py += 1
+                assert not ascii_pair or not math.isfinite(
+                    lat_a[j] if side else lat)  # a reason must exist
+                assert json.loads(py)["player_id"] == pid  # fallback works
+                continue
+            assert native == py, (pid, lat, w)
+    assert n_py < N  # non-ASCII/non-finite rows only
+
+
+# ---------------------------------------------------------------------------
+# encode: queued / timeout / shed
+
+
+def test_fuzz_encode_simple_byte_identical():
+    rng = random.Random(7)
+    kinds = [rng.randrange(3) for _ in range(N)]
+    pids = [_rand_id(rng) for _ in range(N)]
+    lat = np.array([_rand_float(rng) for _ in range(N)])
+    retry = np.array([abs(_rand_float(rng)) for _ in range(N)])
+    traces = ["" if rng.random() < 0.5 else f"t{j}" for j in range(N)]
+    tiers = np.array([-1 if rng.random() < 0.5 else rng.randrange(4)
+                      for _ in range(N)], np.int32)
+    bodies = codec.encode_simple_batch(kinds, pids, lat, retry, traces,
+                                       tiers)
+    assert bodies is not None
+    statuses = {codec.KIND_QUEUED: "queued", codec.KIND_TIMEOUT: "timeout",
+                codec.KIND_SHED: "shed"}
+    n_py = 0
+    for j in range(N):
+        py = encode_response(SearchResponse(
+            status=statuses[kinds[j]], player_id=pids[j],
+            latency_ms=float(lat[j]), retry_after_ms=float(retry[j]),
+            trace_id=traces[j],
+            tier=None if tiers[j] < 0 else int(tiers[j])))
+        if bodies[j] is None:
+            n_py += 1
+            assert any(ord(c) >= 128 for c in pids[j]), pids[j]
+            assert json.loads(py)["status"] == statuses[kinds[j]]
+            continue
+        assert bodies[j] == py, (kinds[j], pids[j], lat[j])
+    assert n_py < N // 4
+
+
+def test_rebuild_from_source(tmp_path):
+    """codec.rebuild(): the CI seam — the library must (re)build from
+    codec.cc on demand and come back available (check.sh calls this so
+    the parity gate never tests a stale checked-in .so)."""
+    assert codec.rebuild() is True
+    assert codec.available()

@@ -500,6 +500,62 @@ class Engine:
     assert clean == []
 
 
+def test_perf_flags_per_delivery_header_parse_in_hot_loop():
+    """ISSUE 9: a headers[...] subscript or headers.get(...) call inside a
+    loop in a hot-path function is per-delivery wire work the
+    window-granular path removed — parse once at admission, cache on the
+    Delivery."""
+    findings = analyze_source('''
+class Runtime:
+    def _flush_columnar(self, deliveries, now):
+        tiers = []
+        for d in deliveries:
+            tiers.append(int(d.properties.headers["x-tier"]))
+        return tiers
+
+    def _handle_columnar_out(self, out, deliveries, now):
+        return [d.properties.headers.get("x-deadline") for d in deliveries]
+''', path="matchmaking_tpu/service/fixture.py")
+    assert sorted(_rules(findings)) == ["perf", "perf"]
+    assert "header parse" in findings[0].message
+    # The cached read (no header touch) is the sanctioned form.
+    clean = analyze_source('''
+class Runtime:
+    def _flush_columnar(self, deliveries, now):
+        return [(d.tier, d.deadline) for d in deliveries]
+
+    def _on_delivery(self, delivery):
+        # Not hot-path-named: the once-per-delivery admission parse site.
+        return delivery.properties.headers.get("x-tier")
+''', path="matchmaking_tpu/service/fixture.py")
+    assert clean == []
+
+
+def test_perf_flags_per_element_encode_response_in_hot_loop():
+    """ISSUE 9: encode_response() per element inside _flush_*/_handle_*
+    is the egress hot loop the native batch encoder replaced."""
+    findings = analyze_source('''
+from matchmaking_tpu.service.contract import encode_response
+
+class Runtime:
+    def _handle_columnar_out(self, out, responses):
+        return [encode_response(r) for r in responses]
+''', path="matchmaking_tpu/service/fixture.py")
+    assert _rules(findings) == ["perf"]
+    assert "encode_response" in findings[0].message
+    # Outside a loop (one-off response) it is fine, as is the batch call.
+    clean = analyze_source('''
+from matchmaking_tpu.service.contract import encode_response
+from matchmaking_tpu.native import codec
+
+class Runtime:
+    def _handle_columnar_out(self, out, resp, rows):
+        bodies = codec.encode_simple_batch(*rows)
+        return encode_response(resp)
+''', path="matchmaking_tpu/service/fixture.py")
+    assert clean == []
+
+
 def test_perf_inline_ignore_with_reason_suppresses():
     body = '''
 class Engine:

@@ -173,79 +173,100 @@ class TestAgainstPythonDecoder:
         assert n_ok >= 250  # fast path covers the overwhelming majority
 
 
+def _py_matched(pid, ids_a, ids_b, mid, lat, qual, waited, trace_id=""):
+    from matchmaking_tpu.service.contract import (
+        MatchResult,
+        SearchResponse,
+        encode_response,
+    )
+
+    return encode_response(SearchResponse(
+        status="matched", player_id=pid, latency_ms=float(lat),
+        waited_ms=float(waited), trace_id=trace_id,
+        match=MatchResult(match_id=mid, players=(ids_a, ids_b),
+                          teams=((ids_a,), (ids_b,)), quality=float(qual))))
+
+
 class TestNativeEncoder:
-    """Batch matched-response encoder vs contract.encode_response: parsed-
-    value equivalence (byte formats may differ in trailing float zeros)."""
+    """Batch response encoder vs contract.encode_response: BYTE-identical
+    for every row the native path claims (status OK); rows it cannot
+    express exactly (non-ASCII, non-finite, NUL) come back None and the
+    caller re-encodes through the Python contract."""
 
-    def test_parsed_equivalence_varied(self):
-        import json
-
-        import numpy as np
-
-        from matchmaking_tpu.service.contract import (
-            MatchResult,
-            SearchResponse,
-            encode_response,
-        )
-
-        if not codec.available():
-            import pytest
-
-            pytest.skip("native codec unavailable")
-        ids_a = ["alice", 'q"uote', "back\\slash", "unié", "tab\there"]
+    def test_matched_byte_identical_varied(self):
+        ids_a = ["alice", 'q"uote', "back\\slash", "ctl\x01", "tab\there"]
         ids_b = ["bob", "b2", "b3", "b4", "b5"]
         mids = [f"m{i}" for i in range(5)]
         lat_a = np.array([12.3456, 0.0, 0.00004, 1.5, 99999.999])
         lat_b = np.array([1.0, 2.25, 3.875, 0.125, 7.0])
         qual = np.array([0.987654321, 1.0, 0.0, 0.5, 0.333333333])
+        wa = np.array([10.0, 0.5, 0.0, 1.25, 3e-7])
+        wb = np.array([0.0, 1.0, 2.0, 3.0, 4.0])
+        tr_a = ["", "t1", "", "t3", ""]
         bodies = codec.encode_matched_batch(ids_a, ids_b, mids, lat_a, lat_b,
-                                            qual)
+                                            qual, wa, wb, tr_a, None)
         assert bodies is not None and len(bodies) == 10
         for i in range(5):
-            for side, (pid, lat) in enumerate(((ids_a[i], lat_a[i]),
-                                               (ids_b[i], lat_b[i]))):
-                native = json.loads(bodies[2 * i + side])
-                py = json.loads(encode_response(SearchResponse(
-                    status="matched", player_id=pid,
-                    latency_ms=round(float(lat), 3),
-                    match=MatchResult(match_id=mids[i],
-                                      players=(ids_a[i], ids_b[i]),
-                                      teams=((ids_a[i],), (ids_b[i],)),
-                                      quality=float(qual[i])))))
-                assert native["status"] == py["status"] == "matched"
-                assert native["player_id"] == py["player_id"]
-                assert abs(native["latency_ms"] - py["latency_ms"]) < 5e-4
-                nm, pm = native["match"], py["match"]
-                assert nm["match_id"] == pm["match_id"]
-                assert nm["players"] == pm["players"]
-                assert nm["teams"] == pm["teams"]
-                assert abs(nm["quality"] - pm["quality"]) < 5e-7
+            assert bodies[2 * i] == _py_matched(
+                ids_a[i], ids_a[i], ids_b[i], mids[i], lat_a[i], qual[i],
+                wa[i], tr_a[i])
+            assert bodies[2 * i + 1] == _py_matched(
+                ids_b[i], ids_a[i], ids_b[i], mids[i], lat_b[i], qual[i],
+                wb[i])
+
+    def test_simple_byte_identical(self):
+        import json
+
+        from matchmaking_tpu.service.contract import (
+            SearchResponse,
+            encode_response,
+        )
+
+        kinds = [codec.KIND_QUEUED, codec.KIND_TIMEOUT, codec.KIND_SHED]
+        pids = ["p0", "p1", ""]
+        lat = np.array([0.0, 1234.5678, 0.125])
+        retry = np.array([0.0, 0.0, 250.0])
+        traces = ["tq", "", "ts"]
+        tiers = np.array([-1, 2, 0], np.int32)
+        bodies = codec.encode_simple_batch(kinds, pids, lat, retry, traces,
+                                           tiers)
+        assert bodies is not None
+        statuses = ["queued", "timeout", "shed"]
+        for i in range(3):
+            py = encode_response(SearchResponse(
+                status=statuses[i], player_id=pids[i],
+                latency_ms=float(lat[i]), retry_after_ms=float(retry[i]),
+                trace_id=traces[i],
+                tier=None if tiers[i] < 0 else int(tiers[i])))
+            assert bodies[i] == py
+            assert json.loads(bodies[i])["status"] == statuses[i]
 
     def test_empty_batch(self):
-        if not codec.available():
-            import pytest
+        assert codec.encode_matched_batch([], [], [], [], [], [],
+                                          [], []) == []
+        assert codec.encode_simple_batch([], [], []) == []
 
-            pytest.skip("native codec unavailable")
-        assert codec.encode_matched_batch([], [], [],
-                                          [], [], []) == []
-
-    def test_nul_and_nonfinite_fall_back_to_python(self):
-        import numpy as np
-
-        if not codec.available():
-            import pytest
-
-            pytest.skip("native codec unavailable")
-        # Embedded NUL in an id: c_char_p would truncate -> must refuse.
-        assert codec.encode_matched_batch(
-            ["a\x00b"], ["bob"], ["m1"],
-            np.array([1.0]), np.array([1.0]), np.array([0.5])) is None
-        # Non-finite floats are not strict JSON -> must refuse.
-        assert codec.encode_matched_batch(
-            ["a"], ["b"], ["m1"],
-            np.array([float("nan")]), np.array([1.0]),
-            np.array([0.5])) is None
-        assert codec.encode_matched_batch(
-            ["a"], ["b"], ["m1"],
-            np.array([1.0]), np.array([1.0]),
-            np.array([float("inf")])) is None
+    def test_exotic_rows_fall_back_per_row(self):
+        # Embedded NUL: c_char_p would truncate -> that row is None.
+        bodies = codec.encode_matched_batch(
+            ["a\x00b", "c"], ["bob", "dan"], ["m1", "m2"],
+            np.array([1.0, 2.0]), np.array([1.0, 2.0]),
+            np.array([0.5, 0.5]), np.array([0.0, 0.0]),
+            np.array([0.0, 0.0]))
+        assert bodies is not None
+        assert bodies[0] is None and bodies[1] is None  # a-side id is bad
+        assert bodies[2] == _py_matched("c", "c", "dan", "m2", 2.0, 0.5, 0.0)
+        assert bodies[3] == _py_matched("dan", "c", "dan", "m2", 2.0, 0.5,
+                                        0.0)
+        # Non-finite floats are not strict JSON -> that SIDE is None.
+        bodies = codec.encode_matched_batch(
+            ["a"], ["b"], ["m1"], np.array([float("nan")]),
+            np.array([1.0]), np.array([0.5]), np.array([0.0]),
+            np.array([0.0]))
+        assert bodies[0] is None and bodies[1] is not None
+        # Non-ASCII ids: json.dumps escapes over decoded text -> both
+        # sides of the match carry the id, so both fall back.
+        bodies = codec.encode_matched_batch(
+            ["unié"], ["b"], ["m1"], np.array([1.0]), np.array([1.0]),
+            np.array([0.5]), np.array([0.0]), np.array([0.0]))
+        assert bodies[0] is None and bodies[1] is None

@@ -524,6 +524,83 @@ def test_pool_deadline_sweep_cancels_exactly(sanitizer):
     asyncio.run(run())
 
 
+# ---- tier-aware rescan selection --------------------------------------------
+
+def test_rescan_selects_lowest_tier_deadline_first():
+    """ISSUE 9 satellite (PR 7 follow-up): when a rescan tick cannot cover
+    the whole pool, it picks the lowest-(tier, deadline) slots first — the
+    EDF sort key over the QoS mirror columns — instead of oldest-first; an
+    untiered deadline-less pool keeps the old oldest-first order."""
+    import numpy as np
+
+    from matchmaking_tpu.config import EngineConfig
+    from matchmaking_tpu.engine.interface import make_engine
+    from matchmaking_tpu.service.contract import RequestColumns
+
+    def cols(ids, tiers, deadlines, enqueued):
+        n = len(ids)
+        return RequestColumns(
+            ids=np.asarray(ids, object),
+            rating=np.asarray([1000.0 + 300.0 * i for i in range(n)],
+                              np.float32),  # unmatchable: gaps >> threshold
+            rd=np.zeros(n, np.float32),
+            region=np.zeros(n, np.int32),
+            mode=np.zeros(n, np.int32),
+            threshold=np.full(n, 10.0, np.float32),
+            enqueued_at=np.asarray(enqueued, np.float64),
+            reply_to=np.asarray([""] * n, object),
+            correlation_id=np.asarray([""] * n, object),
+            tier=np.asarray(tiers, np.int32),
+            deadline=np.asarray(deadlines, np.float64),
+        )
+
+    cfg = Config(engine=EngineConfig(backend="tpu", pool_capacity=64,
+                                     pool_block=32, batch_buckets=(16,)))
+    q = QueueConfig(name="mm.resel", rating_threshold=10.0)
+    engine = make_engine(cfg, q)
+    try:
+        now = 1000.0
+        # Arrival order p0..p3 (p0 oldest): oldest-first would pick p0,p1.
+        engine.search_columns_async(
+            cols(["p0", "p1", "p2", "p3"],
+                 tiers=[2, 1, 0, 1],
+                 deadlines=[0.0, now + 50.0, 0.0, now + 20.0],
+                 enqueued=[now, now + 1, now + 2, now + 3]), now)
+        engine.flush()
+        assert engine.pool_size() == 4
+        tok = engine.rescan_async(2, now + 5)
+        assert tok is not None
+        pending = engine._pending[-1]
+        chosen = sorted(pending.chunks[0][0][0].ids.tolist())
+        # Lowest (tier, deadline) first: p2 (tier 0), then p3 (tier 1,
+        # earlier deadline than p1). Never p0 (tier 2) despite being
+        # oldest.
+        assert chosen == ["p2", "p3"]
+        engine.flush()
+    finally:
+        engine.close()
+
+    # Untiered pin: zero tiers + zero deadlines reduce to oldest-first
+    # (fresh engine — the tiered pool above must not interfere).
+    engine = make_engine(cfg, q)
+    try:
+        now = 1000.0
+        engine.search_columns_async(
+            cols(["o0", "o1", "o2"], tiers=[0, 0, 0],
+                 deadlines=[0.0, 0.0, 0.0],
+                 enqueued=[now + 2, now, now + 1]), now)
+        engine.flush()
+        assert engine.pool_size() == 3
+        tok = engine.rescan_async(2, now + 5)
+        assert tok is not None
+        pending = engine._pending[-1]
+        chosen = sorted(pending.chunks[0][0][0].ids.tolist())
+        assert chosen == ["o1", "o2"]  # the two oldest
+        engine.flush()
+    finally:
+        engine.close()
+
+
 # ---- loadgen tier mix -------------------------------------------------------
 
 def test_loadgen_tier_mix_accounting(sanitizer):

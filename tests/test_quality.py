@@ -675,16 +675,102 @@ def test_waited_ms_wire_roundtrip():
     back = decode_response(body)
     assert back.waited_ms == pytest.approx(80.5, abs=1e-3)
     assert back.match.quality == pytest.approx(0.75)
-    # splice path (native bodies get waited_ms appended post-encode)
-    from matchmaking_tpu.service.app import _body_with_waited
+    # Native batch-encoded bodies carry waited_ms directly (ISSUE 9: the
+    # PR 8 post-encode splice helpers are gone — the C encoder emits the
+    # byte-identical contract body).
+    from matchmaking_tpu.native import codec
 
-    plain = encode_response(SearchResponse(
-        status="matched", player_id="p1",
-        match=MatchResult("m1", ("p1", "p2"), (("p1",), ("p2",)),
-                          quality=0.75),
-        latency_ms=120.0))
-    spliced = decode_response(_body_with_waited(plain, 42.125))
-    assert spliced.waited_ms == pytest.approx(42.125, abs=1e-3)
+    if codec.available():
+        bodies = codec.encode_matched_batch(
+            ["p1"], ["p2"], ["m1"], np.array([120.0]), np.array([120.0]),
+            np.array([0.75]), np.array([42.125]), np.array([42.125]))
+        assert bodies is not None
+        native = decode_response(bodies[0])
+        assert native.waited_ms == pytest.approx(42.125, abs=1e-3)
     # non-matched responses don't carry the key
     shed = encode_response(SearchResponse(status="shed", player_id=""))
     assert b"waited_ms" not in shed
+
+
+def test_quality_counters_survive_chaos_crash_revive(sanitizer):
+    """ISSUE 9 satellite (PR 8 follow-up): engine quality accumulators
+    survive a crash revive — a scripted chaos device-step fault nacks its
+    window and rebuilds the engine from the mirror, and /debug/quality's
+    sample counters keep COUNTING UP across the swap instead of resetting
+    (checkpointed via Engine.quality_checkpoint/quality_restore)."""
+    async def run():
+        q = QueueConfig(name="mm.qrev", rating_threshold=100.0,
+                        send_queued_ack=False)
+        cfg = Config(
+            queues=(q,),
+            engine=EngineConfig(backend="tpu", pool_capacity=64,
+                                pool_block=32, batch_buckets=(16,),
+                                pipeline_depth=2, breaker_threshold=0),
+            batcher=BatcherConfig(max_batch=16, max_wait_ms=2.0),
+            # Step 0: the first pair's window — matches cleanly. Step 1:
+            # the second pair's window — scripted device fault, nack +
+            # revive; the redelivery matches on the fresh engine (step 2).
+            chaos=ChaosConfig(seed=3, queues=(q.name,), fail_steps=(1,)),
+        )
+        app = MatchmakingApp(cfg)
+        reply = "qrev.replies"
+        app.broker.declare_queue(q.name)
+        app.broker.declare_queue(reply)
+        await app.start()
+        rt = app.runtime(q.name)
+        try:
+            for i in range(2):
+                app.broker.publish(
+                    q.name, f'{{"id":"a{i}","rating":1500}}'.encode(),
+                    Properties(reply_to=reply, correlation_id=f"a{i}"))
+            await _wait_for(lambda: app.metrics.counters.get(
+                "players_matched") >= 2)
+
+            async def samples() -> int:
+                # The device-side accumulator snapshot is async (refreshed
+                # every quality_report_every windows) — force the readback
+                # under the engine lock so the report shows exact totals.
+                async with rt._engine_lock:
+                    await asyncio.to_thread(rt.engine._quality_force_sync)
+                return rt.engine.quality_report()["samples"]
+
+            assert await samples() == 2
+            # Second pair: its window hits the scripted step fault.
+            for i in range(2):
+                app.broker.publish(
+                    q.name, f'{{"id":"b{i}","rating":1520}}'.encode(),
+                    Properties(reply_to=reply, correlation_id=f"b{i}"))
+            await _wait_for(lambda: app.metrics.counters.get(
+                "players_matched") >= 4)
+            assert app.metrics.counters.get("engine_crashes") >= 1
+            revives = [e for e in app.events.snapshot()
+                       if e["kind"] == "engine_revive"]
+            assert revives, "scripted fault must have revived the engine"
+            # THE regression: monotone across the revive — the fresh
+            # engine reports the dead engine's samples plus its own.
+            assert await samples() == 4
+        finally:
+            await app.stop()
+
+    asyncio.run(run())
+
+
+def test_quality_checkpoint_restore_units():
+    """Engine.quality_checkpoint/quality_restore: the CPU engine round-
+    trips its accumulator arrays, and restore MERGES (adds) rather than
+    replaces."""
+    cfg = Config(engine=EngineConfig(backend="cpu"))
+    q = QueueConfig(name="u", rating_threshold=50.0)
+    e1 = make_engine(cfg, q)
+    e1.quality_accum.observe([1500.0, 1520.0], 0.9, [1.0, 2.0], 20.0)
+    snap = e1.quality_checkpoint()
+    assert snap is not None and int(snap["count"].sum()) == 2
+    e2 = make_engine(cfg, q)
+    e2.quality_accum.observe([1400.0], 0.5, [0.5], 10.0)
+    e2.quality_restore(snap)
+    assert e2.quality_report()["samples"] == 3
+    # Mutating the checkpoint after the fact must not alias e1's arrays.
+    snap["count"][:] = 99
+    assert e1.quality_report()["samples"] == 2
+    e2.quality_restore(None)  # tolerated no-op
+    assert e2.quality_report()["samples"] == 3
