@@ -1,7 +1,6 @@
-"""matchlint — the project's concurrency-and-compile static analyzer.
+"""matchlint — the project's concurrency, lifecycle and device analyzer.
 
-Five project-specific rules (see each module's docstring for the full
-contract):
+Lexical rules (PR 4–9; see each module's docstring for the contract):
 
 - ``await-under-lock``  (locks.py)       suspension points inside
   ``async with <lock>`` bodies that aren't the sanctioned off-loop seam.
@@ -13,12 +12,33 @@ contract):
   deadlines that break chaos-replay determinism.
 - ``recompile``         (recompile.py)   jaxpr drift across same-shape
   traces + Python-scalar closure captures in the kernel modules.
+- ``perf``              (perf.py)        O(pool)/O(matches) host scans
+  inside hot-path-named functions.
+
+Flow-sensitive rules (ISSUE 10, on the dataflow.py CFG + fixed-point
+substrate — ``await``/calls are implicit exception edges):
+
+- ``settlement``        (lifecycle.py)   exactly-once delivery
+  settlement: credit leaks on exception paths, double-settles through
+  helper calls, conditionally-settled windows; interprocedural contracts
+  via ``# settles:`` / ``# settles-some:`` / ``# owns:`` annotations.
+- ``lock-pairing``      (lifecycle.py)   balanced explicit
+  ``acquire()``/``release()`` on every path.
+- ``device``            (device_audit.py) jaxpr device-path audit:
+  host callbacks under jit, host-syncs in kernel modules, donated-buffer
+  use-after-donation, per/cross-family dtype drift, padded-lane sentinel
+  contamination, ppermute ring consistency — trace-only, no device
+  execution.
+- ``stale-ignore``      (core.py)        active ignores that suppress
+  nothing anymore.
 
 Run ``python -m matchmaking_tpu.analysis`` (or ``scripts/matchlint.py``)
 from the repo root; ``pytest -m lint`` runs the same gate as a test node.
-Suppress intentional findings inline with an ignore comment naming the
-rule plus a reason (syntax in core.py), or accept them in
-``analysis/baseline.json``.
+``--format=json``, ``--changed-only`` and a content-hash result cache
+keep editor/pre-commit/CI runs fast. Suppress intentional findings
+inline with an ignore comment naming the rule plus a reason (syntax in
+core.py), or accept them in ``analysis/baseline.json``
+(``--write-baseline`` / ``--update-baseline``).
 """
 
 from matchmaking_tpu.analysis.core import (  # noqa: F401

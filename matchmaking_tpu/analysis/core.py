@@ -39,6 +39,10 @@ RULES = (
     "determinism",
     "recompile",
     "perf",
+    "settlement",
+    "lock-pairing",
+    "device",
+    "stale-ignore",
 )
 
 
@@ -64,27 +68,65 @@ _IGNORE_RE = re.compile(
     r"#\s*matchlint:\s*ignore\[([a-z\-, ]+)\]\s*(\S.*)?")
 
 
+def _comment_lines(lines: list[str],
+                   source: str | None) -> "list[tuple[int, str]]":
+    """(lineno, comment text) for every REAL comment token.  Tokenizing
+    (rather than regex over raw lines) keeps ignore syntax quoted inside
+    docstrings and test-fixture strings from registering as live ignores —
+    which the stale-ignore rule would otherwise flag forever."""
+    if source is None:
+        return list(enumerate(lines, start=1))
+    import io
+    import tokenize
+
+    try:
+        return [(tok.start[0], tok.string)
+                for tok in tokenize.generate_tokens(
+                    io.StringIO(source).readline)
+                if tok.type == tokenize.COMMENT]
+    except (tokenize.TokenError, IndentationError):  # pragma: no cover
+        return list(enumerate(lines, start=1))
+
+
 class IgnoreMap:
     """Per-file map of line → rules suppressed there. An ignore covers its
     own line and the line below it (so a comment can sit above a long
-    statement). Ignores without a reason are INACTIVE."""
+    statement). Ignores without a reason are INACTIVE.
 
-    def __init__(self, lines: list[str]):
-        self._by_line: dict[int, set[str]] = {}
+    Usage is tracked per (comment line, rule): an active ignore that
+    suppresses nothing in a full-rules run becomes a ``stale-ignore``
+    finding itself (suppression hygiene — dead ignores hide future real
+    findings at the same line)."""
+
+    def __init__(self, lines: list[str], source: str | None = None):
+        #: line → {(rule, owning comment line)}.
+        self._by_line: dict[int, set[tuple[str, int]]] = {}
+        #: (comment line, rules named there) for every ACTIVE ignore.
+        self.entries: list[tuple[int, frozenset[str]]] = []
         self.bare: list[int] = []  # ignores missing the required reason
-        for i, text in enumerate(lines, start=1):
+        #: (comment line, rule) pairs that suppressed at least one finding
+        #: this run (filled by apply_ignores).
+        self.used: set[tuple[int, str]] = set()
+        for i, text in _comment_lines(lines, source):
             m = _IGNORE_RE.search(text)
             if not m:
                 continue
             if not (m.group(2) or "").strip():
                 self.bare.append(i)
                 continue
-            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
-            self._by_line.setdefault(i, set()).update(rules)
-            self._by_line.setdefault(i + 1, set()).update(rules)
+            rules = frozenset(r.strip() for r in m.group(1).split(",")
+                              if r.strip())
+            self.entries.append((i, rules))
+            for rule in rules:
+                self._by_line.setdefault(i, set()).add((rule, i))
+                self._by_line.setdefault(i + 1, set()).add((rule, i))
 
     def suppressed(self, line: int, rule: str) -> bool:
-        return rule in self._by_line.get(line, ())
+        for r, comment_line in self._by_line.get(line, ()):
+            if r == rule:
+                self.used.add((comment_line, rule))
+                return True
+        return False
 
 
 class SourceFile:
@@ -97,7 +139,7 @@ class SourceFile:
             self.text = f.read()
         self.lines = self.text.splitlines()
         self.tree = ast.parse(self.text, filename=relpath)
-        self.ignores = IgnoreMap(self.lines)
+        self.ignores = IgnoreMap(self.lines, source=self.text)
 
     def line_at(self, lineno: int) -> str:
         if 1 <= lineno <= len(self.lines):
@@ -169,6 +211,27 @@ def apply_ignores(findings: list[Finding],
     return kept
 
 
+def stale_ignores(sources: "Iterable[SourceFile]") -> list[Finding]:
+    """Suppression hygiene: every ACTIVE ignore that suppressed nothing in
+    this (full-rules) run is itself a finding.  Call after apply_ignores —
+    usage marks accumulate there."""
+    out: list[Finding] = []
+    for sf in sources:
+        for comment_line, rules in sf.ignores.entries:
+            dead = [r for r in sorted(rules)
+                    if r != "stale-ignore"
+                    and (comment_line, r) not in sf.ignores.used]
+            if dead:
+                out.append(Finding(
+                    "stale-ignore", sf.path, comment_line,
+                    f"ignore[{','.join(dead)}] no longer suppresses any "
+                    f"finding — the violation it excused is gone; delete "
+                    f"the comment (dead ignores silently hide FUTURE "
+                    f"findings on this line)",
+                    f"ignore@{comment_line}"))
+    return out
+
+
 # ---- baseline --------------------------------------------------------------
 
 def load_baseline(path: str) -> list[dict]:
@@ -189,6 +252,22 @@ def write_baseline(path: str, findings: list[Finding]) -> None:
     with open(path, "w", encoding="utf-8") as f:
         json.dump({"findings": entries}, f, indent=2, sort_keys=True)
         f.write("\n")
+
+
+def update_baseline(path: str, findings: list[Finding]) -> tuple[int, int]:
+    """Rewrite the baseline IN PLACE: drop entries no current finding
+    matches (their violations are fixed), keep matching entries with their
+    hand-written reasons verbatim.  Returns (kept, dropped)."""
+    baseline = load_baseline(path)
+    current = {f.fingerprint() for f in findings}
+    kept = [e for e in baseline
+            if (e.get("rule", ""), e.get("path", ""),
+                e.get("context", "")) in current]
+    dropped = len(baseline) - len(kept)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"findings": kept}, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return len(kept), dropped
 
 
 def split_by_baseline(

@@ -421,11 +421,15 @@ class _MethodChecker(ast.NodeVisitor):
         self.generic_visit(node)
 
 
-def check(sources: list[SourceFile]) -> list[Finding]:
+def check(sources: list[SourceFile],
+          external: "ExternalContracts | None" = None) -> list[Finding]:
     findings: list[Finding] = []
     # Pass 1: cross-class contracts (the declaring class and its callers
-    # live in different files, so the registry spans all sources).
-    external = collect_external(sources)
+    # live in different files, so the registry spans all sources).  The
+    # cache-aware driver passes a registry collected over the FULL tree
+    # while checking one file at a time.
+    if external is None:
+        external = collect_external(sources)
     for sf in sources:
         if not in_package(sf):
             continue

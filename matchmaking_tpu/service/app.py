@@ -431,6 +431,7 @@ class _QueueRuntime:
 
     # ---- settle + admission (overload control) ----------------------------
 
+    # settles: delivery
     def _ack(self, delivery: Delivery) -> None:
         """Ack + release the delivery's admission credit. EVERY runtime
         settle path comes through here (or _nack): the credit limiter's
@@ -440,6 +441,7 @@ class _QueueRuntime:
         if self.admission is not None:
             self.admission.release(delivery.delivery_tag)
 
+    # settles: delivery
     def _nack(self, delivery: Delivery, requeue: bool = True) -> None:
         """Nack twin of _ack. The credit is released even on requeue: the
         redelivery re-enters through admission and takes a fresh credit
@@ -449,6 +451,7 @@ class _QueueRuntime:
         if self.admission is not None:
             self.admission.release(delivery.delivery_tag)
 
+    # settles: delivery
     def _shed_delivery(self, delivery: Delivery) -> None:
         """Explicit rejection under overload: a ``shed`` response with a
         retry-after hint, acked — never silent rot in an unbounded queue.
@@ -475,6 +478,7 @@ class _QueueRuntime:
         if tr is not None:
             self._settle_trace(delivery, "shed")
 
+    # settles: delivery
     def _expire_delivery(self, delivery: Delivery, now: float,
                          player_id: str = "") -> None:
         """Deadline-expired: cancel without dispatch. The ``expired`` trace
@@ -534,6 +538,7 @@ class _QueueRuntime:
 
     # ---- window-granular admission (ISSUE 9) ------------------------------
 
+    # settles-some: deliveries
     def _admission_cut(self, deliveries: list[Delivery],
                        now: float) -> "set[int] | None":
         """The batched admission ladder over one cut window: ONE
@@ -557,6 +562,7 @@ class _QueueRuntime:
         self._shed_deliveries(shed)
         return {d.delivery_tag for d in shed}
 
+    # settles: *deliveries
     def _shed_deliveries(self, deliveries: list[Delivery]) -> None:
         """Batched twin of ``_shed_delivery`` for a window's shed rows:
         identical per-row accounting (one record_shed EVENT per row — the
@@ -659,6 +665,32 @@ class _QueueRuntime:
                     self._shed_delivery(delivery)
                 return
             self.admission.admit(delivery.delivery_tag, delivery.tier)
+            try:
+                await self._ingress_submit(delivery, received_at, tr)
+            except BaseException:
+                # Any crash between the admit above and the batcher
+                # hand-off is settled by the BROKER layer (the consumer's
+                # crash handler nacks without coming through _nack), which
+                # would strand this delivery's admission credit: over AMQP
+                # every redelivery carries a fresh tag, so leaked credits
+                # accumulate until the queue sheds 100% of traffic.  ONE
+                # wrapper owns the whole post-admit region — the
+                # settlement rule (analysis/lifecycle.py) proved the old
+                # per-call guards left the MessageContext build and the
+                # inter-try trace marks on unprotected exception edges.
+                self.admission.release(delivery.delivery_tag)
+                raise
+            return
+        await self._ingress_submit(delivery, received_at, tr)
+
+    # settles: delivery
+    async def _ingress_submit(self, delivery: Delivery, received_at: float,
+                              tr: "TraceContext | None") -> None:
+        """Post-admission ingress: middleware (or the inline stamp) + the
+        batcher hand-off.  On a normal return the delivery is either
+        settled (middleware reject) or owned by the batcher; on an
+        exception the CALLER settles (credit release in the per-delivery
+        admission wrapper, broker-level nack above that)."""
         if self._inline_ingress:
             # Columnar + auth "none" (ISSUE 9): the whole middleware chain
             # is the first-received stamp — run it inline instead of
@@ -690,16 +722,6 @@ class _QueueRuntime:
                 tr.mark("reject")
                 self._settle_trace(delivery, "rejected")
             return
-        except BaseException:
-            # Any other ingress crash is settled by the BROKER layer (the
-            # consumer's crash handler nacks without coming through _nack),
-            # which would strand this delivery's admission credit: over
-            # AMQP every redelivery carries a fresh tag, so leaked credits
-            # accumulate until the queue sheds 100% of traffic. Release
-            # before the broker takes over; the redelivery re-admits.
-            if self.admission is not None:
-                self.admission.release(delivery.delivery_tag)
-            raise
         if tr is not None:
             tr.mark("batch")
         # Arrival stamp: the batched admission pass re-orders the (possibly
@@ -709,24 +731,19 @@ class _QueueRuntime:
         # per-delivery admission decided it.
         delivery.arrival = self._arrival_seq
         self._arrival_seq += 1
-        try:
-            if ctx.request is None:
-                # Columnar ingress: the pipeline left decoding to the
-                # batched native codec (1v1 queues) — middleware only ran
-                # auth/validity checks that need headers.
-                self.batcher.submit((None, delivery))
-                return
-            if tr is not None:
-                tr.player_id = ctx.request.id
-            self.batcher.submit((ctx.request, delivery))
-        except BaseException:
-            # Same leak via a closed/crashed batcher submit.
-            if self.admission is not None:
-                self.admission.release(delivery.delivery_tag)
-            raise
+        if ctx.request is None:
+            # Columnar ingress: the pipeline left decoding to the
+            # batched native codec (1v1 queues) — middleware only ran
+            # auth/validity checks that need headers.
+            self.batcher.submit((None, delivery))
+            return
+        if tr is not None:
+            tr.player_id = ctx.request.id
+        self.batcher.submit((ctx.request, delivery))
 
     # ---- the window flush: THE seam into Engine.search --------------------
 
+    # settles: *window
     async def _flush(self, window: list[tuple[SearchRequest, Delivery]]) -> None:
         self._flushing += 1
         try:
@@ -746,6 +763,7 @@ class _QueueRuntime:
         finally:
             self._flushing -= 1
 
+    # settles: *window
     async def _flush_inner(self, window: list[tuple[SearchRequest, Delivery]]) -> None:
         if self._columnar:
             await self._flush_columnar([d for _, d in window])
@@ -905,6 +923,7 @@ class _QueueRuntime:
         delivery.first_received = first
         return first
 
+    # settles-some: delivery
     def _decode_or_reject(self, delivery: Delivery,
                           now: float) -> SearchRequest | None:
         """Decode one delivery through the semantic codec; a ContractError
@@ -948,6 +967,7 @@ class _QueueRuntime:
             out.append((req, delivery))
         return out
 
+    # settles: *deliveries
     async def _flush_columnar(self, deliveries: list[Delivery]) -> None:
         """Columnar window flush, window-granular end to end (ISSUE 9):
         batched admission pass → batched native decode → batch dedup probe
@@ -1184,6 +1204,7 @@ class _QueueRuntime:
                                 deliveries[s] for s, pid, _ in keep
                                 if pid not in drop]
                             if not len(cols):
+                                # matchlint: ignore[settlement] empty residue: every kept row was a debt victim _pay_debt_locked settled (shed+ack)
                                 return
                     outs = await asyncio.to_thread(run_engine)
                     # Error check + failed-token bookkeeping stay INSIDE
@@ -1206,7 +1227,9 @@ class _QueueRuntime:
                 return
             for tok, out in outs:
                 self._merge_window_marks(tok, deliveries_in)
+                # matchlint: ignore[settlement] depth-1 branch: flush() returns exactly this one window, so the loop body runs once
                 self._handle_columnar_out(out, by_id, deliveries_in, now)
+            # matchlint: ignore[settlement] outs is never empty here (the window just dispatched always lands in flush())
             return
 
         # Pipelined path: dispatch without waiting; outcomes (publish + ack)
@@ -1225,6 +1248,7 @@ class _QueueRuntime:
 
     # ---- pipelined collection ---------------------------------------------
 
+    # settles-some: pairs
     def _settle_terminal_locked(self, pairs: list[tuple[str, Delivery]],
                                 now: float) -> set[str]:
         """Second dedup-cache check, run under the engine lock immediately
@@ -1256,6 +1280,7 @@ class _QueueRuntime:
         return stale
 
     # holds-lock: _engine_lock
+    # settles-some: pairs
     def _settle_expired_locked(self, pairs: list[tuple[str, Delivery]],
                                now: float) -> set[str]:
         """Deadline check #3 (pre-dispatch), run under the engine lock
@@ -1306,6 +1331,7 @@ class _QueueRuntime:
         return out
 
     # holds-lock: _engine_lock
+    # settles-some: entering
     async def _pay_debt_locked(self, entering: "list[tuple[str, int, float, Delivery]]",
                                debt: int, now: float) -> set[str]:
         """Settle the occupancy debt for one dispatching window. Untiered:
@@ -1382,6 +1408,7 @@ class _QueueRuntime:
             self._remember(req.id, body, now)
             self._publish_body(req.reply_to, req.correlation_id, body)
 
+    # settles: *pairs
     async def _dispatch_pipelined(self, dispatch,
                                   pairs: list[tuple[str, Delivery]],
                                   now: float) -> None:
@@ -1456,6 +1483,7 @@ class _QueueRuntime:
             # Once meta is recorded the revive path settles this window
             # exactly once (salvage-ack or stale-meta nack) — passing
             # extra_nack too would double-settle the same delivery tags.
+            # matchlint: ignore[settlement] `recorded` mirrors the meta hand-off exactly: extra_nack is None on every path where the window escaped
             await self._revive_pipelined(
                 now, extra_nack=None if recorded else deliveries_in)
             return
@@ -1513,7 +1541,7 @@ class _QueueRuntime:
                     return
                 self._publish_rescan_outcome(out, now)
             return
-        by_id, deliveries = meta
+        by_id, deliveries = meta  # owns: deliveries
         self._merge_window_marks(tok, deliveries)
         if tok in self.engine.failed_tokens:
             self.engine.failed_tokens.discard(tok)
@@ -1559,6 +1587,7 @@ class _QueueRuntime:
         return {d.trace.player_id: d.trace for d in deliveries
                 if d.trace is not None and d.trace.player_id}
 
+    # settles: *deliveries
     def _handle_columnar_out(self, out, by_id: dict[str, Delivery],
                              deliveries: list[Delivery], now: float) -> None:
         """Publish one collected window's outcome and ack its deliveries."""
@@ -1622,6 +1651,7 @@ class _QueueRuntime:
         m.counters.inc("windows")
         m.counters.inc("requests_batched", len(deliveries))
 
+    # settles: *deliveries
     def _handle_object_out(self, out, deliveries: list[Delivery],
                            now: float) -> None:
         """Publish one collected OBJECT window's outcome (device team
@@ -1659,6 +1689,7 @@ class _QueueRuntime:
         self.engine.device_error = None
         self._revive_engine(now)
 
+    # settles: *extra_nack
     async def _revive_pipelined(self, now: float,
                                 extra_nack: list[Delivery] | None = None) -> None:
         """Dispatch-path crash with windows possibly in flight: salvage what

@@ -29,6 +29,18 @@ service's own ``asyncio.Lock()`` calls come back instrumented:
   lock convoys — one slow engine step serializing every queue behind the
   engine lock — show up as a fat p99 at one site; ``assert_clean`` quotes
   the slowest sites so a failing soak names its convoy.
+- **settlement twin** (ISSUE 10) — the dynamic half of the static
+  ``settlement`` typestate (analysis/lifecycle.py): ``AdmissionController
+  .admit/release`` and the in-proc broker's app-facing ``ack``/``nack``
+  come back instrumented.  A second app-level settle of a delivery tag
+  that is no longer in flight (and was not requeued in between) is a
+  **double-settle**; an admission credit still held at ``assert_clean``
+  for a tag the broker already settled is a **credit leak** — both
+  reported with the acquire/settle sites quoted.  The broker's own crash
+  handler and cancel paths go through ``_Consumer.nack``/``_requeue``
+  directly, so at-least-once redelivery never trips the check — only the
+  app's settle seam is audited, which is exactly the static rule's scope,
+  measured instead of proved.
 
 Usage (the ``sanitizer`` fixture in tests/conftest.py wraps this):
 
@@ -178,14 +190,28 @@ class AsyncSanitizer:
         #: follow-up: make overload-induced lock convoys visible).
         self._holds: dict[str, _HoldStats] = {}
         self._orig_lock: Any = None
+        # ---- settlement twin state (ISSUE 10) -----------------------------
+        #: (id(controller), delivery_tag) → (controller, acquire site):
+        #: admission credits currently held.  The controller ref is pinned
+        #: for the sanitizer's test-scoped lifetime, so id() keys are
+        #: stable (same argument as ``_locks``).
+        self._credits: dict[tuple[int, int], tuple[Any, str]] = {}
+        #: delivery_tag → (kind, site) of the last app-level settle since
+        #: the delivery was (re)registered (tags are globally unique —
+        #: the in-proc broker draws them from one counter).
+        self._settles: dict[int, tuple[str, str]] = {}
 
     # ---- installation ------------------------------------------------------
 
     def installed(self):
-        """Context manager patching ``asyncio.Lock`` so every lock the code
-        under test creates is instrumented (InstrumentedLock subclasses the
-        real Lock, so isinstance checks and semantics are unchanged)."""
+        """Context manager patching ``asyncio.Lock`` (lock instrumentation)
+        plus the admission controller's admit/release and the in-proc
+        broker's app-facing ack/nack (the settlement twin) — every lock
+        and every settle the code under test performs reports here."""
         import contextlib
+
+        from matchmaking_tpu.service import broker as _broker_mod
+        from matchmaking_tpu.service import overload as _overload_mod
 
         san = self
 
@@ -193,16 +219,100 @@ class AsyncSanitizer:
             def __new__(cls, *a: Any, **k: Any):
                 return InstrumentedLock(san)
 
+        ac = _overload_mod.AdmissionController
+        br = _broker_mod.InProcBroker
+        orig_admit, orig_release = ac.admit, ac.release
+        orig_ack, orig_nack = br.ack, br.nack
+        orig_requeue = br._requeue
+
+        def admit(ctrl, delivery_tag: int, tier: int = 0) -> None:
+            if delivery_tag not in ctrl._credits:
+                san._credits[(id(ctrl), delivery_tag)] = (
+                    ctrl, _caller_site(__name__.replace(".", "/")))
+            orig_admit(ctrl, delivery_tag, tier)
+
+        def release(ctrl, delivery_tag: int) -> None:
+            san._credits.pop((id(ctrl), delivery_tag), None)
+            orig_release(ctrl, delivery_tag)
+
+        def ack(broker, consumer_tag: str, delivery_tag: int) -> None:
+            san._on_settle(broker, consumer_tag, delivery_tag, "ack")
+            orig_ack(broker, consumer_tag, delivery_tag)
+
+        def nack(broker, consumer_tag: str, delivery_tag: int,
+                 requeue: bool = True) -> None:
+            san._on_settle(broker, consumer_tag, delivery_tag, "nack")
+            orig_nack(broker, consumer_tag, delivery_tag, requeue)
+
+        def _requeue(broker, queue, delivery) -> None:
+            # Redelivery legitimizes a future settle of the SAME tag (the
+            # in-proc broker reuses the Delivery object): reset the twin's
+            # record so at-least-once redelivery never reads as a double.
+            # A dead-lettered delivery never re-enters, so its record must
+            # SURVIVE — a later second settle of that tag is still the
+            # double-settle class this twin exists to catch.
+            if delivery.redelivery_count < broker.cfg.max_redelivery:
+                san._settles.pop(delivery.delivery_tag, None)
+            orig_requeue(broker, queue, delivery)
+
         @contextlib.contextmanager
         def _cm():
             self._orig_lock = asyncio.Lock
             asyncio.Lock = _Factory  # type: ignore[misc]
+            ac.admit, ac.release = admit, release
+            br.ack, br.nack, br._requeue = ack, nack, _requeue
             try:
                 yield self
             finally:
                 asyncio.Lock = self._orig_lock  # type: ignore[misc]
+                ac.admit, ac.release = orig_admit, orig_release
+                br.ack, br.nack = orig_ack, orig_nack
+                br._requeue = orig_requeue
 
         return _cm()
+
+    # ---- settlement twin ---------------------------------------------------
+
+    def _on_settle(self, broker: Any, consumer_tag: str,
+                   delivery_tag: int, kind: str) -> None:
+        consumer = broker._consumers.get(consumer_tag)
+        if consumer is None:
+            return  # late settle after basic_cancel: documented no-op
+        site = _caller_site(__name__.replace(".", "/"))
+        if delivery_tag in consumer.unacked:
+            self._settles[delivery_tag] = (kind, site)
+            return
+        prev = self._settles.get(delivery_tag)
+        if prev is not None:
+            self._report(
+                "double-settle", ("settle", consumer_tag, delivery_tag,
+                                  prev[1], site),
+                f"delivery tag {delivery_tag} {kind}ed at {site} but it "
+                f"was already {prev[0]}ed at {prev[1]} (no redelivery in "
+                f"between) — the second settle acks a delivery the caller "
+                f"no longer owns")
+
+    def settlement_report(self) -> dict[str, Any]:
+        """Open credits + settle counts, for tests that drain fully and
+        want to assert the ledger is empty."""
+        return {
+            "open_credits": [
+                {"tag": tag, "queue": ctrl.queue, "acquired_at": site}
+                for (_cid, tag), (ctrl, site) in sorted(
+                    self._credits.items())
+            ],
+            "settled": len(self._settles),
+        }
+
+    def _check_settlement_leaks(self) -> None:
+        for (_cid, tag), (ctrl, site) in sorted(self._credits.items()):
+            if tag in self._settles:
+                self._report(
+                    "credit-leak", ("leak", ctrl.queue, tag),
+                    f"admission credit for delivery tag {tag} "
+                    f"(queue {ctrl.queue!r}) is still held after the "
+                    f"delivery settled at the broker — acquired at {site}; "
+                    f"the limiter's inflight count never recovers")
 
     # ---- reporting ---------------------------------------------------------
 
@@ -223,6 +333,7 @@ class AsyncSanitizer:
         return {site: stats.to_dict() for site, stats in rows}
 
     def assert_clean(self) -> None:
+        self._check_settlement_leaks()
         if self.findings:
             # Quote the slowest lock sites alongside the findings: an
             # overload-induced convoy (every queue serialized behind one

@@ -564,3 +564,435 @@ class Engine:
 '''
     assert analyze_source(
         body, path="matchmaking_tpu/engine/fixture.py") == []
+
+
+# ---- settlement (ISSUE 10: flow-sensitive exactly-once typestate) ----------
+
+def test_settlement_credit_leak_on_exception_path_at_exact_line():
+    """The flagship planted bug: an exception edge between admission.admit
+    and the release handler leaks a credit — caught at the exact line of
+    the statement whose raise escapes while the credit is held."""
+    findings = analyze_source('''
+class Runtime:
+    async def handle(self, delivery):
+        self.admission.admit(delivery.delivery_tag, delivery.tier)
+        ctx = self.make_context(delivery)
+        try:
+            await self.pipeline.run(ctx)
+        except BaseException:
+            self.admission.release(delivery.delivery_tag)
+            raise
+        self.batcher.submit((None, delivery))
+''', path="matchmaking_tpu/service/fixture.py")
+    leaks = [f for f in findings if f.rule == "settlement"]
+    assert {f.line for f in leaks} == {5, 11}, findings
+    assert all("credit leak" in f.message for f in leaks)
+    # line 5: make_context raising leaks; line 11: submit outside the try.
+
+
+def test_settlement_accepts_fully_wrapped_admit_region():
+    findings = analyze_source('''
+class Runtime:
+    async def handle(self, delivery):
+        self.admission.admit(delivery.delivery_tag, delivery.tier)
+        try:
+            ctx = self.make_context(delivery)
+            await self.pipeline.run(ctx)
+            self.batcher.submit((None, delivery))
+        except BaseException:
+            self.admission.release(delivery.delivery_tag)
+            raise
+''', path="matchmaking_tpu/service/fixture.py")
+    assert [f for f in findings if f.rule == "settlement"] == []
+
+
+def test_settlement_double_ack_across_helper_call_at_exact_line():
+    findings = analyze_source('''
+class Runtime:
+    # settles: delivery
+    def _ack(self, delivery):
+        self.app.broker.ack(self.consumer_tag, delivery.delivery_tag)
+        self.admission.release(delivery.delivery_tag)
+
+    # settles: delivery
+    def _shed(self, delivery):
+        self.respond(delivery)
+        self._ack(delivery)
+
+    def finish(self, delivery):
+        self._shed(delivery)
+        self._ack(delivery)
+''', path="matchmaking_tpu/service/fixture.py")
+    doubles = [f for f in findings if f.rule == "settlement"]
+    assert len(doubles) == 1, findings
+    assert doubles[0].line == 15
+    assert "double-settle" in doubles[0].message
+    assert doubles[0].context == "Runtime.finish"
+
+
+def test_settlement_collection_contract_and_vacuous_empty_shape():
+    """`# settles: *deliveries` demands settlement before a normal return;
+    the `if not window: return` emptiness shape and a settling loop both
+    discharge it — an unrelated early return does not."""
+    clean = analyze_source('''
+class Runtime:
+    # settles: delivery
+    def _ack(self, delivery):
+        self.app.broker.ack(self.consumer_tag, delivery.delivery_tag)
+
+    # settles: *deliveries
+    def _shed_all(self, deliveries):
+        metas = []
+        for d in deliveries:
+            tr = self.trace(d)
+            metas.append((d, tr))
+        if not metas:
+            return
+        self.publish_batch(metas)
+        for d, tr in metas:
+            self._ack(d)
+''', path="matchmaking_tpu/service/fixture.py")
+    assert [f for f in clean if f.rule == "settlement"] == []
+    leak = analyze_source('''
+class Runtime:
+    # settles: delivery
+    def _ack(self, delivery):
+        self.app.broker.ack(self.consumer_tag, delivery.delivery_tag)
+
+    # settles: *deliveries
+    def _shed_all(self, deliveries):
+        if self.closed:
+            return
+        for d in deliveries:
+            self._ack(d)
+''', path="matchmaking_tpu/service/fixture.py")
+    leaks = [f for f in leak if f.rule == "settlement"]
+    assert len(leaks) == 1 and leaks[0].line == 10, leak
+    assert "window leak" in leaks[0].message
+
+
+def test_settlement_escape_to_window_meta_is_a_handoff():
+    """Storing the window's pairs/deliveries into inflight meta transfers
+    ownership (collection settles at collection time) — no finding."""
+    findings = analyze_source('''
+class Runtime:
+    # settles: *pairs
+    async def _dispatch(self, pairs, now):
+        deliveries_in = [d for _, d in pairs]
+        tok = await self.to_thread(self.engine.go)
+        self._inflight_meta[tok] = (dict(pairs), deliveries_in)
+''', path="matchmaking_tpu/service/fixture.py")
+    assert [f for f in findings if f.rule == "settlement"] == []
+
+
+def test_settlement_admit_loop_without_settle_leaks_per_iteration():
+    findings = analyze_source('''
+class Runtime:
+    def admit_all(self, deliveries):
+        for d in deliveries:
+            self.admission.admit(d.delivery_tag)
+''', path="matchmaking_tpu/service/fixture.py")
+    leaks = [f for f in findings if f.rule == "settlement"
+             and "credit leak" in f.message]
+    assert leaks, findings
+    assert any(f.line in (3, 4) for f in leaks)
+
+
+# ---- lock-pairing ----------------------------------------------------------
+
+def test_lock_pairing_flags_unbalanced_paths_and_accepts_try_finally():
+    findings = analyze_source('''
+class Runtime:
+    def bad(self):
+        self._pool_lock.acquire()
+        self.step()
+        self._pool_lock.release()
+
+    def good(self):
+        self._pool_lock.acquire()
+        try:
+            self.step()
+        finally:
+            self._pool_lock.release()
+''', path="matchmaking_tpu/service/fixture.py")
+    pairs = [f for f in findings if f.rule == "lock-pairing"]
+    assert len(pairs) == 1, findings
+    assert pairs[0].context == "Runtime.bad"
+    assert "exception path" in pairs[0].message
+
+
+# ---- device (ISSUE 10: jaxpr device-path audit) ----------------------------
+
+def test_device_flags_host_item_inside_kernel_module_at_exact_line():
+    findings = analyze_source('''
+import jax
+
+class KS:
+    def _search_step(self, pool, batch, now):
+        cap = pool["rating"].item()
+        return pool
+''', path="matchmaking_tpu/engine/kernels.py")
+    dev = [f for f in findings if f.rule == "device"]
+    assert len(dev) == 1 and dev[0].line == 6, findings
+    assert ".item()" in dev[0].message
+
+
+def test_device_init_host_setup_is_exempt():
+    findings = analyze_source('''
+import numpy as np
+
+class KS:
+    def __init__(self, edges):
+        self._edges = np.asarray(edges)
+''', path="matchmaking_tpu/engine/kernels.py")
+    assert [f for f in findings if f.rule == "device"] == []
+
+
+def test_device_use_after_donation_flagged_and_rebind_accepted():
+    bad = analyze_source('''
+class Engine:
+    def step(self, packed):
+        pool2, out = self.kernels.search_step_packed(self._dev_pool, packed)
+        stale = self._dev_pool["rating"]
+        self._dev_pool = pool2
+        return out, stale
+''', path="matchmaking_tpu/engine/fixture.py")
+    dev = [f for f in bad if f.rule == "device"]
+    assert len(dev) == 1 and dev[0].line == 5, bad
+    assert "DONATED" in dev[0].message
+    good = analyze_source('''
+class Engine:
+    def step(self, packed):
+        self._dev_pool, out = self.kernels.search_step_packed(
+            self._dev_pool, packed)
+        fresh = self._dev_pool["rating"]
+        return out, fresh
+''', path="matchmaking_tpu/engine/fixture.py")
+    assert [f for f in good if f.rule == "device"] == []
+
+
+def test_device_padded_lane_taint_catches_unmasked_accumulator():
+    """The QualityAccumKernel shape: masked lanes carry the +inf dist
+    sentinel; a float-mask MULTIPLY is not a sanitizer (0 x inf = NaN) —
+    only a validity select is."""
+    import jax.numpy as jnp
+
+    from matchmaking_tpu.analysis import device_audit
+
+    def bad_accum(state, out):
+        dist = out[2]
+        q_slot = out[0].astype(jnp.int32)
+        valid = q_slot < 64
+        rf = valid.astype(jnp.float32)
+        return state + (rf * dist).sum()
+
+    bad = device_audit.check_padded_lanes(
+        bad_accum, (jnp.zeros(()), jnp.zeros((3, 8))), 1, "bad_accum")
+    assert len(bad) == 1 and "padded-lane contamination" in bad[0].message
+
+    def good_accum(state, out):
+        dist = out[2]
+        q_slot = out[0].astype(jnp.int32)
+        valid = q_slot < 64
+        d = jnp.where(valid, dist, 0.0)
+        rf = valid.astype(jnp.float32)
+        return state + (rf * d).sum()
+
+    assert device_audit.check_padded_lanes(
+        good_accum, (jnp.zeros(()), jnp.zeros((3, 8))), 1,
+        "good_accum") == []
+
+
+def test_device_dtype_drift_detected_via_eval_shape():
+    import jax.numpy as jnp
+
+    from matchmaking_tpu.analysis import device_audit
+
+    def upcast_step(pool, packed):
+        return dict(pool, rating=pool["rating"].astype(jnp.float16)), packed
+
+    out = []
+    device_audit._check_pool_preserved(
+        upcast_step, "fixture.step", "ctx",
+        {"rating": jnp.zeros(4, jnp.float32)}, (jnp.zeros(3),), out)
+    assert len(out) == 1 and "dtype drift" in out[0].message
+
+    def identity_step(pool, packed):
+        return pool, packed
+
+    clean = []
+    device_audit._check_pool_preserved(
+        identity_step, "fixture.id", "ctx",
+        {"rating": jnp.zeros(4, jnp.float32)}, (jnp.zeros(3),), clean)
+    assert clean == []
+
+
+def test_device_ring_audit_rejects_split_permutation():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from matchmaking_tpu.analysis import device_audit
+    from matchmaking_tpu.engine.sharded import _shard_map
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("pool",))
+
+    def bad_ring(x):
+        perm = [(0, 1), (1, 0), (2, 3), (3, 2)]  # two 2-cycles, no ring
+        return lax.ppermute(x, "pool", perm)
+
+    f = _shard_map(bad_ring, mesh=mesh, in_specs=P("pool"),
+                   out_specs=P("pool"))
+    closed = jax.make_jaxpr(f)(jnp.zeros(8))
+    out = []
+    device_audit._check_ring(closed, 4, "fixture.ring", "ctx", out)
+    assert len(out) == 1 and "not a single" in out[0].message
+
+    def good_ring(x):
+        perm = [(i, (i + 1) % 4) for i in range(4)]
+        return lax.ppermute(x, "pool", perm)
+
+    g = _shard_map(good_ring, mesh=mesh, in_specs=P("pool"),
+                   out_specs=P("pool"))
+    clean = []
+    device_audit._check_ring(jax.make_jaxpr(g)(jnp.zeros(8)), 4,
+                             "fixture.ring", "ctx", clean)
+    assert clean == []
+
+
+# ---- stale-ignore (suppression hygiene) ------------------------------------
+
+def test_stale_ignore_reports_dead_suppressions_and_spares_live_ones():
+    live = '''
+import time
+
+async def handler():
+    # matchlint: ignore[blocking-call] admin endpoint, bounded one-shot
+    time.sleep(0.1)
+'''
+    assert analyze_source(live,
+                          path="matchmaking_tpu/service/fixture.py") == []
+    dead = '''
+import asyncio
+
+async def handler():
+    # matchlint: ignore[blocking-call] nothing blocking here anymore
+    await asyncio.sleep(0.1)
+'''
+    findings = analyze_source(dead,
+                              path="matchmaking_tpu/service/fixture.py")
+    assert _rules(findings) == ["stale-ignore"]
+    assert findings[0].line == 5
+    assert "no longer suppresses" in findings[0].message
+
+
+def test_stale_ignore_skips_ignore_syntax_inside_strings():
+    findings = analyze_source('''
+DOC = """
+    # matchlint: ignore[blocking-call] this is documentation, not a comment
+"""
+''', path="matchmaking_tpu/service/fixture.py")
+    assert findings == []
+
+
+# ---- tooling: --format=json + cache ----------------------------------------
+
+def test_cli_json_format_is_machine_readable(capsys):
+    import json as _json
+
+    from matchmaking_tpu.analysis.engine import main
+
+    rc = main(["--static-only", "--no-cache", "--format=json"])
+    out = capsys.readouterr().out
+    data = _json.loads(out)
+    assert set(data) == {"findings", "baselined", "warnings"}
+    assert rc == (1 if data["findings"] else 0)
+
+
+def test_result_cache_replays_findings_for_unchanged_files(tmp_path):
+    import json as _json
+
+    from matchmaking_tpu.analysis import engine as _engine
+
+    root = tmp_path / "repo"
+    (root / "matchmaking_tpu" / "analysis").mkdir(parents=True)
+    # A tiny one-file tree with a known finding.
+    (root / "matchmaking_tpu" / "service").mkdir(parents=True)
+    (root / "matchmaking_tpu" / "service" / "fix.py").write_text(
+        "import time\n\n\nasync def handler():\n    time.sleep(0.1)\n")
+    new1, _, _ = _engine.analyze_repo(str(root), dynamic=False)
+    assert [f.rule for f in new1] == ["blocking-call"]
+    cache = _json.loads((root / ".matchlint_cache.json").read_text())
+    assert "matchmaking_tpu/service/fix.py" in cache["files"]
+    # Second run replays from cache, byte-identical findings.
+    new2, _, _ = _engine.analyze_repo(str(root), dynamic=False)
+    assert [(f.rule, f.path, f.line) for f in new1] == \
+        [(f.rule, f.path, f.line) for f in new2]
+
+
+# ---- review regressions: finally routing + suppression hygiene -------------
+
+def test_settlement_release_in_finally_balances_every_path():
+    """try/except-reraise/finally with the release in the finally is the
+    canonical balanced shape: handler raises route THROUGH the finally
+    (regression: the CFG once sent them past it), and the re-raise after
+    an exceptionally-entered finally carries the post-release state."""
+    findings = analyze_source('''
+class Runtime:
+    async def handle(self, delivery):
+        self.admission.admit(delivery.delivery_tag)
+        try:
+            await self.pipeline.run(delivery)
+        except BaseException:
+            self.log()
+            raise
+        finally:
+            self.admission.release(delivery.delivery_tag)
+''', path="matchmaking_tpu/service/fixture.py")
+    assert [f for f in findings if f.rule == "settlement"] == []
+
+
+def test_lock_pairing_release_in_finally_with_typed_handler():
+    findings = analyze_source('''
+class Runtime:
+    def locked(self):
+        self._pool_lock.acquire()
+        try:
+            self.step()
+        except ValueError:
+            self.log()
+            raise
+        finally:
+            self._pool_lock.release()
+''', path="matchmaking_tpu/service/fixture.py")
+    assert [f for f in findings if f.rule == "lock-pairing"] == []
+
+
+def test_settlement_branch_header_gets_no_exception_edge():
+    """A branch whose BODY contains calls must not leak at the header:
+    evaluating `self.flag` cannot raise (regression: may_raise once
+    walked the whole compound statement)."""
+    findings = analyze_source('''
+class Runtime:
+    def handle(self, delivery):
+        self.admission.admit(delivery.delivery_tag)
+        if self.flag:
+            self.admission.release(delivery.delivery_tag)
+        else:
+            self.admission.release(delivery.delivery_tag)
+''', path="matchmaking_tpu/service/fixture.py")
+    assert [f for f in findings if f.rule == "settlement"] == []
+
+
+def test_stale_ignore_findings_are_themselves_suppressible():
+    findings = analyze_source('''
+import asyncio
+
+async def handler():
+    # matchlint: ignore[stale-ignore] kept for a pending revert
+    # matchlint: ignore[blocking-call] nothing blocking here anymore
+    await asyncio.sleep(0.1)
+''', path="matchmaking_tpu/service/fixture.py")
+    assert findings == []

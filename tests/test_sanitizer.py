@@ -197,3 +197,99 @@ def test_assert_clean_failure_quotes_slowest_lock_sites():
         assert THIS_FILE in str(e)
     else:
         raise AssertionError("expected findings")
+
+
+# ---- settlement twin (ISSUE 10: dynamic exactly-once ledger) ----------------
+
+def _twin_broker_scenario(double_ack: bool, leak_credit: bool):
+    """Drive the REAL in-proc broker + admission controller through one
+    delivery under the sanitizer, with the two planted bugs togglable."""
+    from matchmaking_tpu.config import OverloadConfig
+    from matchmaking_tpu.service.broker import InProcBroker
+    from matchmaking_tpu.service.overload import AdmissionController
+
+    san = AsyncSanitizer(stall_threshold_s=60.0)
+    with san.installed():
+        async def main():
+            broker = InProcBroker()
+            ac = AdmissionController(
+                OverloadConfig(max_inflight=8), "fixture")
+            done = asyncio.Event()
+            state = {}
+
+            async def on_delivery(delivery):
+                ac.admit(delivery.delivery_tag)
+                broker.ack(state["tag"], delivery.delivery_tag)
+                if not leak_credit:
+                    ac.release(delivery.delivery_tag)
+                if double_ack:
+                    broker.ack(state["tag"], delivery.delivery_tag)
+                done.set()
+
+            state["tag"] = broker.basic_consume("q", on_delivery)
+            broker.publish("q", b"{}")
+            await asyncio.wait_for(done.wait(), 5.0)
+            broker.close()
+
+        asyncio.run(main())
+    return san
+
+
+def test_settlement_twin_reports_double_ack_with_both_sites():
+    san = _twin_broker_scenario(double_ack=True, leak_credit=False)
+    doubles = [f for f in san.findings if f.kind == "double-settle"]
+    assert len(doubles) == 1, san.findings
+    msg = doubles[0].message
+    assert msg.count(THIS_FILE) >= 2, msg  # first AND second settle sites
+    assert "already" in msg
+
+
+def test_settlement_twin_reports_credit_leak_with_acquire_site():
+    san = _twin_broker_scenario(double_ack=False, leak_credit=True)
+    try:
+        san.assert_clean()
+    except AssertionError as e:
+        msg = str(e)
+    else:
+        raise AssertionError("leaked credit not reported")
+    assert "credit-leak" in msg and THIS_FILE in msg
+    assert "still held after the delivery settled" in msg
+
+
+def test_settlement_twin_clean_lifecycle_and_requeue_are_silent():
+    san = _twin_broker_scenario(double_ack=False, leak_credit=False)
+    san.assert_clean()
+    assert san.settlement_report()["open_credits"] == []
+
+
+def test_settlement_twin_tolerates_at_least_once_redelivery():
+    """A nack-requeue then a settle of the SAME tag (the in-proc broker
+    reuses the Delivery object) is the documented at-least-once shape,
+    not a double-settle."""
+    from matchmaking_tpu.service.broker import InProcBroker
+
+    san = AsyncSanitizer(stall_threshold_s=60.0)
+    with san.installed():
+        async def main():
+            broker = InProcBroker()
+            seen = []
+            done = asyncio.Event()
+            state = {}
+
+            async def on_delivery(delivery):
+                seen.append(delivery.delivery_tag)
+                if len(seen) == 1:
+                    broker.nack(state["tag"], delivery.delivery_tag,
+                                requeue=True)
+                else:
+                    broker.ack(state["tag"], delivery.delivery_tag)
+                    done.set()
+
+            state["tag"] = broker.basic_consume("q", on_delivery)
+            broker.publish("q", b"{}")
+            await asyncio.wait_for(done.wait(), 5.0)
+            broker.close()
+
+        asyncio.run(main())
+    assert [f for f in san.findings if f.kind == "double-settle"] == []
+    san.assert_clean()
