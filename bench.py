@@ -1132,6 +1132,155 @@ def bench_cpu_oracle(args) -> dict:
     }
 
 
+def bench_placement_soak(args) -> dict:
+    """Elastic placement soak (ISSUE 11): seeded load streams through a
+    hot queue while the control plane executes a scripted
+    migrate → promote(D=2) → demote(D=1) → migrate-back cycle through the
+    SAME audited path policy decisions take (PlacementController.force).
+    Measures the migration blackout (max/mean, from the decision ring)
+    and proves delivery accounting across the moves: zero lost (every
+    submitted player matched or still waiting at the end) and zero
+    duplicated terminal responses.
+
+    Runs on whatever backend is initialized; on a CPU box the caller
+    forces a 4-virtual-device host mesh, so the promote leg exercises the
+    real sharded kernel set.  scripts/bench_diff.py gates
+    placement_blackout_ms_max / placement_lost / placement_dup
+    direction-aware (lower is better)."""
+    import asyncio
+
+    async def run() -> dict:
+        from matchmaking_tpu.config import (
+            BatcherConfig,
+            Config,
+            EngineConfig,
+            OverloadConfig,
+            PlacementConfig,
+            QueueConfig,
+        )
+        from matchmaking_tpu.service.app import MatchmakingApp
+        from matchmaking_tpu.service.broker import Properties
+
+        import jax
+
+        n_dev = min(4, len(jax.devices()))
+        if n_dev < 2:
+            # An explicit error row, not a vacuous clean run: with one
+            # device every scripted move would be refused and the
+            # lost/dup/blackout gates would pass while measuring nothing.
+            return {"error": "placement_soak_needs_2_devices",
+                    "placement_devices": n_dev}
+        window = int(args.placement_window)
+        cfg = Config(
+            queues=(QueueConfig(name="soak.hot", rating_threshold=200.0,
+                                send_queued_ack=False),
+                    QueueConfig(name="soak.cold", rating_threshold=200.0,
+                                send_queued_ack=False)),
+            engine=EngineConfig(backend="tpu",
+                                pool_capacity=max(4 * window, 1024),
+                                pool_block=max(window, 256),
+                                batch_buckets=(16, 64, window), top_k=8),
+            batcher=BatcherConfig(max_batch=window, max_wait_ms=3.0),
+            overload=OverloadConfig(max_inflight=8 * window),
+            placement=PlacementConfig(interval_s=3600.0, devices=n_dev,
+                                      max_shard=2, cooldown_s=0.0),
+        )
+        app = MatchmakingApp(cfg)
+        await app.start()
+        rt = app.runtime("soak.hot")
+        ctrl = app.placement
+        reply_q = "soak.replies"
+        app.broker.declare_queue(reply_q)
+        matched: dict[str, int] = {}
+
+        async def on_reply(delivery) -> None:
+            d = json.loads(delivery.body)
+            if d.get("status") == "matched":
+                pid = str(d.get("player_id", ""))
+                matched[pid] = matched.get(pid, 0) + 1
+
+        app.broker.basic_consume(reply_q, on_reply, prefetch=1_000_000)
+
+        rng = np.random.default_rng(int(args.placement_seed))
+        rate = float(args.placement_rate)
+        duration = float(args.placement_seconds)
+        gap = 1.0 / max(1.0, rate)
+        submitted = 0
+        #: The scripted placement cycle, at fractions of the soak span.
+        schedule = ([(0.2, ("migrate", (1,))),
+                     (0.4, ("promote", (1, 2))),
+                     (0.6, ("demote", (1,))),
+                     (0.8, ("migrate", (0,)))]
+                    if n_dev >= 3 else [(0.25, ("migrate", (1,))),
+                                        (0.65, ("migrate", (0,)))])
+        t0 = time.time()
+        next_action = 0
+        while time.time() - t0 < duration:
+            frac = (time.time() - t0) / duration
+            if next_action < len(schedule) and frac >= schedule[next_action][0]:
+                kind, devices = schedule[next_action][1]
+                next_action += 1
+                await ctrl.force(kind, "soak.hot", devices,
+                                 reason=f"soak script {kind}")
+            burst = max(1, int(rate * 0.01))
+            ratings = rng.normal(1500.0, 120.0, burst)
+            for r in ratings:
+                app.broker.publish(
+                    "soak.hot",
+                    f'{{"id":"s{submitted}","rating":{r:.2f}}}'.encode(),
+                    Properties(reply_to=reply_q,
+                               correlation_id=f"s{submitted}"))
+                submitted += 1
+            await asyncio.sleep(max(gap * burst, 0.001))
+        # The cycle always completes: legs the load loop did not reach
+        # (blackouts + a loaded box eat wall time) run now, against the
+        # still-waiting pool — the blackout/lost/dup accounting must
+        # cover the whole scripted cycle on every box speed.
+        while next_action < len(schedule):
+            kind, devices = schedule[next_action][1]
+            next_action += 1
+            await ctrl.force(kind, "soak.hot", devices,
+                             reason=f"soak script {kind} (post-load)")
+        # Drain: let in-flight work land.
+        for _ in range(400):
+            await asyncio.sleep(0.025)
+            if (app.broker.queue_depth("soak.hot") == 0
+                    and app.broker.queue_depth(reply_q) == 0
+                    and app.broker.handlers_idle()
+                    and rt.batcher.depth == 0 and rt._flushing == 0
+                    and rt.engine.inflight() == 0):
+                break
+        waiting = {r.id for r in rt.engine.waiting()}
+        dup = sum(n - 1 for n in matched.values() if n > 1)
+        lost = submitted - len(matched) - len(waiting)
+        snap = ctrl.snapshot()
+        blackouts = [d["blackout_ms"] for d in snap["decisions"]
+                     if d["status"] == "applied"]
+        failed = [d for d in snap["decisions"]
+                  if d["status"] in ("failed", "refused")]
+        out = {
+            "placement_soak_requests": submitted,
+            "placement_soak_matched": len(matched),
+            "placement_soak_waiting": len(waiting),
+            "placement_migrations": len(blackouts),
+            "placement_failed_actions": len(failed),
+            "placement_blackout_ms_max": (round(max(blackouts), 3)
+                                          if blackouts else None),
+            "placement_blackout_ms_mean": (
+                round(sum(blackouts) / len(blackouts), 3)
+                if blackouts else None),
+            "placement_lost": lost,
+            "placement_dup": dup,
+            "placement_devices": n_dev,
+            "placement_final_binding": snap["live"]["soak.hot"]["devices"],
+            "placement_decisions": snap["decisions"],
+        }
+        await app.stop()
+        return out
+
+    return asyncio.run(run())
+
+
 def main() -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--pool", type=int, default=100_000,
@@ -1266,7 +1415,37 @@ def main() -> None:
                         "XLA_FLAGS=--xla_force_host_platform_device_count=8")
     p.add_argument("--comms-capacity", type=int, default=65_536)
     p.add_argument("--comms-frontier-k", type=int, default=1024)
+    p.add_argument("--placement-soak", action="store_true",
+                   help="elastic placement soak (ISSUE 11): seeded load "
+                        "through a hot queue while a scripted migrate → "
+                        "promote(D=2) → demote → migrate-back cycle runs "
+                        "through the audited controller path; emits "
+                        "placement_blackout_ms_* / placement_lost / "
+                        "placement_dup (bench_diff gates them, lower is "
+                        "better). Standalone mode: skips every other "
+                        "phase; on a CPU box a 4-virtual-device host "
+                        "mesh is forced so the promote leg is real")
+    p.add_argument("--placement-rate", type=float, default=2000.0,
+                   help="soak offered load (req/s)")
+    p.add_argument("--placement-seconds", type=float, default=4.0)
+    p.add_argument("--placement-window", type=int, default=256,
+                   help="soak batcher window / top batch bucket")
+    p.add_argument("--placement-seed", type=int, default=17)
     args = p.parse_args()
+    if args.placement_soak:
+        # Before any jax import: the soak needs >= 2 devices for the
+        # migrate legs (4 for the shard cycle).  The host-platform flag
+        # is set UNCONDITIONALLY — it only affects the CPU platform, so
+        # it is a no-op on a real TPU backend, and gating it on
+        # JAX_PLATFORMS would leave a bare-env CPU box at 1 device where
+        # every scripted action is refused and the gate passes vacuously.
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=4"
+            ).strip()
+        print(json.dumps(bench_placement_soak(args)), flush=True)
+        return
     if args.comms:
         for row in comms_accounting_rows(capacity=args.comms_capacity,
                                          frontier_k=args.comms_frontier_k):

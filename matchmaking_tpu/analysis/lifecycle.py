@@ -295,6 +295,14 @@ class _FnScan:
         self.tracked: set[str] = set()       # scalar vars under analysis
         self.partial_loops: set[int] = set() # For linenos that keep rows
         self._loop_src: dict[str, str] = {}  # loop target -> iterated name
+        #: Guard flags (path-sensitive refinement, ISSUE 11 satellite):
+        #: flag name -> the group ROOT whose hand-off it mirrors.  A
+        #: qualifying flag is a bool local whose ONLY ``flag = True``
+        #: assignment is the statement IMMEDIATELY after a hand-off of an
+        #: owned collection (subscript/attribute store), with at least one
+        #: ``flag = False`` elsewhere and no other assignments — so
+        #: ``flag`` being truthy IMPLIES the group escaped, on every path.
+        self.guard_flags: dict[str, str] = {}
         self._scan()
 
     # A call's contract, resolved same-file: self.helper → Class.helper,
@@ -407,11 +415,75 @@ class _FnScan:
                         and isinstance(sub.func.value, ast.Name)
                         and self.groups.find(sub.func.value.id) in roots):
                     self.partial_loops.add(node.lineno)
+        # Guard flags: scan statement SEQUENCES for the hand-off/flag
+        # adjacency, then validate the flag's full assignment set.
+        bool_assigns: dict[str, list[ast.Assign]] = {}
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, bool)):
+                bool_assigns.setdefault(node.targets[0].id,
+                                        []).append(node)
+        candidates: dict[str, tuple[str, ast.Assign]] = {}
+        for node in ast.walk(fn):
+            for field in ("body", "orelse", "finalbody"):
+                body = getattr(node, field, None)
+                if not isinstance(body, list):
+                    continue
+                for prev, cur in zip(body, body[1:]):
+                    if not (isinstance(cur, ast.Assign)
+                            and len(cur.targets) == 1
+                            and isinstance(cur.targets[0], ast.Name)
+                            and isinstance(cur.value, ast.Constant)
+                            and cur.value.value is True):
+                        continue
+                    root = self._handoff_root(prev)
+                    if root is not None:
+                        candidates[cur.targets[0].id] = (root, cur)
+        for flag, (root, true_stmt) in candidates.items():
+            stmts = bool_assigns.get(flag, [])
+            trues = [a for a in stmts if a.value.value is True]
+            falses = [a for a in stmts if a.value.value is False]
+            # Any OTHER write to the flag (non-constant, augmented, tuple
+            # target, loop binding) disqualifies it — the correlation
+            # must be total.
+            def _target_nodes(n: ast.AST) -> list[ast.AST]:
+                if isinstance(n, ast.Assign):
+                    return list(n.targets)
+                return [n.target]
+
+            others = [
+                n for n in ast.walk(fn)
+                if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign,
+                                  ast.For, ast.AsyncFor))
+                and any(flag in _binding_names(t)
+                        for t in _target_nodes(n))
+                and n not in stmts
+            ]
+            if len(trues) == 1 and trues[0] is true_stmt and falses \
+                    and not others:
+                self.guard_flags[flag] = root
         #: For linenos whose body settles/hands-off the loop target on
         #: EVERY path — computed per loop over a sub-CFG of the body alone
         #: so stale bindings from earlier loops cannot join in.  Filled by
         #: check() once the SourceFile is attached.
         self.settling_loops: set[int] = set()
+
+    def _handoff_root(self, stmt: ast.AST) -> str | None:
+        """The owned-group root ``stmt`` hands off, when it is a
+        subscript/attribute store of an owned collection (the window-meta
+        shape: ``self._inflight_meta[tok] = (dict(pairs), deliveries)``)."""
+        if not (isinstance(stmt, ast.Assign) and stmt.targets
+                and all(isinstance(t, (ast.Subscript, ast.Attribute))
+                        for t in stmt.targets)):
+            return None
+        owned = {self.groups.find(n) for n in self.owned_seeds}
+        for n in _bare_names(stmt.value):
+            r = self.groups.find(n)
+            if r in owned:
+                return r
+        return None
 
     def group_key(self, name: str) -> str:
         return "&" + self.groups.find(name)
@@ -533,6 +605,8 @@ class _SettlementAnalysis(df.Analysis):
                 for pname, pos in contract.settles_coll.items():
                     if pos not in args:
                         continue
+                    if self._settle_correlated(args[pos], state):
+                        continue
                     hit = {self.scan.group_key(n)
                            for n in _names_in(args[pos])}
                     for key in hit & set(state):
@@ -567,6 +641,43 @@ class _SettlementAnalysis(df.Analysis):
                                     self.scan._loop_src.get(var, var))):
                             continue  # kept within its own window group
                         self._escape(state, var)
+
+    def _settle_correlated(self, arg: ast.AST,
+                           state: dict[str, str]) -> bool:
+        """Path-sensitive guard refinement (ISSUE 11 satellite): a
+        ``settles: *`` argument of the shape ``None if flag else group``
+        (or ``group if not flag else None``) where ``flag`` is a guard
+        flag correlated with ``group``'s hand-off.  The correlation is
+        exact by construction — ``flag`` is True iff the group escaped
+        (its only True-assignment immediately follows the hand-off, with
+        no raise edge in between since a constant store cannot raise) and
+        the callee settles the collection exactly on the flag-False
+        paths — so every path ends settled-or-escaped: HANDLED, with no
+        conditional-settlement report.  Returns True when refined."""
+        if not isinstance(arg, ast.IfExp):
+            return False
+        test, neg = arg.test, False
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            test, neg = test.operand, True
+        if not isinstance(test, ast.Name):
+            return False
+        root = self.scan.guard_flags.get(test.id)
+        if root is None:
+            return False
+        key = "&" + root
+        if key not in state:
+            return False
+        escaped_branch = arg.orelse if neg else arg.body  # flag True value
+        settle_branch = arg.body if neg else arg.orelse   # flag False value
+        if not (isinstance(escaped_branch, ast.Constant)
+                and escaped_branch.value is None):
+            return False
+        names = _names_in(settle_branch)
+        if not names or any(self.scan.groups.find(n) != root
+                            for n in names):
+            return False
+        state[key] = HANDLED
+        return True
 
     def _check_leaves(self, state: dict[str, str], var: str, line: int,
                       where: str) -> None:
@@ -761,7 +872,11 @@ def _loop_settles(scan: _FnScan, sf: SourceFile, qual: str,
 def check(sources: list[SourceFile]) -> list[Finding]:
     findings: list[Finding] = []
     for sf in sources:
-        if not in_package(sf) or "/service/" not in "/" + sf.path:
+        # The settlement seams live in service/ by design; control/ joined
+        # in ISSUE 11 — its executor/controller own engine hand-offs and
+        # explicit lock pairings the same rules must prove.
+        if not in_package(sf) or not any(
+                seg in "/" + sf.path for seg in ("/service/", "/control/")):
             continue
         contracts = _collect_contracts(sf)
         for cls, fn in _iter_functions(sf.tree):

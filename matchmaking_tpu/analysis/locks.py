@@ -74,10 +74,19 @@ AWAIT_RULE = "await-under-lock"
 GUARD_RULE = "guarded-by"
 
 #: Awaited callables allowed inside a lock body (dotted suffix match).
-ALLOWED_AWAIT_CALLS = ("asyncio.to_thread",)
+#: ``_shielded_to_thread`` is service/app's cancellation-hardened twin of
+#: ``asyncio.to_thread`` (shield detaches the await chain from the thread
+#: task); the work is off-loop exactly like to_thread — the runtime
+#: sanitizer sanctions the same name (testing/sanitizer.py).
+ALLOWED_AWAIT_CALLS = ("asyncio.to_thread", "_shielded_to_thread")
 #: Methods designed to run with the lock held (awaitable helpers whose
-#: own awaits are all ``asyncio.to_thread``).
-ALLOWED_AWAIT_METHODS = ("_drain_engine", "_pay_debt_locked")
+#: own awaits are all ``asyncio.to_thread`` — plus the cross-queue EDF
+#: dispatch gate ``_arbiter_slot``/``_arbiter_turn`` (control/arbiter.py),
+#: whose wait is the strictly innermost resource by design: holders never
+#: acquire a lock under it, and the held engine lock guards state nothing
+#: else can touch while this queue waits its turn).
+ALLOWED_AWAIT_METHODS = ("_drain_engine", "_pay_debt_locked",
+                         "_arbiter_slot", "_arbiter_turn")
 
 #: Container/set/dict methods that mutate their receiver.
 MUTATORS = frozenset({

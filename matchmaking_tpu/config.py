@@ -421,6 +421,67 @@ class OverloadConfig:
 
 
 @dataclass(frozen=True)
+class PlacementConfig:
+    """Elastic queue→device placement control plane (matchmaking_tpu/
+    control/): a controller that watches the telemetry ring (per-queue SLO
+    burn, device idle fraction, effective occupancy, stage p99) and
+    live-migrates queues across device engines using the drain/checkpoint/
+    restore primitive — plus Nitsum-style elastic sharding (promote a hot
+    1v1 queue from single-chip to D>1 and back as load recedes).  The
+    greedy burn-to-idle policy ships first; the policy seam
+    (control/policy.PlacementPolicy) is where a MIPS-style search planner
+    drops in later.
+
+    Every decision is a pure function of the controller's signal view at
+    the tick (no RNG, no clock reads inside the policy — ``now`` is data),
+    so the seeded simulation mode (control/simulate.py) replays decision
+    traces bit-identically without devices."""
+
+    #: Controller tick interval (seconds; 0 disables the control plane
+    #: entirely — no task, no arbiter, zero hot-path overhead).
+    interval_s: float = 0.0
+    #: Logical device inventory the controller places queues onto. 0 =
+    #: discover from the live backend (``jax.devices()``); N > 0 = a fixed
+    #: logical inventory — what the host-oracle backend and the seeded
+    #: simulation use (CpuEngine carries placement as metadata only).
+    devices: int = 0
+    #: A queue is HOT (migration source) when its SLO is burning or its
+    #: device idle fraction over the last telemetry window falls below
+    #: this bound.
+    hot_idle_below: float = 0.15
+    #: A device is a migration TARGET only when its idle fraction exceeds
+    #: this bound (and it hosts no hot queue).
+    cold_idle_above: float = 0.5
+    #: Minimum idle-fraction gap between target and source devices before
+    #: a migration is worth its blackout.
+    min_idle_gain: float = 0.2
+    #: Per-queue cooldown between placement actions (seconds) — bounds
+    #: migrate/promote thrash; measured against the tick's ``now``.
+    cooldown_s: float = 10.0
+    #: Elastic sharding cap for 1v1 device queues (Nitsum adaptive
+    #: parallelism): a hot queue alone on its device may be promoted to up
+    #: to this many chips (D>1, engine/sharded.py) and is demoted back as
+    #: load recedes. 1 = no elastic sharding.
+    max_shard: int = 1
+    #: Promote only while effective occupancy (valid/padded lanes) exceeds
+    #: this — an idle-but-burning queue gains nothing from more chips.
+    promote_occupancy: float = 0.5
+    #: Demote a sharded queue once its idle fraction exceeds this.
+    demote_idle_above: float = 0.8
+    #: Placement decisions kept in the audit ring (/debug/placement).
+    decision_ring: int = 256
+    #: Cross-queue (tier, deadline) dispatch arbitration for queues the
+    #: controller co-locates on one device: EDF ordering holds ACROSS
+    #: co-located queues' concurrently-waiting windows, not just within
+    #: one batcher.  Only engaged while >= 2 queues share a device — an
+    #: unshared device's dispatches bypass the arbiter entirely.
+    arbiter: bool = True
+
+    def enabled(self) -> bool:
+        return self.interval_s > 0
+
+
+@dataclass(frozen=True)
 class ObservabilityConfig:
     """Request-lifecycle flight recorder + debug surfaces (utils/trace.py,
     service/observability.py). The BASELINE north star asserts a p99;
@@ -547,6 +608,9 @@ class Config:
     #: Flight recorder / debug endpoints (tracing on by default).
     observability: ObservabilityConfig = field(
         default_factory=ObservabilityConfig)
+    #: Elastic queue→device placement control plane (off by default — see
+    #: PlacementConfig.enabled()).
+    placement: PlacementConfig = field(default_factory=PlacementConfig)
     #: Number of concurrent search workers draining batches (the reference's
     #: GenServer pool size analog — SURVEY.md §2 C7).
     workers: int = 2
@@ -579,6 +643,7 @@ class Config:
             ("chaos", ChaosConfig),
             ("overload", OverloadConfig),
             ("observability", ObservabilityConfig),
+            ("placement", PlacementConfig),
         ):
             if name in d:
                 sub = dict(d[name])

@@ -1,0 +1,61 @@
+"""Elastic queue→device placement control plane (ISSUE 11).
+
+Queues were statically bound to device engines: a hot 1v1 queue saturates
+its chip while a cold team queue's chip idles.  This package closes the
+loop the ROADMAP named — every input already shipped:
+
+- **signals** — the PR 6 telemetry ring (idle fraction, effective
+  occupancy, stage p99) and the PR 6/7 SLO burn monitors
+  (``slo_burning_queues`` keys);
+- **mechanism** — the PR 5 drain/checkpoint/restore round trip as a
+  correctness-proven live-migration primitive (PR 9's quality-accumulator
+  checkpoint rides along, so observability survives the move);
+- **policy** — greedy burn-to-idle first (move the hottest-burning queue
+  to the idlest device; promote a hot 1v1 queue to D>1 chips and demote it
+  back — Nitsum's adaptive parallelism), behind a seam
+  (:class:`~matchmaking_tpu.control.policy.PlacementPolicy`) sized for a
+  MIPS-style search planner later.
+
+Layout::
+
+    state.py       placement state model + exactly-once migration
+                   typestate + bounded decision audit log
+    policy.py      PlacementPolicy seam + GreedyPolicy (burn → idle)
+    simulate.py    deterministic seeded cluster simulation (policy unit
+                   tests and the bench soak run without devices)
+    arbiter.py     cross-queue (tier, deadline) dispatch arbiter for
+                   co-located queues (the open PR 7 follow-up)
+    executor.py    the engine rebuild primitive (snapshot → build on the
+                   target devices → restore → verify)
+    controller.py  the live control loop + /debug/placement snapshot
+"""
+
+from matchmaking_tpu.control.arbiter import DispatchArbiter
+from matchmaking_tpu.control.controller import PlacementController
+from matchmaking_tpu.control.policy import (
+    Action,
+    GreedyPolicy,
+    PlacementPolicy,
+    QueueSignals,
+    SignalView,
+)
+from matchmaking_tpu.control.state import (
+    PlacementDecision,
+    PlacementError,
+    PlacementState,
+    QueuePlacement,
+)
+
+__all__ = [
+    "Action",
+    "DispatchArbiter",
+    "GreedyPolicy",
+    "PlacementController",
+    "PlacementDecision",
+    "PlacementError",
+    "PlacementPolicy",
+    "PlacementState",
+    "QueuePlacement",
+    "QueueSignals",
+    "SignalView",
+]

@@ -272,8 +272,15 @@ class Engine(abc.ABC):
         return min(self.queue.max_threshold, base + self.queue.widen_per_sec * waited)
 
 
-def make_engine(cfg: Config, queue: QueueConfig) -> Engine:
-    """Engine factory — the ``engine: :cpu | :tpu`` selection point."""
+def make_engine(cfg: Config, queue: QueueConfig,
+                devices: "tuple[int, ...] | None" = None) -> Engine:
+    """Engine factory — the ``engine: :cpu | :tpu`` selection point.
+
+    ``devices`` is the elastic-placement binding (ISSUE 11): logical
+    device INDICES into ``jax.devices()`` this engine's pool lives on.
+    None = the pre-placement default (XLA default device / the first
+    ``mesh_pool_axis`` devices).  Host engines carry no device state, so
+    the binding is placement metadata only there."""
     if cfg.engine.backend == "cpu":
         from matchmaking_tpu.engine.cpu import CpuEngine
 
@@ -281,5 +288,5 @@ def make_engine(cfg: Config, queue: QueueConfig) -> Engine:
     if cfg.engine.backend == "tpu":
         from matchmaking_tpu.engine.tpu import TpuEngine
 
-        return TpuEngine(cfg, queue)
+        return TpuEngine(cfg, queue, devices=devices)
     raise ValueError(f"unknown engine backend {cfg.engine.backend!r}")

@@ -297,9 +297,23 @@ class ShardedKernelSet:
 def sharded_kernel_set(capacity: int, top_k: int, pool_block: int,
                        glicko2: bool, widen_per_sec: float,
                        max_threshold: float, n_shards: int,
-                       ring: bool, pair_rounds: int = 8) -> ShardedKernelSet:
+                       ring: bool, pair_rounds: int = 8,
+                       device_ids: "tuple[int, ...] | None" = None,
+                       ) -> ShardedKernelSet:
+    """``device_ids`` (elastic placement, ISSUE 11): the logical device
+    indices the pool mesh spans — None keeps the pre-placement default
+    (the first ``n_shards`` of ``jax.devices()``).  Part of the cache key:
+    the same shape promoted onto a different chip pair is a different
+    compiled set."""
+    devices = None
+    if device_ids is not None:
+        if len(device_ids) != n_shards:
+            raise ValueError(
+                f"device_ids {device_ids} must match n_shards={n_shards}")
+        all_devs = jax.devices()
+        devices = [all_devs[i] for i in device_ids]
     return ShardedKernelSet(
         capacity=capacity, top_k=top_k, pool_block=pool_block, glicko2=glicko2,
         widen_per_sec=widen_per_sec, max_threshold=max_threshold,
-        mesh=pool_mesh(n_shards), ring=ring, pair_rounds=pair_rounds,
+        mesh=pool_mesh(n_shards, devices), ring=ring, pair_rounds=pair_rounds,
     )

@@ -172,8 +172,20 @@ class _Pending:
 # externally-serialized-by: _engine_lock
 # lock-free: pool_size, inflight, pool_tier_counts, deadline_count, util_report, span_report, quality_report
 class TpuEngine(Engine):
-    def __init__(self, cfg: Config, queue: QueueConfig):
+    def __init__(self, cfg: Config, queue: QueueConfig,
+                 devices: "tuple[int, ...] | None" = None):
         super().__init__(cfg, queue)
+        #: Elastic placement binding (ISSUE 11): logical indices into
+        #: ``jax.devices()`` this engine's pool lives on. None = the
+        #: pre-placement default.  Single-device engines COMMIT the pool
+        #: arrays to the chosen device (jit follows committed operands);
+        #: sharded engines build their pool mesh over exactly these ids.
+        self.devices: tuple[int, ...] | None = (
+            tuple(int(d) for d in devices) if devices else None)
+        self._device = (jax.devices()[self.devices[0]]
+                        if self.devices is not None
+                        and cfg.engine.mesh_pool_axis <= 1
+                        and len(self.devices) == 1 else None)
         # Recompile visibility (SURVEY.md §5): every engine-owning process
         # counts XLA backend compiles; a hot-path recompile is a latency
         # cliff that must show in /metrics and the bench JSON.
@@ -255,6 +267,7 @@ class TpuEngine(Engine):
                 n_shards=ec.mesh_pool_axis,
                 ring=ec.ring_merge,
                 pair_rounds=ec.pair_rounds,
+                device_ids=self.devices,
             )
         else:
             self.kernels = kernel_set(
@@ -1236,6 +1249,12 @@ class TpuEngine(Engine):
         place = getattr(self.kernels, "place_pool", None)
         if place is not None:
             return place(init)
+        if self._device is not None:
+            # Elastic placement (ISSUE 11): COMMIT the pool to the bound
+            # device — every jitted step follows the committed operand, so
+            # the whole engine runs where the controller put it.
+            return jax.device_put({k: jnp.asarray(v)
+                                   for k, v in init.items()}, self._device)
         return jax.device_put({k: jnp.asarray(v) for k, v in init.items()})
 
     def _pack(self, batch, now_rel: float,

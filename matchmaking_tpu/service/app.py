@@ -58,12 +58,33 @@ from matchmaking_tpu.utils.trace import EventLog, FlightRecorder, TraceContext
 log = logging.getLogger(__name__)
 
 
+async def _shielded_to_thread(task: "asyncio.Task"):
+    """Await an already-launched ``asyncio.to_thread`` task, shielded from
+    caller cancellation: the worker THREAD cannot be interrupted anyway,
+    so a cancelled caller lets it finish in the background (the caller
+    attaches a done-callback to dispose the result).  Named so the
+    runtime async sanitizer recognizes it as the same sanctioned off-loop
+    seam as a bare ``await asyncio.to_thread(...)`` — the work is off the
+    event loop either way (testing/sanitizer.py
+    ``_SANCTIONED_CODE_NAMES``)."""
+    return await asyncio.shield(task)
+
+
 class _QueueRuntime:
     """Everything one matchmaking queue owns (consumer, batcher, engine)."""
 
-    def __init__(self, app: "MatchmakingApp", queue_cfg: QueueConfig):
+    def __init__(self, app: "MatchmakingApp", queue_cfg: QueueConfig,
+                 placement: "tuple[int, ...] | None" = None):
         self.app = app
         self.queue_cfg = queue_cfg
+        #: Elastic placement binding (ISSUE 11): logical device ids this
+        #: queue's engine runs on (shard degree = len). None = the static
+        #: pre-placement default.  EVERY engine rebuild (crash revive,
+        #: breaker demote/re-promote, migration) goes through
+        #: _make_engine/_probe_build, which apply this — a revive must not
+        #: silently undo a migration.
+        self.placement: tuple[int, ...] | None = (
+            tuple(placement) if placement else None)
         #: Chaos fault hook for this queue's engines (None = no chaos). The
         #: hook's step counters live in the APP's ChaosState, not the
         #: engine, so a scripted schedule keeps advancing across revives.
@@ -202,6 +223,28 @@ class _QueueRuntime:
 
     # ---- engine lifecycle (revive / breaker demotion / re-promotion) ------
 
+    def elastic_shardable(self) -> bool:
+        """Elastic sharding (D=1↔D>1 promotion) is available for this
+        queue: the device 1v1 path only — team/role kernel sets take no
+        device binding for their meshes, so the controller moves them
+        whole-device or not at all."""
+        return (self.queue_cfg.team_size == 1
+                and not self.queue_cfg.role_slots
+                and self.app.cfg.engine.backend == "tpu")
+
+    def _engine_cfg(self) -> Config:
+        """The engine's effective config under the CURRENT placement:
+        for elastic-shardable queues the mesh axis follows the binding's
+        device count (promote D=1→2 rebuilds onto the sharded kernel set;
+        demote comes back), everything else passes through unchanged."""
+        cfg = self.app.cfg
+        if (self.placement is not None and self.elastic_shardable()
+                and len(self.placement) != cfg.engine.mesh_pool_axis):
+            cfg = dataclasses.replace(
+                cfg, engine=dataclasses.replace(
+                    cfg.engine, mesh_pool_axis=len(self.placement)))
+        return cfg
+
     def _make_engine(self) -> Engine:
         """Build this queue's engine for the CURRENT breaker state: the
         configured (device) engine while the breaker is closed, the
@@ -218,7 +261,8 @@ class _QueueRuntime:
                 "queue %r: breaker %s — running DEGRADED on the host oracle",
                 self.queue_cfg.name, self.breaker.state)
             return CpuEngine(self.app.cfg, self.queue_cfg)
-        engine = make_engine(self.app.cfg, self.queue_cfg)
+        engine = make_engine(self._engine_cfg(), self.queue_cfg,
+                             devices=self.placement)
         if self._chaos_hook is not None and hasattr(engine, "chaos_hook"):
             engine.chaos_hook = self._chaos_hook
         return engine
@@ -880,7 +924,14 @@ class _QueueRuntime:
                 for delivery in deliveries_in:
                     if delivery.trace is not None:
                         delivery.trace.mark("dispatch", t_disp)
-                outcome = await asyncio.to_thread(self.engine.search, requests, now)
+                # Arbiter slot (ISSUE 11): (tier, deadline) turn against
+                # co-located queues — inside the engine lock (see
+                # _dispatch_pipelined), spanning the synchronous step
+                # (dispatch == device step for engines without the
+                # pipelined API; the device serializes them anyway).
+                async with self._arbiter_slot(deliveries_in):
+                    outcome = await asyncio.to_thread(
+                        self.engine.search, requests, now)
         except Exception:
             log.exception("engine step crashed; reviving engine from mirror")
             self._record_engine_crash(now)
@@ -1206,7 +1257,11 @@ class _QueueRuntime:
                             if not len(cols):
                                 # matchlint: ignore[settlement] empty residue: every kept row was a debt victim _pay_debt_locked settled (shed+ack)
                                 return
-                    outs = await asyncio.to_thread(run_engine)
+                    # Arbiter slot (ISSUE 11) — inside the engine lock,
+                    # around the dispatch+flush only (see
+                    # _dispatch_pipelined for the discipline).
+                    async with self._arbiter_slot(deliveries_in):
+                        outs = await asyncio.to_thread(run_engine)
                     # Error check + failed-token bookkeeping stay INSIDE
                     # the lock: a breaker demotion parked on it must not
                     # swap the engine between the flush and this read.
@@ -1473,7 +1528,16 @@ class _QueueRuntime:
                                 deliveries_in = [d for _, d in pairs]
                                 if not pairs:
                                     return
-                tok = await asyncio.to_thread(dispatch, stale)
+                # Cross-queue EDF arbitration (ISSUE 11): while the
+                # placement controller co-locates queues on this device,
+                # the dispatch call waits its (tier, deadline) turn
+                # against the other tenants' concurrently-waiting
+                # windows.  Acquired INSIDE the engine lock and held only
+                # across the host-side dispatch itself, so a migration
+                # blackout (engine lock held for the whole rebuild) can
+                # never stall a co-located queue through the slot.
+                async with self._arbiter_slot(deliveries_in):
+                    tok = await asyncio.to_thread(dispatch, stale)
                 self._inflight_meta[tok] = (dict(pairs), deliveries_in)
                 recorded = True
                 self._collect_ready_locked(time.time())
@@ -1483,7 +1547,10 @@ class _QueueRuntime:
             # Once meta is recorded the revive path settles this window
             # exactly once (salvage-ack or stale-meta nack) — passing
             # extra_nack too would double-settle the same delivery tags.
-            # matchlint: ignore[settlement] `recorded` mirrors the meta hand-off exactly: extra_nack is None on every path where the window escaped
+            # The settlement rule now PROVES this shape (guard-flag
+            # refinement: `recorded`'s only True-assignment immediately
+            # follows the meta hand-off), so the PR 10 inline ignore that
+            # sat here is retired.
             await self._revive_pipelined(
                 now, extra_nack=None if recorded else deliveries_in)
             return
@@ -2215,7 +2282,8 @@ class _QueueRuntime:
         probe failure must cost the degraded queue nothing but this thread's
         time. Returns the proven engine; closes it and re-raises on probe
         failure."""
-        engine = make_engine(self.app.cfg, self.queue_cfg)
+        engine = make_engine(self._engine_cfg(), self.queue_cfg,
+                             devices=self.placement)
         if self._chaos_hook is not None and hasattr(engine, "chaos_hook"):
             engine.chaos_hook = self._chaos_hook
         try:
@@ -2306,6 +2374,107 @@ class _QueueRuntime:
             "queue %r: half-open probe succeeded — breaker CLOSED, device "
             "engine restored (%d waiting players transferred)",
             self.queue_cfg.name, transferred)
+
+    # ---- elastic placement: live queue→device migration (ISSUE 11) --------
+
+    async def migrate(self, devices: "tuple[int, ...]") -> "dict[str, Any]":
+        """Live-migrate this queue's engine onto ``devices`` (shard degree
+        = len) using the drain/checkpoint/restore primitive: under the
+        engine lock, collect every in-flight window (their outcomes
+        publish + ack through the normal settle paths), snapshot the
+        waiting pool and the quality accumulators, rebuild the engine
+        bound to the target devices, and restore.  Nothing else is
+        settled here: admission credits and EDF deadline caches live in
+        THIS runtime and survive by construction; deliveries parked in
+        the batcher or on the engine lock simply dispatch to the
+        successor engine once the lock frees.
+
+        The lock-held span is the migration BLACKOUT — measured and
+        returned (the controller audits it in /debug/placement).  On any
+        build/restore failure the old engine keeps serving and the old
+        binding is restored (same order of operations as the breaker's
+        probe swap)."""
+        from matchmaking_tpu.control.executor import rebuild_engine
+
+        if self.breaker is not None and self.breaker.state != CLOSED:
+            raise RuntimeError(
+                f"queue {self.queue_cfg.name!r} is degraded (breaker "
+                f"{self.breaker.state}) — the host oracle serves it, so a "
+                f"device re-binding would migrate nothing")
+        devices = tuple(int(d) for d in devices)
+        async with self._engine_lock:
+            t0 = time.perf_counter()
+            now = time.time()
+            await self._drain_engine(now)
+            old = self.engine
+            prev = self.placement
+            self.placement = devices
+
+            def swap():
+                return rebuild_engine(
+                    old,
+                    lambda: self._make_engine(),
+                    now=now)
+
+            # shield + ensure_future: a cancelled migrate (drain/stop
+            # tearing the controller tick down) cannot interrupt the swap
+            # THREAD anyway — let it finish in the background and dispose
+            # whatever engine it built, instead of leaking a bound-to-
+            # nothing device pool.
+            swap_task = asyncio.ensure_future(asyncio.to_thread(swap))
+            try:
+                candidate, stats = await _shielded_to_thread(swap_task)
+            except BaseException:
+                # Build/restore failed or the await was cancelled: the
+                # old engine never stopped serving — revert the binding
+                # so later rebuilds (revive, probe) stay where the pool
+                # actually is, and close the candidate when/if the swap
+                # thread completes.
+                self.placement = prev
+
+                def _dispose(t: "asyncio.Task") -> None:
+                    if t.cancelled() or t.exception() is not None:
+                        return
+                    eng, _stats = t.result()
+                    try:
+                        eng.close()
+                    except Exception:
+                        log.exception("orphaned candidate engine close "
+                                      "failed")
+
+                swap_task.add_done_callback(_dispose)
+                raise
+            self._bind_engine(candidate)
+            try:
+                old.close()
+            except Exception:
+                log.exception("migrated-away engine close failed")
+            blackout_s = time.perf_counter() - t0
+        self.app.metrics.counters.inc("queue_migrations")
+        self.app.events.append(
+            "queue_migrated", self.queue_cfg.name,
+            f"{list(prev) if prev else 'default'} -> {list(devices)}: "
+            f"{stats['transferred']} players, "
+            f"{blackout_s * 1e3:.1f} ms blackout")
+        return {"blackout_s": blackout_s,
+                "transferred": stats["transferred"],
+                "devices": devices}
+
+    def _arbiter_slot(self, deliveries: "list[Delivery]"):
+        """The cross-queue (tier, deadline) dispatch gate (ISSUE 11): a
+        no-op context unless the placement controller is live, the
+        arbiter is enabled, and this queue currently SHARES its primary
+        device with another queue — the unshared layout pays one attr
+        read and one set probe per window."""
+        from matchmaking_tpu.control.arbiter import NOOP_SLOT, window_key
+
+        ctrl = self.app.placement
+        if ctrl is None or not self.app.cfg.placement.arbiter:
+            return NOOP_SLOT
+        dev = self.placement[0] if self.placement else None
+        if not ctrl.arbiter.engaged(dev):
+            return NOOP_SLOT
+        return ctrl.arbiter.slot(dev, window_key(deliveries))
 
     # ---- timeout + deadline sweeper ---------------------------------------
 
@@ -2508,15 +2677,27 @@ class MatchmakingApp:
         self._runtimes: dict[str, _QueueRuntime] = {}
         self._started = False
         self._observability = None
+        #: Elastic placement control plane (ISSUE 11; None = disabled).
+        #: Built at start(): the controller needs the runtimes to bind
+        #: boot placements and the telemetry ring to steer.
+        self.placement = None
 
     async def start(self) -> None:
         assert not self._started
-        for queue_cfg in self.cfg.queues:
+        if self.cfg.placement.enabled():
+            from matchmaking_tpu.control import PlacementController
+
+            self.placement = PlacementController(self, self.cfg.placement)
+        for i, queue_cfg in enumerate(self.cfg.queues):
             self.broker.declare_queue(queue_cfg.name)
-            rt = _QueueRuntime(self, queue_cfg)
+            rt = _QueueRuntime(self, queue_cfg,
+                               placement=self._boot_placement(i, queue_cfg))
             self._runtimes[queue_cfg.name] = rt
             if self.cfg.engine.warm_start:
                 rt.engine.warmup()
+        if self.placement is not None:
+            self.placement.bind_boot_placements()
+            self.placement.start()
         obs = self.cfg.observability
         if obs.slo_target_ms > 0:
             def _monitor(key: str) -> SloMonitor:
@@ -2576,9 +2757,29 @@ class MatchmakingApp:
             await self._observability.start()
         self._started = True
 
+    def _boot_placement(self, index: int,
+                        queue_cfg: QueueConfig) -> "tuple[int, ...] | None":
+        """The queue's boot-time device binding under the control plane:
+        mesh-sharded queues keep the default leading-device span (their
+        kernel sets build the mesh), single-device queues pack round-robin
+        over the inventory — the static layout, now explicit so the
+        controller's first tick starts from the truth. None when the
+        control plane is off (the pre-placement default everywhere)."""
+        if self.placement is None:
+            return None
+        n = self.placement.state.n_devices
+        axis = self.cfg.engine.mesh_pool_axis
+        if axis > 1:
+            # The mesh spans the leading devices; an inventory smaller
+            # than the axis is a config error PlacementState reports.
+            return tuple(range(axis))
+        return (index % n,)
+
     async def stop(self) -> None:
         if not self._started:
             return  # drain() already shut everything down
+        if self.placement is not None:
+            await self.placement.stop()
         self._stop_telemetry()
         if self._observability is not None:
             await self._observability.stop()
@@ -2601,6 +2802,12 @@ class MatchmakingApp:
         is configured)."""
         directory = (checkpoint_dir if checkpoint_dir is not None
                      else self.cfg.overload.drain_checkpoint_dir)
+        if self.placement is not None:
+            # Placement actions stop FIRST (cancel + AWAIT the tick, so
+            # no migration is mid-flight): a migration racing the drain
+            # would rebuild an engine the checkpoint walk below is about
+            # to read.
+            await self.placement.stop()
         self._stop_telemetry()
         self.events.append("drain_begin", "",
                            f"checkpoint={'on' if directory else 'off'}")
@@ -2619,6 +2826,21 @@ class MatchmakingApp:
         counts: dict[str, int] = {}
         if directory:
             counts = await self.save_checkpoint(directory)
+            # Admission-state sidecar (ISSUE 11 satellite): the adaptive
+            # credit fraction is DECISION state — without it a restored
+            # queue admits a burst the predecessor had tightened against.
+            # Saved after begin_drain flipped the controllers, so the
+            # checkpoint method excludes drain mode by construction.
+            adm = {name: rt.admission.checkpoint()
+                   for name, rt in self._runtimes.items()
+                   if rt.admission is not None}
+            if adm:
+                import os
+
+                from matchmaking_tpu.utils.checkpoint import save_admission
+
+                save_admission(os.path.join(directory, "_admission.json"),
+                               adm)
             # Broker-backlog handoff (ROADMAP carry-over): the consumers
             # above are cancelled, so any delivery still buffered on a
             # request queue would die with this process on the in-proc
@@ -2790,6 +3012,19 @@ class MatchmakingApp:
             async with rt._engine_lock:
                 await rt._drain_engine(now if now is not None else time.time())
                 counts[name] = load_pool(rt.engine, path, now)
+        # Admission-state sidecar (ISSUE 11 satellite): restore the
+        # adaptive credit fraction + shed/expired accounting so the
+        # successor's first admission ladder walk is IDENTICAL to what
+        # the predecessor's next walk would have been (the regression
+        # test in tests/test_overload.py diffs exactly that).
+        adm_path = os.path.join(directory, "_admission.json")
+        if os.path.exists(adm_path):
+            from matchmaking_tpu.utils.checkpoint import load_admission
+
+            for qname, state in load_admission(adm_path).items():
+                rt = self._runtimes.get(qname)
+                if rt is not None and rt.admission is not None:
+                    rt.admission.restore_state(state)
         # Re-publish the predecessor's unconsumed broker backlog (see
         # drain()): each entry flows through the normal publish path —
         # fresh delivery tags and trace contexts, original headers

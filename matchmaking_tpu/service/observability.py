@@ -475,6 +475,24 @@ class ObservabilityServer:
             body["queues"][name] = entry
         return web.json_response(body)
 
+    async def _debug_placement(self, request) -> "web.Response":
+        """Elastic placement control plane (ISSUE 11): current queue →
+        device bindings (shard degree, generation, typestate), the
+        decision audit ring — each record with the signal snapshot that
+        drove it and the measured migration blackout — per-queue blackout
+        stats, and the cross-queue dispatch arbiter's engagement state.
+        ``?n=`` caps the decision history (default: the full ring)."""
+        ctrl = getattr(self.app, "placement", None)
+        if ctrl is None:
+            return web.json_response(
+                {"error": "placement control plane disabled "
+                          "(set placement.interval_s)"}, status=404)
+        try:
+            history = max(0, int(request.query.get("n", "0")))
+        except ValueError:
+            history = 0
+        return web.json_response(ctrl.snapshot(history=history))
+
     async def _debug_telemetry(self, request) -> "web.Response":
         """The continuous telemetry ring (utils/timeseries.py): ``?n=``
         tail length, ``?key=`` comma-separated key-prefix filter
@@ -556,6 +574,7 @@ class ObservabilityServer:
         http_app.router.add_get("/debug/traces", self._debug_traces)
         http_app.router.add_get("/debug/attribution", self._debug_attribution)
         http_app.router.add_get("/debug/quality", self._debug_quality)
+        http_app.router.add_get("/debug/placement", self._debug_placement)
         http_app.router.add_get("/debug/telemetry", self._debug_telemetry)
         http_app.router.add_get("/debug/events", self._debug_events)
         http_app.router.add_get("/debug/profile", self._debug_profile)

@@ -452,6 +452,48 @@ class AdmissionController:
                     f"(p99 {0.0 if p99_s is None else p99_s * 1e3:.1f} ms, "
                     f"pipeline {pipeline_frac:.2f})")
 
+    # ---- checkpoint / restore (ISSUE 11 satellite) ------------------------
+
+    def checkpoint(self) -> dict[str, Any]:
+        """Admission state that must survive a drain/restore (or any
+        engine handoff) for the successor to make IDENTICAL decisions:
+        the adaptive credit fraction (it scales every cap — a reset
+        fraction admits a burst the predecessor would have shed) and the
+        per-tier shed/expired accounting (monotone observability).  Held
+        credits are deliberately NOT checkpointed: a drain settles every
+        in-flight delivery (shed responses), so the successor correctly
+        starts with zero held — redeliveries re-enter through admission
+        and take fresh credits."""
+        return {
+            "credit_fraction": self._fraction,
+            "shed_total": self.shed_total,
+            "expired_total": self.expired_total,
+            "shed_by_tier": list(self.shed_by_tier),
+            "expired_by_tier": list(self.expired_by_tier),
+        }
+
+    def restore_state(self, state: "Mapping[str, Any] | None") -> None:
+        """Fold a predecessor's checkpoint in (inverse of checkpoint();
+        missing/foreign keys read as no-ops so old sidecars stay loadable)."""
+        if not state:
+            return
+        frac = state.get("credit_fraction")
+        if isinstance(frac, (int, float)):
+            self._fraction = min(1.0, max(self.cfg.min_credit_fraction,
+                                          float(frac)))
+        for key in ("shed_total", "expired_total"):
+            v = state.get(key)
+            if isinstance(v, int) and v >= 0:
+                setattr(self, key, v)
+        for key in ("shed_by_tier", "expired_by_tier"):
+            v = state.get(key)
+            if isinstance(v, list):
+                dst = getattr(self, key)
+                for t in range(min(len(dst), len(v))):
+                    if isinstance(v[t], int) and v[t] >= 0:
+                        dst[t] = v[t]
+        self._publish_gauges()
+
     # ---- drain / observability --------------------------------------------
 
     def begin_drain(self) -> None:

@@ -64,7 +64,21 @@ __all__ = ["AsyncSanitizer", "InstrumentedLock", "SanitizerFinding"]
 
 #: Await chains routed through these code names/files are the sanctioned
 #: off-loop seam (asyncio.to_thread and its internals).
-_SANCTIONED_CODE_NAMES = {"to_thread", "run_in_executor"}
+_SANCTIONED_CODE_NAMES = {
+    "to_thread", "run_in_executor",
+    # service/app._shielded_to_thread: a to_thread await hardened against
+    # caller cancellation (asyncio.shield detaches the await chain from
+    # the thread task, so the bare names above no longer appear in the
+    # holder's frames) — the work is off-loop exactly like to_thread.
+    "_shielded_to_thread",
+    # control/arbiter._arbiter_turn: the cross-queue EDF dispatch gate,
+    # awaited with the caller's engine lock held BY DESIGN — the lock
+    # guards the caller's own engine (untouchable while held); the wait
+    # orders against OTHER queues' dispatch sections, and the slot is the
+    # strictly innermost resource (holders never acquire a lock under
+    # it), so the suspension is bounded and cycle-free.
+    "_arbiter_turn",
+}
 
 
 class SanitizerFinding:

@@ -96,6 +96,43 @@ def load_backlog(path: str) -> "dict[str, list[dict]]":
     return out
 
 
+#: Admission-state sidecar format (ISSUE 11 satellite: a restored queue
+#: must resume with IDENTICAL admission decisions — the adaptive credit
+#: fraction is decision state, not just observability).
+ADMISSION_VERSION = 1
+
+
+def save_admission(path: str, per_queue: "dict[str, dict]") -> int:
+    """Serialize per-queue AdmissionController checkpoints (queue →
+    controller.checkpoint()) next to the pool checkpoints.  Atomic like
+    save_pool.  Returns the number of queues saved."""
+    payload = {"version": ADMISSION_VERSION, "saved_at": time.time(),
+               "queues": per_queue}
+    directory = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".json.tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    return len(per_queue)
+
+
+def load_admission(path: str) -> "dict[str, dict]":
+    """Inverse of save_admission: queue → checkpoint dict for
+    AdmissionController.restore_state."""
+    with open(path) as f:
+        payload = json.load(f)
+    if payload.get("version") != ADMISSION_VERSION:
+        raise ValueError(
+            f"unsupported admission checkpoint version: "
+            f"{payload.get('version')}")
+    return {q: dict(v) for q, v in payload.get("queues", {}).items()}
+
+
 def engine_waiting_columns(engine) -> tuple[RequestColumns, np.ndarray, np.ndarray]:
     """Waiting pool as columns + region/mode NAME arrays.
 

@@ -24,7 +24,9 @@ Compared metrics (direction-aware):
                        moves), e2e_matched_per_s, e2e_knee_req_s,
                        e2e_slo_attainment, frontier quality_mean
     lower is better:   p99_ms, e2e_p99_ms, frontier wait_at_match_ms_p99,
-                       frontier quality_disparity
+                       frontier quality_disparity, and the placement-soak
+                       rows (ISSUE 11): placement_blackout_ms_max/mean,
+                       placement_lost, placement_dup
 Frontier rows (``e2e_frontier``, ISSUE 8) are matched by threshold.
 """
 
@@ -45,6 +47,14 @@ TOP_LEVEL_METRICS: dict[str, bool] = {
     "e2e_slo_attainment": True,
     "p99_ms": False,
     "e2e_p99_ms": False,
+    # Elastic placement soak (ISSUE 11, bench.py --placement-soak):
+    # migration blackout and delivery accounting regress downward only.
+    # lost/dup have a zero baseline on a healthy run, so ANY nonzero
+    # fresh value beyond the threshold regresses (see the base==0 rule).
+    "placement_blackout_ms_max": False,
+    "placement_blackout_ms_mean": False,
+    "placement_lost": False,
+    "placement_dup": False,
 }
 
 FRONTIER_METRICS: dict[str, bool] = {

@@ -12,6 +12,20 @@ cd "$(dirname "$0")/.."
 echo "== matchlint =="
 JAX_PLATFORMS=cpu python -m matchmaking_tpu.analysis
 
+echo "== control plane =="
+# ISSUE 11 gate: the settlement + lock-pairing dataflow rules armed over
+# the placement control plane (matchmaking_tpu/control/ joined their
+# scope) — a credit-leak or unbalanced-acquire shape in the migration
+# executor/controller fails fast and by rule name, before the full lint
+# above repeats it in context. --static-only: these two rules need no
+# jax tracing, so this stays sub-second.
+JAX_PLATFORMS=cpu python -m matchmaking_tpu.analysis \
+    --rules settlement,lock-pairing --static-only
+# Placement suite by marker: migration round trip / shard cycle /
+# arbiter regressions fail fast and by name.
+JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'placement and not slow' \
+    --continue-on-collection-errors -p no:cacheprovider
+
 echo "== codec parity =="
 # ISSUE 9 gate: rebuild libmmcodec.so FROM SOURCE (force — CI must never
 # gate against the checked-in binary), then fuzz the native batch codec

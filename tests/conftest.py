@@ -62,6 +62,11 @@ def pytest_configure(config):
         "waited_ms wire contract — scripts/check.sh runs it by marker; "
         "the fast ones are tier-1, soaks additionally carry `slow`)")
     config.addinivalue_line(
+        "markers", "placement: elastic placement control-plane suite "
+        "(queue→device migration / elastic sharding / dispatch "
+        "arbitration — scripts/check.sh runs it by marker; the fast ones "
+        "are tier-1, soaks additionally carry `slow`)")
+    config.addinivalue_line(
         "markers", "codec: native-codec parity fuzz (byte/field equality "
         "vs the Python contract module over a seeded corpus — "
         "scripts/check.sh runs it by marker after rebuilding "

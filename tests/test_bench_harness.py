@@ -134,3 +134,31 @@ def test_bench_diff_gates_e2e_rate_and_p99():
     flags = {r["metric"]: r["regressed"]
              for r in bd.diff(base, mixed, threshold=0.10)}
     assert flags == {"e2e_rate_req_s": False, "e2e_p99_ms": True}
+
+
+def test_bench_diff_gates_placement_blackout_and_accounting():
+    """ISSUE 11 satellite: the placement-soak rows gate direction-aware
+    (lower is better). lost/dup have a zero baseline on a healthy run, so
+    any meaningful nonzero fresh value regresses via the base==0 rule."""
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import bench_diff as bd
+    finally:
+        sys.path.pop(0)
+    base = {"placement_blackout_ms_max": 200.0,
+            "placement_blackout_ms_mean": 80.0,
+            "placement_lost": 0, "placement_dup": 0}
+    worse = {"placement_blackout_ms_max": 400.0,
+             "placement_blackout_ms_mean": 90.0,
+             "placement_lost": 3, "placement_dup": 1}
+    flags = {r["metric"]: r["regressed"]
+             for r in bd.diff(base, worse, threshold=0.10)}
+    assert flags == {"placement_blackout_ms_max": True,
+                     "placement_blackout_ms_mean": True,
+                     "placement_lost": True,
+                     "placement_dup": True}
+    better = {"placement_blackout_ms_max": 150.0,
+              "placement_blackout_ms_mean": 60.0,
+              "placement_lost": 0, "placement_dup": 0}
+    assert not any(r["regressed"]
+                   for r in bd.diff(base, better, threshold=0.10))

@@ -996,3 +996,92 @@ async def handler():
     await asyncio.sleep(0.1)
 ''', path="matchmaking_tpu/service/fixture.py")
     assert findings == []
+
+
+# ---- settlement guard-flag refinement (ISSUE 11 satellite) -----------------
+
+_RECORDED_SHAPE = '''
+class Runtime:
+    # settles: *extra_nack
+    async def _revive_pipelined(self, now, extra_nack=None):
+        for d in extra_nack or ():
+            self._nack(d)
+
+    # settles: delivery
+    def _nack(self, delivery):
+        self.app.broker.nack(self.tag, delivery.delivery_tag)
+
+    # settles: *pairs
+    async def dispatch(self, pairs, now):
+        recorded = False
+        deliveries_in = [d for _, d in pairs]
+        try:
+            tok = self.launch(deliveries_in)
+            self._inflight_meta[tok] = (dict(pairs), deliveries_in)
+            recorded = True
+            self.collect(now)
+        except Exception:
+            await self._revive_pipelined(
+                now, extra_nack=None if recorded else deliveries_in)
+            return
+'''
+
+
+def test_settlement_guard_flag_refinement_proves_recorded_shape():
+    """The PR 10 inline ignore at the `recorded` seam is retired: a bool
+    flag whose ONLY True-assignment immediately follows the window-meta
+    hand-off correlates exactly with the group's escape, so the
+    `None if flag else group` settle argument is exactly-once on every
+    path — no conditional-settlement finding."""
+    findings = analyze_source(_RECORDED_SHAPE,
+                              path="matchmaking_tpu/service/fixture.py")
+    assert [f for f in findings if f.rule == "settlement"] == [], findings
+
+
+def test_settlement_uncorrelated_guard_flag_still_flags():
+    """Move `recorded = True` BEFORE the hand-off and the correlation is
+    broken (an exception between flag-set and hand-off reaches the
+    handler with flag True and the window NOT escaped — nothing would
+    settle it): the refinement must not fire, and the possible
+    double-settle report survives."""
+    broken = _RECORDED_SHAPE.replace(
+        "            self._inflight_meta[tok] = (dict(pairs), deliveries_in)\n"
+        "            recorded = True\n",
+        "            recorded = True\n"
+        "            self._inflight_meta[tok] = (dict(pairs), deliveries_in)\n")
+    findings = [f for f in analyze_source(
+        broken, path="matchmaking_tpu/service/fixture.py")
+        if f.rule == "settlement"]
+    assert findings, "uncorrelated flag must still report"
+    assert any("double-settle" in f.message for f in findings)
+
+
+def test_settlement_refined_shape_with_leftover_ignore_reads_stale():
+    """A now-redundant `# matchlint: ignore[settlement]` on the refined
+    shape suppresses nothing — the stale-ignore rule reports it (this is
+    how the retired app.py ignore was found and removed)."""
+    with_ignore = _RECORDED_SHAPE.replace(
+        "            await self._revive_pipelined(",
+        "            # matchlint: ignore[settlement] retired by the "
+        "guard-flag refinement\n"
+        "            await self._revive_pipelined(")
+    findings = analyze_source(with_ignore,
+                              path="matchmaking_tpu/service/fixture.py")
+    stale = [f for f in findings if f.rule == "stale-ignore"]
+    assert stale, findings
+
+
+def test_settlement_rule_covers_control_package():
+    """ISSUE 11: control/ joined the settlement/lock-pairing scope — a
+    credit-leak shape placed there must report exactly as in service/."""
+    code = '''
+class Executor:
+    async def handle(self, delivery):
+        self.admission.admit(delivery.delivery_tag, delivery.tier)
+        ctx = self.make_context(delivery)
+        self.batcher.submit((None, delivery))
+'''
+    findings = [f for f in analyze_source(
+        code, path="matchmaking_tpu/control/fixture.py")
+        if f.rule == "settlement"]
+    assert findings and any("credit leak" in f.message for f in findings)

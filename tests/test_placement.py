@@ -1,0 +1,609 @@
+"""Elastic placement control plane (`placement` marker — ISSUE 11):
+state typestate, greedy policy over the seeded simulation, live
+queue→device migration under load (zero lost/duplicated requests), the
+D=1→2→1 shard-cycle bit-identity proof, chaos mid-migration, and the
+cross-queue (tier, deadline) dispatch arbiter."""
+
+import asyncio
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from matchmaking_tpu.config import (
+    BatcherConfig,
+    ChaosConfig,
+    Config,
+    EngineConfig,
+    OverloadConfig,
+    PlacementConfig,
+    QueueConfig,
+)
+from matchmaking_tpu.control.arbiter import DispatchArbiter, window_key
+from matchmaking_tpu.control.executor import rebuild_engine
+from matchmaking_tpu.control.policy import GreedyPolicy, QueueSignals, SignalView
+from matchmaking_tpu.control.simulate import SimQueue, run_simulation
+from matchmaking_tpu.control.state import PlacementError, PlacementState
+from matchmaking_tpu.engine.interface import make_engine
+from matchmaking_tpu.service.app import MatchmakingApp
+from matchmaking_tpu.service.client import MatchmakingClient
+from matchmaking_tpu.service.contract import SearchRequest
+
+pytestmark = pytest.mark.placement
+
+
+def _tiny_engine_cfg(mesh: int = 1) -> Config:
+    return Config(
+        queues=(QueueConfig(rating_threshold=100.0),),
+        engine=EngineConfig(backend="tpu", pool_capacity=256, pool_block=64,
+                            batch_buckets=(8, 32), top_k=4,
+                            mesh_pool_axis=mesh),
+    )
+
+
+# ---- state model -----------------------------------------------------------
+
+def test_placement_state_typestate_exactly_once_and_audit():
+    st = PlacementState(4, decision_ring=3)
+    st.bind("a", (0,))
+    st.bind("b", (1,))
+    d = st.begin("migrate", "a", (2,), now=10.0, signals={"x": 1})
+    # Exactly-once: a second action on the same queue is refused while
+    # the first is in flight.
+    with pytest.raises(PlacementError):
+        st.begin("migrate", "a", (3,), now=10.0)
+    st.complete(d, 11.0, blackout_s=0.01, transferred=5)
+    assert st.placement("a").devices == (2,)
+    assert st.placement("a").generation == 1
+    assert st.blackout_max["a"] == pytest.approx(0.01)
+    # Failure leaves the binding untouched but advances the cooldown.
+    d2 = st.begin("migrate", "a", (3,), now=20.0)
+    st.fail(d2, 21.0, "boom")
+    assert st.placement("a").devices == (2,)
+    assert st.placement("a").last_action_t == 21.0
+    # Invalid targets are refused before any typestate change.
+    with pytest.raises(PlacementError):
+        st.begin("migrate", "b", (9,), now=30.0)
+    with pytest.raises(PlacementError):
+        st.begin("migrate", "b", (1, 1), now=30.0)
+    # The audit ring is bounded.
+    for i in range(5):
+        di = st.begin("migrate", "b", ((i % 2) + 2,), now=40.0 + i)
+        st.complete(di, 40.0 + i, 0.0, 0)
+    assert len(st.decisions) == 3
+    snap = st.snapshot()
+    assert snap["bindings"]["a"]["devices"] == [2]
+    assert len(snap["decisions"]) == 3
+
+
+def test_placement_state_shared_and_free_devices():
+    st = PlacementState(4)
+    st.bind("a", (0,))
+    st.bind("b", (0,))
+    st.bind("c", (1, 2))
+    assert st.queues_on(0) == ["a", "b"]
+    assert st.shared_devices() == {0}
+    assert st.free_devices() == [3]
+
+
+# ---- greedy policy over the seeded simulation ------------------------------
+
+def test_greedy_policy_sim_canonical_migrate_promote_demote():
+    """The ISSUE 11 story end to end, without devices: a co-located hot
+    queue migrates to an idle chip, saturates it alone, promotes to D=2,
+    and demotes back once load recedes — deterministically on the seed."""
+    cfg = PlacementConfig(interval_s=0.1, devices=3, cooldown_s=2.0,
+                          max_shard=2)
+    queues = [
+        SimQueue(name="hot", load=(0.3, 1.6, 0.1), edges=(0, 5, 18),
+                 device=0, shardable=True),
+        SimQueue(name="cold", load=(0.1,), edges=(0,), device=0),
+    ]
+    out = run_simulation(cfg, queues, ticks=40, seed=7)
+    kinds = [(d["kind"], d["queue"], tuple(d["to"])) for d in out["decisions"]]
+    assert kinds == [("migrate", "hot", (1,)),
+                     ("promote", "hot", (1, 2)),
+                     ("demote", "hot", (1,))]
+    # Every decision quotes the signals that drove it + a bounded blackout.
+    for d in out["decisions"]:
+        assert "hot" in d["signals"] and d["status"] == "applied"
+        assert 0.0 < d["blackout_ms"] < 100.0
+    # Bit-identical replay on the same seed.
+    assert out == run_simulation(cfg, queues, ticks=40, seed=7)
+    # A different seed still produces a valid (possibly different) trace.
+    run_simulation(cfg, queues, ticks=40, seed=8)
+
+
+def test_greedy_policy_cooldown_degraded_and_solo_rules():
+    cfg = PlacementConfig(interval_s=1.0, devices=3, cooldown_s=100.0,
+                          max_shard=2)
+    policy = GreedyPolicy(cfg)
+    st = PlacementState(3)
+    st.bind("hot", (0,))
+    st.bind("cold", (0,))
+    hot = QueueSignals(burning=True, idle_frac=0.0, occupancy=1.0,
+                       shardable=True)
+    view = SignalView(queues={"hot": hot, "cold": QueueSignals()})
+    # Co-located hot queue migrates to the idle device 1.
+    acts = policy.plan(st, view, now=1000.0)
+    assert [(a.kind, a.queue, a.devices) for a in acts] == [
+        ("migrate", "hot", (1,))]
+    # Cooldown: a queue that just acted is untouchable.
+    d = st.begin("migrate", "hot", (1,), now=1000.0)
+    st.complete(d, 1000.0, 0.0, 0)
+    assert policy.plan(st, view, now=1050.0) == []
+    # After the cooldown, a SOLO hot queue never migrates (no gain) —
+    # it promotes instead (device 2 is free).
+    acts = policy.plan(st, view, now=2000.0)
+    assert [(a.kind, a.queue, a.devices) for a in acts] == [
+        ("promote", "hot", (1, 2))]
+    # Degraded queues are never touched: the host oracle serves them.
+    view_deg = SignalView(queues={
+        "hot": dataclasses.replace(hot, degraded=True),
+        "cold": QueueSignals()})
+    assert policy.plan(st, view_deg, now=3000.0) == []
+
+
+# ---- live migration (service path) -----------------------------------------
+
+async def test_live_migration_under_load_zero_lost_or_dup(sanitizer):
+    """Two live migrations (move + back) while 60 players stream through
+    admission: every player reaches exactly one terminal response, the
+    settlement twin holds (sanitizer fixture asserts at teardown), and
+    the blackout is measured and bounded."""
+    cfg = Config(
+        queues=(QueueConfig(name="mig.q", rating_threshold=200.0),),
+        engine=EngineConfig(backend="tpu", pool_capacity=256, pool_block=64,
+                            batch_buckets=(8, 32), top_k=4),
+        batcher=BatcherConfig(max_batch=8, max_wait_ms=5.0),
+        overload=OverloadConfig(max_inflight=128),
+        placement=PlacementConfig(interval_s=3600.0, devices=4),
+    )
+    app = MatchmakingApp(cfg)
+    await app.start()
+    try:
+        rt = app.runtime("mig.q")
+        assert rt.placement == (0,)
+        client = MatchmakingClient(app.broker, "mig.q")
+
+        async def one(i):
+            return await client.search_until_matched(
+                {"id": f"p{i}", "rating": 1500 + (i % 11) * 9},
+                timeout=15.0)
+
+        tasks = [asyncio.create_task(one(i)) for i in range(60)]
+        await asyncio.sleep(0.02)
+        stats = await rt.migrate((2,))
+        assert stats["devices"] == (2,)
+        assert rt.engine.devices == (2,)
+        assert 0.0 < stats["blackout_s"] < 30.0
+        await asyncio.sleep(0.02)
+        stats2 = await rt.migrate((1,))
+        assert rt.placement == (1,)
+        results = await asyncio.gather(*tasks)
+        matched = [r for r in results if r.status == "matched"]
+        ids = [r.player_id for r in matched]
+        assert len(ids) == len(set(ids)), "duplicate terminal responses"
+        # Zero lost: every submitted player either matched or is STILL
+        # WAITING in the (migrated) pool — matching is arrival-triggered,
+        # so a trailing pairing leftover legitimately waits for the next
+        # arrival; what migration must never do is drop or duplicate one.
+        waiting = {r.id for r in rt.engine.waiting()}
+        assert len(matched) + len(waiting) == 60, \
+            (len(matched), sorted(waiting))
+        assert waiting == {f"p{i}" for i in range(60)} - set(ids)
+        assert len(matched) >= 50  # the bulk really flowed through
+        assert app.metrics.counters.get("queue_migrations") == 2
+        # /debug/placement's live view follows direct migrations too.
+        snap = app.placement.snapshot()
+        assert snap["live"]["mig.q"]["devices"] == [1]
+    finally:
+        await app.stop()
+
+
+async def test_controller_promote_demote_audited_with_blackout(sanitizer):
+    """The controller path: injected signal views drive a promote
+    (D=1→2, the engine really rebuilds onto the sharded kernel set) and a
+    demote back, each audited in /debug/placement with signals and
+    blackout, and traffic still matches afterwards."""
+    cfg = Config(
+        queues=(QueueConfig(name="el.q", rating_threshold=200.0),),
+        engine=EngineConfig(backend="tpu", pool_capacity=256, pool_block=64,
+                            batch_buckets=(8, 32), top_k=4),
+        batcher=BatcherConfig(max_batch=8, max_wait_ms=5.0),
+        placement=PlacementConfig(interval_s=3600.0, devices=4, max_shard=2,
+                                  cooldown_s=0.0),
+    )
+    app = MatchmakingApp(cfg)
+    await app.start()
+    try:
+        rt = app.runtime("el.q")
+        hot = SignalView(queues={"el.q": QueueSignals(
+            burning=True, idle_frac=0.0, occupancy=0.9, shardable=True)})
+        dec = await app.placement.step(now=1000.0, view=hot)
+        assert dec is not None and dec["kind"] == "promote"
+        assert rt.placement == (0, 1)
+        assert type(rt.engine.kernels).__name__ == "ShardedKernelSet"
+        assert [str(d) for d in rt.engine.kernels.mesh.devices.flatten()] \
+            == ["TFRT_CPU_0", "TFRT_CPU_1"]
+        cold = SignalView(queues={"el.q": QueueSignals(
+            burning=False, idle_frac=0.95, occupancy=0.05, shardable=True)})
+        dec2 = await app.placement.step(now=2000.0, view=cold)
+        assert dec2 is not None and dec2["kind"] == "demote"
+        assert rt.placement == (0,)
+        assert type(rt.engine.kernels).__name__ == "KernelSet"
+        snap = app.placement.snapshot()
+        assert [d["kind"] for d in snap["decisions"]] == ["promote",
+                                                          "demote"]
+        for d in snap["decisions"]:
+            assert d["status"] == "applied"
+            assert d["blackout_ms"] > 0.0
+            assert "el.q" in d["signals"]
+        assert snap["bindings"]["el.q"]["devices"] == [0]
+        assert snap["bindings"]["el.q"]["generation"] == 2
+        # The demoted engine still serves traffic (arrival-triggered:
+        # window-boundary leftovers legitimately wait, nothing is lost).
+        client = MatchmakingClient(app.broker, "el.q")
+        r = await asyncio.gather(*[
+            client.search_until_matched({"id": f"e{i}", "rating": 1500},
+                                        timeout=10.0) for i in range(4)])
+        matched = [x for x in r if x.status == "matched"]
+        assert len(matched) + rt.engine.pool_size() == 4
+        assert len(matched) >= 2
+    finally:
+        await app.stop()
+
+
+async def test_migration_refused_while_degraded():
+    cfg = Config(
+        queues=(QueueConfig(name="deg.q"),),
+        engine=EngineConfig(backend="tpu", pool_capacity=128, pool_block=32,
+                            batch_buckets=(8,), top_k=4,
+                            breaker_threshold=1),
+        placement=PlacementConfig(interval_s=3600.0, devices=2),
+    )
+    app = MatchmakingApp(cfg)
+    await app.start()
+    try:
+        rt = app.runtime("deg.q")
+        rt.breaker.record_crash(0.0)
+        assert rt.breaker.state != "closed"
+        with pytest.raises(RuntimeError, match="degraded"):
+            await rt.migrate((1,))
+        assert rt.placement == (0,)
+    finally:
+        await app.stop()
+
+
+# ---- shard cycle bit-identity (the acceptance proof) -----------------------
+
+def _seeded_requests(rng, n, start):
+    return [
+        SearchRequest(id=f"s{start + i}", rating=float(r),
+                      rating_deviation=60.0, game_mode="m", region="r")
+        for i, r in enumerate(rng.normal(1500.0, 120.0, n))
+    ]
+
+
+def _match_pairs(out):
+    """Order-free fingerprint of one window's matches: sorted (a, b,
+    quality) rows — match ids are process-global counters and excluded."""
+    rows = []
+    for m in out.matches:
+        ids = sorted(r.id for r in m.requests())
+        rows.append((ids[0], ids[1], float(m.quality)))
+    return sorted(rows)
+
+
+def test_shard_cycle_bit_identical_vs_never_migrated_control():
+    """Promote→demote (D=1→2→1) through the real rebuild primitive
+    returns BIT-IDENTICAL match results versus a never-migrated control
+    engine fed the same seeded windows."""
+    cfg1 = _tiny_engine_cfg(mesh=1)
+    queue = cfg1.queues[0]
+    rng_a = np.random.default_rng(42)
+    rng_b = np.random.default_rng(42)
+    windows_a = [_seeded_requests(rng_a, 24, 100 * k) for k in range(3)]
+    windows_b = [_seeded_requests(rng_b, 24, 100 * k) for k in range(3)]
+
+    control = make_engine(cfg1, queue, devices=(0,))
+    cycle = make_engine(cfg1, queue, devices=(0,))
+    outs_control = [_match_pairs(control.search(windows_a[0], 1000.0))]
+    outs_cycle = [_match_pairs(cycle.search(windows_b[0], 1000.0))]
+
+    # Promote: D=1 → D=2 over devices (0, 1).
+    cfg2 = _tiny_engine_cfg(mesh=2)
+    cycle, stats = rebuild_engine(
+        cycle, lambda: make_engine(cfg2, queue, devices=(0, 1)), now=1000.5)
+    assert stats["transferred"] == control.pool_size()
+    outs_control.append(_match_pairs(control.search(windows_a[1], 1001.0)))
+    outs_cycle.append(_match_pairs(cycle.search(windows_b[1], 1001.0)))
+
+    # Demote: back to D=1 on device 1.
+    cycle, stats = rebuild_engine(
+        cycle, lambda: make_engine(cfg1, queue, devices=(1,)), now=1001.5)
+    outs_control.append(_match_pairs(control.search(windows_a[2], 1002.0)))
+    outs_cycle.append(_match_pairs(cycle.search(windows_b[2], 1002.0)))
+
+    assert outs_cycle == outs_control
+    assert cycle.pool_size() == control.pool_size()
+    # Quality accounting survived both rebuilds (monotone, not reset).
+    rep_cycle = cycle.quality_report()
+    rep_control = control.quality_report()
+    assert rep_cycle["samples"] == rep_control["samples"] > 0
+
+
+def test_rebuild_failure_leaves_source_engine_serving():
+    from matchmaking_tpu.control.executor import MigrationFailed
+
+    cfg = _tiny_engine_cfg()
+    queue = cfg.queues[0]
+    engine = make_engine(cfg, queue, devices=(0,))
+    rng = np.random.default_rng(3)
+    engine.search(_seeded_requests(rng, 9, 0), 1000.0)
+    before = engine.pool_size()
+    assert before > 0
+
+    def broken():
+        raise RuntimeError("no such device")
+
+    with pytest.raises(MigrationFailed):
+        rebuild_engine(engine, broken, now=1000.5)
+    assert engine.pool_size() == before
+    out = engine.search(_seeded_requests(rng, 9, 50), 1001.0)
+    assert out.matches  # still serving
+
+
+# ---- chaos mid-migration (ISSUE 11 satellite) ------------------------------
+
+@pytest.mark.chaos
+async def test_chaos_fault_around_migration_settlement_clean(sanitizer):
+    """A seeded PR 2 fault schedule firing around two live migrations:
+    the settlement twin must stay clean (no double-settle, no held
+    credit — the sanitizer fixture asserts at teardown), every player
+    still reaches exactly one terminal response, and the engine-side
+    quality accounting (/debug/quality's engine block) stays monotone
+    across the moves and the chaos revive."""
+    cfg = Config(
+        queues=(QueueConfig(name="cx.q", rating_threshold=200.0),),
+        engine=EngineConfig(backend="tpu", pool_capacity=256, pool_block=64,
+                            batch_buckets=(8, 32), top_k=4),
+        batcher=BatcherConfig(max_batch=8, max_wait_ms=5.0),
+        overload=OverloadConfig(max_inflight=128),
+        chaos=ChaosConfig(seed=11, queues=("cx.q",), fail_steps=(2, 5),
+                          dup_seqs=((3, 1),)),
+        placement=PlacementConfig(interval_s=3600.0, devices=4),
+        debug_invariants=True,
+    )
+    app = MatchmakingApp(cfg)
+    await app.start()
+    try:
+        rt = app.runtime("cx.q")
+        client = MatchmakingClient(app.broker, "cx.q")
+
+        async def one(i):
+            return await client.search_until_matched(
+                {"id": f"c{i}", "rating": 1500 + (i % 13) * 7},
+                timeout=20.0)
+
+        tasks = [asyncio.create_task(one(i)) for i in range(40)]
+        await asyncio.sleep(0.05)
+        samples_before = rt.engine.quality_report()["samples"]
+        await rt.migrate((3,))
+        mid = rt.engine.quality_report()["samples"]
+        assert mid >= samples_before
+        await asyncio.sleep(0.05)
+        await rt.migrate((0,))
+        results = await asyncio.gather(*tasks)
+        matched = [r for r in results if r.status == "matched"]
+        ids = [r.player_id for r in matched]
+        assert len(ids) == len(set(ids)), "duplicate terminal responses"
+        # Zero lost under chaos: matched or still waiting, nothing else.
+        waiting = {r.id for r in rt.engine.waiting()}
+        assert len(matched) + len(waiting) == 40, \
+            (len(matched), sorted(waiting))
+        assert len(matched) >= 30
+        # Chaos really fired (engine crashes + revives happened) and the
+        # quality samples are monotone through faults AND migrations.
+        assert app.metrics.counters.get("engine_crashes") >= 1
+        # Monotone, never reset — the device accumulator snapshot may be
+        # up to quality_report_every windows stale, so the floor is the
+        # pre-migration sample count, not the final matched total.
+        assert rt.engine.quality_report()["samples"] >= max(mid, 1)
+        report = sanitizer.settlement_report()
+        assert report["open_credits"] == []
+    finally:
+        await app.stop()
+
+
+# ---- cross-queue dispatch arbiter ------------------------------------------
+
+def test_window_key_min_tier_then_deadline():
+    class D:
+        def __init__(self, tier, deadline):
+            self.tier = tier
+            self.deadline = deadline
+
+    assert window_key([D(2, 50.0), D(1, 900.0), D(1, 30.0)]) == (1, 30.0)
+    assert window_key([D(0, 0.0)]) == (0, float("inf"))
+    assert window_key([]) == (1 << 30, float("inf"))
+
+
+async def test_arbiter_grants_waiters_in_edf_order():
+    arb = DispatchArbiter()
+    arb.set_shared({0})
+    order: list[str] = []
+
+    async def holder():
+        async with arb.slot(0, (0, 1.0)):
+            order.append("hold")
+            await asyncio.sleep(0.05)
+
+    async def waiter(name, key, delay):
+        await asyncio.sleep(delay)
+        async with arb.slot(0, key):
+            order.append(name)
+
+    await asyncio.gather(
+        holder(),
+        waiter("late-tier0", (0, 10.0), 0.02),
+        waiter("tier2", (2, 1.0), 0.01),
+        waiter("tier1-early-deadline", (1, 5.0), 0.015),
+        waiter("tier1-late-deadline", (1, 99.0), 0.012),
+    )
+    assert order == ["hold", "late-tier0", "tier1-early-deadline",
+                     "tier1-late-deadline", "tier2"]
+    snap = arb.snapshot()
+    assert snap["grants"] == 5 and snap["holds"] == 4
+    # Unshared devices bypass the gate entirely.
+    assert not arb.engaged(1)
+    async with arb.slot(1, (0, 0.0)):
+        pass
+    assert arb.snapshot()["grants"] == 5  # bypass granted nothing
+
+
+async def test_arbiter_engages_only_on_colocated_queues(sanitizer):
+    """Service-level: two queues migrated onto one device get the
+    arbiter engaged (shared set fed by the controller) and both still
+    serve; moving one away disengages it."""
+    cfg = Config(
+        queues=(QueueConfig(name="ar.a", rating_threshold=200.0),
+                QueueConfig(name="ar.b", rating_threshold=200.0)),
+        engine=EngineConfig(backend="tpu", pool_capacity=128, pool_block=32,
+                            batch_buckets=(8,), top_k=4),
+        batcher=BatcherConfig(max_batch=8, max_wait_ms=5.0),
+        placement=PlacementConfig(interval_s=3600.0, devices=2,
+                                  cooldown_s=0.0),
+    )
+    app = MatchmakingApp(cfg)
+    await app.start()
+    try:
+        ctrl = app.placement
+        # Boot: a→0, b→1. Co-locate b on 0 through the controller's
+        # bookkeeping path so the arbiter engagement set follows.
+        dec = ctrl.state.begin("migrate", "ar.b", (0,), now=1.0)
+        stats = await app.runtime("ar.b").migrate((0,))
+        ctrl.state.complete(dec, 2.0, stats["blackout_s"],
+                            stats["transferred"])
+        ctrl._feed_arbiter()
+        assert ctrl.arbiter.engaged(0)
+        client_a = MatchmakingClient(app.broker, "ar.a")
+        client_b = MatchmakingClient(app.broker, "ar.b")
+        results = await asyncio.gather(*(
+            [client_a.search_until_matched(
+                {"id": f"a{i}", "rating": 1500 + 3 * i}, timeout=10.0)
+             for i in range(6)]
+            + [client_b.search_until_matched(
+                {"id": f"b{i}", "rating": 1500 + 3 * i}, timeout=10.0)
+               for i in range(6)]))
+        matched = [r for r in results if r.status == "matched"]
+        waiting = (len(app.runtime("ar.a").engine.waiting())
+                   + len(app.runtime("ar.b").engine.waiting()))
+        assert len(matched) + waiting == 12, [r.status for r in results]
+        assert len(matched) >= 8
+        assert ctrl.arbiter.grants > 0
+        # Disengage: move b back to its own chip.
+        dec2 = ctrl.state.begin("migrate", "ar.b", (1,), now=3.0)
+        stats2 = await app.runtime("ar.b").migrate((1,))
+        ctrl.state.complete(dec2, 4.0, stats2["blackout_s"],
+                            stats2["transferred"])
+        ctrl._feed_arbiter()
+        assert not ctrl.arbiter.engaged(0)
+    finally:
+        await app.stop()
+
+
+# ---- /debug/placement payload ----------------------------------------------
+
+async def test_placement_snapshot_is_json_ready():
+    cfg = Config(
+        queues=(QueueConfig(name="js.q"),),
+        engine=EngineConfig(backend="tpu", pool_capacity=128, pool_block=32,
+                            batch_buckets=(8,), top_k=4),
+        placement=PlacementConfig(interval_s=3600.0, devices=2),
+    )
+    app = MatchmakingApp(cfg)
+    await app.start()
+    try:
+        snap = app.placement.snapshot()
+        json.dumps(snap)  # JSON-ready end to end
+        assert snap["n_devices"] == 2
+        assert snap["bindings"]["js.q"]["devices"] == [0]
+        assert snap["interval_s"] == 3600.0
+        assert "arbiter" in snap and "live" in snap
+    finally:
+        await app.stop()
+
+
+# ---- review-hardening regressions ------------------------------------------
+
+def test_placement_state_refusals_are_audited():
+    st = PlacementState(2)
+    st.bind("a", (0,))
+    d = st.refuse("migrate", "a", (0,), now=5.0, detail="already there")
+    assert d.status == "refused" and d.src == (0,) and d.dst == (0,)
+    # Unknown queues and invalid targets audit too (raw, unvalidated).
+    st.refuse("migrate", "ghost", (7,), now=6.0, detail="unknown queue")
+    assert [x.status for x in st.decisions] == ["refused", "refused"]
+
+
+async def test_controller_force_refusal_lands_in_audit_ring():
+    cfg = Config(
+        queues=(QueueConfig(name="rf.q"),),
+        engine=EngineConfig(backend="tpu", pool_capacity=128, pool_block=32,
+                            batch_buckets=(8,), top_k=4),
+        placement=PlacementConfig(interval_s=3600.0, devices=2),
+    )
+    app = MatchmakingApp(cfg)
+    await app.start()
+    try:
+        # Forcing the CURRENT binding is refused — and audited.
+        dec = await app.placement.force("migrate", "rf.q", (0,))
+        assert dec is not None and dec["status"] == "refused"
+        dec2 = await app.placement.force("migrate", "nope", (1,))
+        assert dec2 is not None and dec2["status"] == "refused"
+        snap = app.placement.snapshot()
+        assert [d["status"] for d in snap["decisions"]] == ["refused",
+                                                            "refused"]
+        assert snap["refusals"] == 2
+        assert app.placement.state.placement("rf.q").status == "stable"
+    finally:
+        await app.stop()
+
+
+async def test_arbiter_cancelled_waiter_does_not_wedge_device():
+    """A waiter cancelled while queued must neither strand its heap
+    entry (granted-to-dead-task) nor leak the busy slot — the device
+    keeps granting afterwards."""
+    arb = DispatchArbiter()
+    arb.set_shared({0})
+    done: list[str] = []
+
+    async def holder():
+        async with arb.slot(0, (0, 1.0)):
+            await asyncio.sleep(0.05)
+            done.append("holder")
+
+    async def doomed():
+        await asyncio.sleep(0.01)
+        async with arb.slot(0, (0, 2.0)):
+            done.append("doomed")  # never reached
+
+    async def survivor():
+        await asyncio.sleep(0.02)
+        async with arb.slot(0, (3, 99.0)):
+            done.append("survivor")
+
+    h = asyncio.create_task(holder())
+    d = asyncio.create_task(doomed())
+    s = asyncio.create_task(survivor())
+    await asyncio.sleep(0.03)
+    d.cancel()
+    await asyncio.gather(h, s, return_exceptions=True)
+    assert done == ["holder", "survivor"]
+    # And a fresh dispatch still flows (no stranded busy slot).
+    async with arb.slot(0, (0, 0.0)):
+        done.append("after")
+    assert done[-1] == "after"
+    assert not arb.snapshot()["waiting"]
