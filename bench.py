@@ -708,6 +708,14 @@ def bench_e2e(args) -> dict:
                    "p99_ms": cat.get("p99_ms")}
             for name, cat in (attr.get("categories") or {}).items()
         }
+        # Consume/decode ingest share (ISSUE 12): the broker-consume +
+        # wire-decode WORK fraction of the settled span — the number the
+        # consume_batch seam exists to shrink. Recorded top-level so
+        # bench_diff gates it direction-aware (lower is better).
+        cats = attr.get("categories") or {}
+        out["e2e_consume_share"] = round(
+            sum((cats.get(c) or {}).get("share") or 0.0
+                for c in ("consume", "decode")), 4)
         if hasattr(rt.engine, "util_report"):
             u = rt.engine.util_report()
             out["e2e_idle_fraction"] = u["idle_fraction"]
@@ -1059,10 +1067,19 @@ def run_cpu_fallback(args) -> None:
         devices = jax.devices()
     except Exception as e:
         log(f"[fallback] CPU backend init failed too: {e!r}")
+        # Structured abort (ISSUE 12 satellite — what burned BENCH_r05):
+        # the round records WHY it aborted and what it was configured to
+        # measure, so the driver archives an explainable partial artifact
+        # and bench_diff skips the round instead of failing on nulls.
         print(json.dumps({
             "metric": f"matches/sec @ {args.pool}-player pool (1v1 ELO)",
             "value": None, "unit": "matches/sec", "vs_baseline": None,
             "error": "backend_unavailable",
+            "abort_reason": "backend_unavailable",
+            "abort_detail": f"cpu fallback init failed: {e!r}",
+            "abort_config": {"pool": args.pool, "window": args.window,
+                             "depth": args.depth,
+                             "init_retries": args.init_retries},
         }), flush=True)
         return
     log(f"[fallback] TPU unavailable — running CPU-mesh configs on "
@@ -1102,6 +1119,10 @@ def run_cpu_fallback(args) -> None:
     except Exception as e:
         log(f"[fallback] e2e phase failed: {e!r}")
         out["error"] = "cpu_fallback_failed"
+        # Partial-result abort record: the comms rows (if any) above stay
+        # in the artifact; the reason travels with them.
+        out["abort_reason"] = "cpu_fallback_failed"
+        out["abort_detail"] = repr(e)
     if args.e2e_quality:
         # The frontier is a shape measurement — it runs on the CPU mesh
         # unchanged (the acceptance gate for ISSUE 8 reads it here).
@@ -1110,6 +1131,79 @@ def run_cpu_fallback(args) -> None:
         except Exception as e:
             log(f"[fallback] e2e-quality phase failed: {e!r}")
     print(json.dumps(out), flush=True)
+
+
+def bench_consume_ab(args) -> dict:
+    """Consume-share A/B (ISSUE 12 acceptance): the SAME seeded offered
+    load through two fresh single-queue apps — ``consume_batch`` on vs
+    off — recording each run's consume+decode ingest work (seconds, and
+    share of the settled span). The acceptance bar is the ON config's
+    consume/decode work per request down ≥ 2× at fixed offered load;
+    ``work_reduction_x`` is that ratio, measured, in the artifact."""
+    import asyncio
+
+    from matchmaking_tpu.config import (
+        BatcherConfig,
+        BrokerConfig,
+        Config,
+        EngineConfig,
+        ObservabilityConfig,
+        QueueConfig,
+    )
+    from matchmaking_tpu.service.app import MatchmakingApp
+    from matchmaking_tpu.service.loadgen import offered_load
+
+    async def one(consume_batch: bool) -> dict:
+        cfg = Config(
+            queues=(QueueConfig(rating_threshold=100.0,
+                                send_queued_ack=False),),
+            engine=EngineConfig(
+                backend="tpu", pool_capacity=8192, pool_block=2048,
+                batch_buckets=(16, 64, 256), top_k=8,
+                pipeline_depth=min(args.depth, 2), warm_start=True),
+            batcher=BatcherConfig(max_batch=256, max_wait_ms=3.0),
+            broker=BrokerConfig(prefetch=8192,
+                                consume_batch=consume_batch),
+            observability=ObservabilityConfig(snapshot_interval_s=0.0),
+        )
+        app = MatchmakingApp(cfg)
+        await app.start()
+        try:
+            res = await offered_load(
+                app, cfg.broker.request_queue,
+                rate=float(args.e2e_ab_rate),
+                duration=float(args.e2e_ab_seconds), seed=7)
+            attr = app.attribution.snapshot()["queues"].get(
+                cfg.broker.request_queue, {})
+            cats = attr.get("categories") or {}
+            work_s = sum((cats.get(c) or {}).get("total_s") or 0.0
+                         for c in ("consume", "decode"))
+            sent = max(1, res.get("sent", 1))
+            return {
+                "consume_batch": consume_batch,
+                "share": round(sum(
+                    (cats.get(c) or {}).get("share") or 0.0
+                    for c in ("consume", "decode")), 4),
+                "work_s": round(work_s, 6),
+                "work_us_per_req": round(work_s / sent * 1e6, 3),
+                "sent": res.get("sent"),
+                "matched": res.get("players_matched"),
+            }
+        finally:
+            await app.stop()
+
+    async def run() -> dict:
+        on = await one(True)
+        off = await one(False)
+        ratio = (off["work_s"] / on["work_s"]) if on["work_s"] else None
+        return {"e2e_consume_ab": {
+            "on": on, "off": off,
+            "work_reduction_x": round(ratio, 2) if ratio else None,
+            "rate_req_s": float(args.e2e_ab_rate),
+            "seconds": float(args.e2e_ab_seconds),
+        }}
+
+    return asyncio.run(run())
 
 
 def bench_cpu_oracle(args) -> dict:
@@ -1370,6 +1464,15 @@ def main() -> None:
                    help="iid rating stddev for frontier arrivals (diverse "
                         "ratings, NOT the loadgen's paired default — the "
                         "threshold must bite for quality/wait to trade)")
+    p.add_argument("--e2e-ab-seconds", type=float, default=0.0,
+                   help="consume-share A/B phase (ISSUE 12): run the same "
+                        "seeded load through consume_batch=on and =off "
+                        "apps for this many seconds each and record the "
+                        "measured consume+decode work reduction "
+                        "(e2e_consume_ab). 0 = skip (two extra app boots "
+                        "+ warmups)")
+    p.add_argument("--e2e-ab-rate", type=float, default=4000.0,
+                   help="offered req/s for the consume-share A/B phase")
     p.add_argument("--e2e-sweep-seconds", type=float, default=4.0,
                    help="duration of each saturation-sweep step")
     p.add_argument("--e2e-slo-ms", type=float, default=250.0,
@@ -1477,13 +1580,21 @@ def main() -> None:
         if args.no_cpu_fallback:
             # One parseable line, rc=0: the driver records the outage
             # itself rather than an evidence-less crashed round (round-2
-            # postmortem).
+            # postmortem). abort_reason is the structured form (ISSUE 12
+            # satellite): bench_diff skips aborted rounds by it, and the
+            # config echo makes the lost round reproducible.
             print(json.dumps({
                 "metric": f"matches/sec @ {args.pool}-player pool (1v1 ELO)",
                 "value": None,
                 "unit": "matches/sec",
                 "vs_baseline": None,
                 "error": "backend_unavailable",
+                "abort_reason": "backend_unavailable",
+                "abort_detail": (f"TPU init failed after "
+                                 f"{args.init_retries} attempts"),
+                "abort_config": {"pool": args.pool, "window": args.window,
+                                 "depth": args.depth,
+                                 "readback_group": args.readback_group},
             }), flush=True)
             return
         # ROADMAP carry-over (BENCH_r05): a dead backend still yields a
@@ -1549,6 +1660,11 @@ def main() -> None:
             e2e.update(bench_quality_frontier(args))
         except Exception as e:
             log(f"[e2e-quality] failed: {e!r}")
+    if args.e2e_ab_seconds > 0:
+        try:
+            e2e.update(bench_consume_ab(args))
+        except Exception as e:
+            log(f"[e2e-consume-ab] failed: {e!r}")
     mp = {}
     if not args.skip_multiproc:
         try:

@@ -66,6 +66,7 @@ the hand-rolled pairings a future migration/retry path would add.
 from __future__ import annotations
 
 import ast
+import copy
 import re
 from typing import Any
 
@@ -869,6 +870,121 @@ def _loop_settles(scan: _FnScan, sf: SourceFile, qual: str,
             and not any(v in (HELD, MIX) for v in vals))
 
 
+# ---- flush() return-contract refinement (ISSUE 12 satellite) ----------------
+#
+# The engine contract behind the non-pipelined columnar flush: a closure
+# that DISPATCHES a window (``search_columns_async`` / ``search_async``)
+# and returns ``engine.flush()`` yields exactly the windows in flight —
+# here exactly ONE, because the dispatch immediately precedes the flush
+# under the same lock.  So ``outs = await asyncio.to_thread(run_engine)``
+# is a depth-1, never-empty sequence, and ``for tok, out in outs:`` runs
+# its body exactly once.  Without that value-flow fact the typestate sees
+# two false paths: a second iteration double-settling the window's
+# deliveries, and a zero-iteration path leaving them unsettled.  The
+# refinement DESUGARS such loops to their bodies (execute exactly once)
+# before the CFG is built — the two PR 9 inline ignores this replaces are
+# retired.  Deliberately narrow: the iterated name must be assigned
+# exactly once, from ``to_thread(<closure>)`` where the closure both
+# dispatches and returns a ``.flush()`` call, and the loop must have no
+# break/continue/else.
+
+_DISPATCH_LEAVES = frozenset({"search_columns_async", "search_async"})
+
+
+def _flush_closure_names(fn: ast.AST) -> set[str]:
+    """Local defs that dispatch a window and return ``engine.flush()``."""
+    out: set[str] = set()
+    for node in ast.walk(fn):
+        if (not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                or node is fn):
+            continue
+        dispatches = returns_flush = False
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                leaf = (dotted_name(sub.func) or "").rsplit(".", 1)[-1]
+                if leaf in _DISPATCH_LEAVES:
+                    dispatches = True
+            elif (isinstance(sub, ast.Return)
+                  and isinstance(sub.value, ast.Call)
+                  and (dotted_name(sub.value.func) or "").endswith("flush")):
+                returns_flush = True
+        if dispatches and returns_flush:
+            out.add(node.name)
+    return out
+
+
+def _singleton_flush_vars(fn: ast.AST, closures: set[str]) -> set[str]:
+    """Names bound EXACTLY ONCE — by a plain ``(await) asyncio.to_thread(f)``
+    assignment with ``f`` a dispatch-then-flush closure — and by NOTHING
+    else (any other binding construct — loop target, with-item, walrus,
+    aug/ann assignment — disqualifies: a rebound name no longer carries
+    the flush() return contract)."""
+    assigned: dict[str, int] = {}
+    singles: set[str] = set()
+    for node in ast.walk(fn):
+        # Every binding construct counts against "exactly once".
+        targets: list[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign, ast.For,
+                               ast.AsyncFor, ast.NamedExpr)):
+            targets = [node.target]
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            targets = [item.optional_vars for item in node.items
+                       if item.optional_vars is not None]
+        for tgt in targets:
+            for name in _binding_names(tgt):
+                assigned[name] = assigned.get(name, 0) + 1
+        if (not isinstance(node, ast.Assign) or len(node.targets) != 1
+                or not isinstance(node.targets[0], ast.Name)):
+            continue
+        value = node.value
+        if isinstance(value, ast.Await):
+            value = value.value
+        if (isinstance(value, ast.Call)
+                and (dotted_name(value.func) or "").rsplit(".", 1)[-1]
+                == "to_thread"
+                and value.args and isinstance(value.args[0], ast.Name)
+                and value.args[0].id in closures):
+            singles.add(node.targets[0].id)
+    return {name for name in singles if assigned.get(name) == 1}
+
+
+class _SingletonLoopDesugar(ast.NodeTransformer):
+    """Replace ``for … in <singleton-var>:`` with its body (runs once)."""
+
+    def __init__(self, names: set[str]):
+        self.names = names
+
+    def _qualifies(self, node: "ast.For | ast.AsyncFor") -> bool:
+        if not (isinstance(node.iter, ast.Name)
+                and node.iter.id in self.names and not node.orelse):
+            return False
+        return not any(isinstance(sub, (ast.Break, ast.Continue))
+                       for sub in ast.walk(node))
+
+    def visit_For(self, node: ast.For):
+        self.generic_visit(node)
+        if self._qualifies(node):
+            return node.body
+        return node
+
+    visit_AsyncFor = visit_For
+
+
+def _refine_flush_loops(fn: ast.AST) -> ast.AST:
+    """The depth-1/never-empty flush() refinement: desugar qualifying
+    loops on a COPY of the function (the shared tree must stay pristine
+    for the other rules)."""
+    closures = _flush_closure_names(fn)
+    if not closures:
+        return fn
+    names = _singleton_flush_vars(fn, closures)
+    if not names:
+        return fn
+    return _SingletonLoopDesugar(names).visit(copy.deepcopy(fn))
+
+
 def check(sources: list[SourceFile]) -> list[Finding]:
     findings: list[Finding] = []
     for sf in sources:
@@ -882,6 +998,10 @@ def check(sources: list[SourceFile]) -> list[Finding]:
         for cls, fn in _iter_functions(sf.tree):
             qual = f"{cls}.{fn.name}" if cls else fn.name
             contract = contracts.get(qual) or _FnContract(fn)
+            # Depth-1/never-empty flush() return contract (ISSUE 12):
+            # loops over a dispatch-then-flush closure's result execute
+            # exactly once — desugared before the CFG is built.
+            fn = _refine_flush_loops(fn)
             scan = _FnScan(fn, contract, contracts, cls)
             scan._sf = sf
             # Re-scan # owns: locals now that the source is attached.

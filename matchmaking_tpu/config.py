@@ -221,6 +221,37 @@ class BrokerConfig:
     #: needing them take the full publish() path inside the batch. False =
     #: the per-response path, byte for byte.
     batch_publish: bool = True
+    #: Columnar consume_batch ingress (ISSUE 12 — the decode side of the
+    #: batch response encoder): the broker drains whole bursts of buffered
+    #: deliveries and hands the app ONE callback per burst instead of one
+    #: handler invocation + bookkeeping per delivery; the app then runs
+    #: admission pre-checks, the native batch request decode (one C call
+    #: over the burst's concatenated bodies + offsets), and the batcher
+    #: hand-off burst-granular. Per-delivery semantics are preserved: a
+    #: broker with consume-side fault injection armed (chaos drops, delay)
+    #: keeps the per-delivery handler path so fault identity replays
+    #: bit-identically, and auth-RPC services keep per-delivery tasks (the
+    #: round trips must overlap). False = the per-delivery PR 9 path, byte
+    #: for byte.
+    consume_batch: bool = True
+    #: Deliveries per consume burst (the batch callback's max rows; also
+    #: the AMQP loop-bridge coalescing cap).
+    consume_batch_max: int = 256
+    #: In-process ingress shard workers per queue (ISSUE 12): a burst's
+    #: contract-fallback rows are consistent-hashed (crc32 of the
+    #: correlation id — the request identity available pre-decode) into N
+    #: worker slices, and the shard columns merge at the EDF cut feeding
+    #: the single device engine. The terminal-replay dedup cache is
+    #: independently split into per-shard dicts by player id; shard
+    #: workers never touch it (the probe runs at the cut, on the event
+    #: loop), and the remaining ingress state (admission credits,
+    #: batcher) stays event-loop-confined and is proven
+    #: settle-exactly-once by matchlint's settlement typestate — which is
+    #: what keeps the whole split lock-free. 1 = today's single-worker
+    #: path, byte for byte. N > 1 runs shard slices on worker threads
+    #: (the native decode and numpy assembly release the GIL, so
+    #: multi-core hosts parallelize ingress).
+    ingress_shards: int = 1
     # Fault-injection hooks (SURVEY.md §5 "Failure detection").
     drop_prob: float = 0.0
     dup_prob: float = 0.0

@@ -93,6 +93,22 @@ def _load() -> ctypes.CDLL | None:
                 np.ctypeslib.ndpointer(np.int64),         # region_off
                 np.ctypeslib.ndpointer(np.int64),         # mode_off
             ]
+            lib.mm_decode_requests_concat.restype = ctypes.c_int64
+            lib.mm_decode_requests_concat.argtypes = [
+                ctypes.c_char_p,                          # buf (concat bodies)
+                ctypes.c_int64,                           # buf_len
+                np.ctypeslib.ndpointer(np.int64),         # body offsets [n+1]
+                ctypes.c_int32,                           # n
+                np.ctypeslib.ndpointer(np.float32),       # rating
+                np.ctypeslib.ndpointer(np.float32),       # rd
+                np.ctypeslib.ndpointer(np.float32),       # threshold
+                np.ctypeslib.ndpointer(np.int32),         # status
+                ctypes.c_char_p,                          # arena
+                ctypes.c_int64,                           # cap
+                np.ctypeslib.ndpointer(np.int64),         # id_off
+                np.ctypeslib.ndpointer(np.int64),         # region_off
+                np.ctypeslib.ndpointer(np.int64),         # mode_off
+            ]
             lib.mm_encode_matched.restype = ctypes.c_int64
             lib.mm_encode_matched.argtypes = [
                 ctypes.POINTER(ctypes.c_char_p),          # id_a
@@ -176,6 +192,47 @@ def decode_batch(bodies: list[bytes]):
     used = lib.mm_decode_requests(
         bufs, lens, n, rating, rd, threshold, status, arena, cap,
         id_off, region_off, mode_off)
+    if used < 0:  # arena overflow cannot happen (strings ⊆ input), but guard
+        return None
+    raw = arena.raw
+    ids = np.empty(n, object)
+    regions = np.empty(n, object)
+    modes = np.empty(n, object)
+    for i in range(n):
+        if status[i] == OK:
+            ids[i] = raw[id_off[i]:region_off[i]].decode()
+            regions[i] = raw[region_off[i]:mode_off[i]].decode()
+            modes[i] = raw[mode_off[i]:id_off[i + 1]].decode()
+        else:
+            ids[i] = regions[i] = modes[i] = ""
+    return ids, rating, rd, threshold, regions, modes, status
+
+
+def decode_batch_concat(buf: bytes, offsets: "np.ndarray"):
+    """Decode a consume burst's bodies natively from the CONCAT layout
+    (ISSUE 12): one contiguous buffer of n bodies packed back-to-back with
+    ``offsets`` ([n+1] int64; body i spans offsets[i]..offsets[i+1]) — the
+    mirror of the encoders' arena+offset output, so a broker burst flows
+    into the decoder without a per-row pointer table. Same return shape as
+    ``decode_batch``; rows with inverted/out-of-range offsets come back as
+    ``bad_json``. None when the native library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    offsets = np.ascontiguousarray(offsets, np.int64)
+    n = len(offsets) - 1
+    rating = np.empty(n, np.float32)
+    rd = np.empty(n, np.float32)
+    threshold = np.empty(n, np.float32)
+    status = np.empty(n, np.int32)
+    id_off = np.empty(n + 1, np.int64)
+    region_off = np.empty(n + 1, np.int64)
+    mode_off = np.empty(n + 1, np.int64)
+    cap = len(buf) + 16
+    arena = ctypes.create_string_buffer(cap)
+    used = lib.mm_decode_requests_concat(
+        buf, len(buf), offsets, n, rating, rd, threshold, status,
+        arena, cap, id_off, region_off, mode_off)
     if used < 0:  # arena overflow cannot happen (strings ⊆ input), but guard
         return None
     raw = arena.raw

@@ -43,6 +43,7 @@ import asyncio
 import threading
 import time
 import uuid
+from collections import deque
 from typing import Any, Awaitable, Callable
 
 from matchmaking_tpu.service.broker import Delivery, Properties
@@ -74,9 +75,12 @@ class _Consumer:
     """Supervised consumer state (one dedicated connection + thread)."""
 
     __slots__ = ("queue", "callback", "prefetch", "conn", "channel",
-                 "generation", "stop", "thread", "connected")
+                 "generation", "stop", "thread", "connected",
+                 "batch_callback", "pending", "drain_scheduled", "tag",
+                 "unacked", "tasks")
 
-    def __init__(self, queue: str, callback, prefetch: int):
+    def __init__(self, queue: str, callback, prefetch: int,
+                 batch_callback=None):
         self.queue = queue
         self.callback = callback
         self.prefetch = prefetch
@@ -86,6 +90,25 @@ class _Consumer:
         self.stop = False
         self.thread: threading.Thread | None = None
         self.connected = threading.Event()
+        #: Columnar consume_batch seam (ISSUE 12): deliveries bridged from
+        #: the pika thread coalesce on the EVENT LOOP side — every message
+        #: that lands before the scheduled drain runs joins one burst, so
+        #: the app pays one batch callback (and one coroutine) per loop
+        #: wakeup instead of one ``run_coroutine_threadsafe`` coroutine
+        #: per delivery.
+        self.batch_callback = batch_callback
+        self.pending: "deque[Delivery]" = deque()
+        self.drain_scheduled = False
+        self.tag = ""  # set by basic_consume (the nack route on a crash)
+        #: Burst deliveries handed to the app and not yet acked/nacked
+        #: (loop-confined, generation-prefixed tags). The crash handler
+        #: nacks ONLY these — a basic_nack for an already-acked tag is a
+        #: 406 PRECONDITION_FAILED channel kill on real RabbitMQ.
+        self.unacked: set[int] = set()
+        #: Strong refs to in-flight burst-callback tasks: the event loop
+        #: holds tasks weakly, and a GC'd pending task would strand its
+        #: burst unacked (same discipline as InProcBroker's _handlers).
+        self.tasks: set = set()
 
 
 class AmqpBroker:
@@ -97,7 +120,8 @@ class AmqpBroker:
                  pika_module: Any = None,
                  reconnect_base_s: float = 0.2,
                  reconnect_max_s: float = 5.0,
-                 max_op_retries: int = 8):
+                 max_op_retries: int = 8,
+                 consume_batch_max: int = 256):
         if pika_module is None:
             try:
                 import pika as pika_module  # noqa: F401
@@ -118,6 +142,8 @@ class AmqpBroker:
         self._base = reconnect_base_s
         self._max_backoff = reconnect_max_s
         self._max_op_retries = max_op_retries
+        #: Max deliveries per coalesced consume burst (ISSUE 12).
+        self._consume_batch_max = max(1, consume_batch_max)
         self._lock = threading.Lock()
         self._conn = None
         self._channel = None
@@ -343,14 +369,20 @@ class AmqpBroker:
     def basic_consume(self, queue: str,
                       callback: Callable[[Delivery], Awaitable[None]],
                       prefetch: int | None = None,
-                      batch_hint: bool = False) -> str:
+                      batch_hint: bool = False,
+                      batch_callback=None) -> str:
         """Start a supervised consumer (dedicated connection + thread) for
         ``queue`` and bridge deliveries into the service event loop.
         ``batch_hint`` is accepted for interface parity with InProcBroker
         and ignored: pika already delivers from its own IO thread and the
-        loop bridge is the batching boundary here."""
+        loop bridge is the batching boundary here. ``batch_callback``
+        (ISSUE 12) arms loop-side burst coalescing: deliveries append to a
+        pending list via ``call_soon_threadsafe`` and ONE drain callback
+        hands the accumulated burst to the app — see _bridge_batched."""
         tag = f"ctag-{uuid.uuid4().hex[:8]}"
-        consumer = _Consumer(queue, callback, prefetch or self._prefetch)
+        consumer = _Consumer(queue, callback, prefetch or self._prefetch,
+                             batch_callback=batch_callback)
+        consumer.tag = tag
         self._consumers[tag] = consumer
         consumer.thread = threading.Thread(
             target=self._consumer_loop, args=(tag, consumer),
@@ -381,6 +413,11 @@ class AmqpBroker:
                 consumer.conn, consumer.channel = conn, channel
                 if generation > 1:
                     self.stats["consumer_reconnects"] += 1
+                    # Dead-generation burst tags can never be settled
+                    # (generation-prefixed); drop them on the LOOP — the
+                    # set is loop-confined and this runs on the consumer
+                    # thread.
+                    loop.call_soon_threadsafe(consumer.unacked.clear)
 
                 def on_message(ch, method, props, body,
                                _gen=generation, _q=consumer.queue):
@@ -449,8 +486,15 @@ class AmqpBroker:
                         redelivered=method.redelivered,
                         trace=trace,
                     )
-                    asyncio.run_coroutine_threadsafe(
-                        consumer.callback(delivery), loop)
+                    if consumer.batch_callback is not None:
+                        # Burst coalescing (ISSUE 12): cheap threadsafe
+                        # append + ONE scheduled drain per loop wakeup —
+                        # no per-delivery coroutine object at all.
+                        loop.call_soon_threadsafe(
+                            self._bridge_batched, consumer, delivery)
+                    else:
+                        asyncio.run_coroutine_threadsafe(
+                            consumer.callback(delivery), loop)
 
                 channel.basic_consume(queue=consumer.queue,
                                       on_message_callback=on_message,
@@ -472,6 +516,57 @@ class AmqpBroker:
         except Exception:
             pass
 
+    def _bridge_batched(self, consumer: _Consumer,
+                        delivery: Delivery) -> None:
+        """Event-loop side of the consume burst bridge: append, and
+        schedule ONE drain if none is pending — the drain re-schedules
+        itself while a backlog remains, so exactly one drain callback is
+        ever outstanding (scheduling per delivery would reintroduce the
+        per-delivery loop wakeups this seam removes). Runs via
+        ``call_soon_threadsafe`` so all state here is loop-confined."""
+        consumer.pending.append(delivery)
+        if not consumer.drain_scheduled:
+            consumer.drain_scheduled = True
+            self._loop.call_soon(self._drain_pending, consumer)
+
+    def _drain_pending(self, consumer: _Consumer) -> None:
+        """Hand up to one cap's worth of the accumulated burst to the app
+        as one batch callback; a remaining backlog re-schedules — O(cap)
+        per drain, not O(backlog) (a post-stall 10k backlog must not pay
+        quadratic remainder copies at exactly the overload moment)."""
+        consumer.drain_scheduled = False
+        if not consumer.pending:
+            return
+        if len(consumer.pending) <= self._consume_batch_max:
+            batch = list(consumer.pending)
+            consumer.pending.clear()
+        else:
+            pop = consumer.pending.popleft
+            batch = [pop() for _ in range(self._consume_batch_max)]
+            # Oversized backlog: drain the remainder on the next tick.
+            consumer.drain_scheduled = True
+            self._loop.call_soon(self._drain_pending, consumer)
+        for delivery in batch:
+            consumer.unacked.add(delivery.delivery_tag)
+        task = asyncio.ensure_future(self._run_batch(consumer, batch))
+        consumer.tasks.add(task)
+        task.add_done_callback(consumer.tasks.discard)
+
+    async def _run_batch(self, consumer: _Consumer,
+                         batch: "list[Delivery]") -> None:
+        """Run one burst callback; a crash nack-requeues the deliveries
+        the app had NOT settled yet (the ``unacked`` guard — the in-proc
+        burst handler's semantics; nacking an already-acked tag would be
+        a 406 channel kill on real RabbitMQ)."""
+        try:
+            await consumer.batch_callback(batch)
+        except Exception:
+            self.stats["consumer_errors"] += 1
+            for delivery in batch:
+                if delivery.delivery_tag in consumer.unacked:
+                    self.nack(consumer.tag, delivery.delivery_tag,
+                              requeue=True)
+
     def basic_cancel(self, consumer_tag: str) -> None:
         consumer = self._consumers.pop(consumer_tag, None)
         if consumer is None:
@@ -489,6 +584,9 @@ class AmqpBroker:
         consumer = self._consumers.get(consumer_tag)
         if consumer is None:
             return False
+        # Settled either way from the burst crash handler's point of view
+        # (a stale-generation tag is the broker's to redeliver).
+        consumer.unacked.discard(delivery_tag)
         generation = delivery_tag >> _TAG_BITS
         if generation != consumer.generation:
             # Delivery from a dead connection: the broker already requeued

@@ -48,6 +48,11 @@ from matchmaking_tpu.service.overload import (
     deadline_of,
 )
 from matchmaking_tpu.service.attribution import Attribution
+from matchmaking_tpu.service.ingress import (
+    IngressShards,
+    ShardedRecent,
+    gather_rows,
+)
 from matchmaking_tpu.service.quality import QualityLedger
 from matchmaking_tpu.engine.quality import QualitySpec
 from matchmaking_tpu.utils.chaos import ChaosState
@@ -56,6 +61,15 @@ from matchmaking_tpu.utils.timeseries import SloMonitor, TelemetryRing
 from matchmaking_tpu.utils.trace import EventLog, FlightRecorder, TraceContext
 
 log = logging.getLogger(__name__)
+
+#: Minimum consume-burst size worth decoding at the consume seam
+#: (ISSUE 12): below this, the per-burst fixed cost (column allocation +
+#: one native call) exceeds what it saves, and the flush's WINDOW-batched
+#: decode — which aggregates many small bursts into one call — is already
+#: the cheaper shape. Bursts this size and up decode at consume, so under
+#: load (where the broker drains full bursts) the flush skips decode
+#: entirely and assembles by gather.
+_MIN_DECODE_BURST = 16
 
 
 async def _shielded_to_thread(task: "asyncio.Task"):
@@ -161,8 +175,14 @@ class _QueueRuntime:
         # expiry). Bytes, not SearchResponse: the body is built exactly once
         # (possibly by the native batch encoder) and replays publish it
         # verbatim — a player always sees a self-consistent response.
-        self._recent: dict[str, tuple[bytes, float]] = {}
+        # Split into per-shard dicts by the consistent request-id hash
+        # (ISSUE 12): at ingress_shards=1 a single dict, byte for byte.
+        self._recent = ShardedRecent(app.cfg.broker.ingress_shards)
         self._next_prune = 0.0
+        #: In-process ingress shard workers (ISSUE 12): the consume-burst
+        #: decode + NEEDS_PYTHON fallback plane, consistent-hashed by
+        #: request id. N=1 runs inline — today's path.
+        self._shards = IngressShards(app.cfg.broker.ingress_shards)
         #: Previous "total"-stage histogram snapshot (counts, overflow,
         #: count) for the adaptive limiter's per-window DELTA p99 — the
         #: lifetime-cumulative histogram would tighten on stale history
@@ -181,10 +201,21 @@ class _QueueRuntime:
         prefetch = app.cfg.broker.prefetch
         if app.cfg.overload.max_inflight > 0:
             prefetch = max(prefetch, 2 * app.cfg.overload.max_inflight)
+        # Columnar consume_batch ingress (ISSUE 12): ONE app callback per
+        # drained broker burst instead of one handler invocation per
+        # delivery. Same eligibility as batch_hint (RPC auth keeps
+        # per-delivery tasks so its round trips overlap); the broker
+        # additionally falls back per-delivery while consume-side fault
+        # injection is armed, so chaos identity never changes with
+        # batching. consume_batch=False = the per-delivery path verbatim.
+        self._consume_batch = (app.cfg.broker.consume_batch
+                               and app.cfg.auth.mode != "rpc")
         self.consumer_tag = app.broker.basic_consume(
             queue_cfg.name, self._on_delivery,
             prefetch=prefetch,
             batch_hint=app.cfg.auth.mode != "rpc",
+            batch_callback=(self._on_delivery_batch if self._consume_batch
+                            else None),
         )
         self._sweeper: asyncio.Task | None = None
         if (queue_cfg.request_timeout_s is not None
@@ -753,6 +784,13 @@ class _QueueRuntime:
             delivery.arrival = self._arrival_seq
             self._arrival_seq += 1
             self.batcher.submit((None, delivery))
+            # Ingest accounting (ISSUE 12): the per-delivery consume cost,
+            # measured where it is spent — the batched twin records one
+            # span per burst; this records one per delivery, so the
+            # consume-share comparison across the two configs is honest.
+            self.app.attribution.observe_ingest(
+                self.queue_cfg.name, "consume",
+                time.time() - received_at, 1)
             return
         ctx = MessageContext(delivery=delivery, queue=self.queue_cfg.name,
                              received_at=received_at)
@@ -784,6 +822,93 @@ class _QueueRuntime:
         if tr is not None:
             tr.player_id = ctx.request.id
         self.batcher.submit((ctx.request, delivery))
+
+    # ---- batched ingress: one callback per consume burst (ISSUE 12) ------
+
+    # settles-some: deliveries
+    async def _on_delivery_batch(self, deliveries: list[Delivery]) -> None:
+        """The consume_batch ingress: ONE invocation per drained broker
+        burst. The fast path (columnar + inline ingress) runs the
+        admission pre-checks, the first-received stamp, arrival stamping,
+        the native burst decode (shard workers), and the batcher hand-off
+        in one pass — one clock read and one decode call per burst where
+        the per-delivery path paid them per delivery. Queues that need
+        per-delivery semantics (middleware chains, legacy per-delivery
+        admission, non-columnar engines) loop the per-delivery handler —
+        identical behavior, minus the per-delivery handler TASK."""
+        if not self._inline_ingress:
+            for delivery in deliveries:
+                await self._on_delivery(delivery)
+            return
+        received_at = time.time()
+        t_burst = time.perf_counter()  # monotonic twin: the ingest spans
+        ac = self.admission
+        # Window-granular admission's pre-checks (ISSUE 9), ONE pass over
+        # the burst (_inline_ingress guarantees batch_admission here):
+        # per-row pre_decide logic in burst order, amortized to one call.
+        decisions = (ac.pre_decide_batch(deliveries, received_at)
+                     if ac is not None else None)
+        live: list[Delivery] = []
+        for idx, delivery in enumerate(deliveries):
+            tr = self._trace(delivery)
+            if tr is not None:
+                tr.mark("consume", received_at)
+            if decisions is not None:
+                decision = decisions[idx]
+                if tr is not None:
+                    tr.tier = delivery.tier
+                if decision is EXPIRED:
+                    self._expire_delivery(delivery, received_at)
+                    continue
+                if decision is not ADMIT:  # draining
+                    self._shed_delivery(delivery)
+                    continue
+            headers = delivery.properties.headers
+            first = headers.setdefault("x-first-received", received_at)
+            try:
+                delivery.first_received = float(first)
+            except (TypeError, ValueError):
+                delivery.first_received = received_at
+            if tr is not None:
+                # Same mark vocabulary as the per-delivery inline path so
+                # the trace taxonomy is stable across configs; the burst
+                # handler's real cost is measured ONCE per burst into the
+                # `consume`/`decode` ingest categories instead of being
+                # smeared N× across member traces.
+                tr.mark("middleware", received_at)
+                tr.mark("batch", received_at)
+            delivery.arrival = self._arrival_seq
+            self._arrival_seq += 1
+            live.append(delivery)
+        if not live:
+            return
+        from matchmaking_tpu.native import codec
+
+        decode_s = 0.0
+        if len(live) >= _MIN_DECODE_BURST and codec.available():
+            # The decode side of PR 9's batch encoder: one native call
+            # over the burst's concatenated bodies + offsets; NEEDS_PYTHON
+            # rows fall back through the contract path on the shard
+            # workers; malformed rows settle here (reject + ack) exactly
+            # as the flush's decode would have.
+            t_dec = time.perf_counter()
+            live, rejects = await self._shards.decode_burst(live)
+            decode_s = time.perf_counter() - t_dec
+            for delivery, counter, code, reason in rejects:
+                self._reject_delivery(delivery, counter, code, reason)
+            self.app.attribution.observe_ingest(
+                self.queue_cfg.name, "decode", decode_s,
+                len(live) + len(rejects))
+        self.batcher.submit_many([(None, d) for d in live])
+        # Monotonic throughout (perf_counter — a wall-clock step must not
+        # produce a negative span the observe guard would silently drop);
+        # at ingress_shards>1 the decode await can suspend, so decode_s
+        # may include other tasks' loop time — noise, bounded by the
+        # burst cadence, and identical across the A/B configs.
+        self.app.attribution.observe_ingest(
+            self.queue_cfg.name, "consume",
+            max(0.0, (time.perf_counter() - t_burst) - decode_s),
+            len(deliveries))
 
     # ---- the window flush: THE seam into Engine.search --------------------
 
@@ -850,7 +975,7 @@ class _QueueRuntime:
                 tr.mark("flush", now)
             cached = self._recent.get(req.id)
             if cached is not None and cached[1] <= now:
-                del self._recent[req.id]  # expired: a genuine re-queue
+                self._recent.pop(req.id)  # expired: a genuine re-queue
                 cached = None
             if cached is not None:
                 # Terminal replay BEFORE the deadline check (same order as
@@ -974,6 +1099,20 @@ class _QueueRuntime:
         delivery.first_received = first
         return first
 
+    # settles: delivery
+    def _reject_delivery(self, delivery: Delivery, counter: str,
+                         code: str, reason: str) -> None:
+        """THE reject settle — counter + error response + ack + trace
+        settle. Every decode/party reject (per-delivery fallback, flush
+        row resolution, consume-burst rejects) funnels here so the paths
+        cannot drift: the equivalence soaks pin them to each other."""
+        self.app.metrics.counters.inc(counter)
+        self._respond_error(delivery, code, reason)
+        self._ack(delivery)
+        if delivery.trace is not None:
+            delivery.trace.mark("reject")
+            self._settle_trace(delivery, "rejected")
+
     # settles-some: delivery
     def _decode_or_reject(self, delivery: Delivery,
                           now: float) -> SearchRequest | None:
@@ -993,12 +1132,8 @@ class _QueueRuntime:
                 enqueued_at=self._first_received(delivery, now),
             )
         except ContractError as e:
-            self.app.metrics.counters.inc("rejected_by_middleware")
-            self._respond_error(delivery, e.code, e.reason)
-            self._ack(delivery)
-            if delivery.trace is not None:
-                delivery.trace.mark("reject")
-                self._settle_trace(delivery, "rejected")
+            self._reject_delivery(delivery, "rejected_by_middleware",
+                                  e.code, e.reason)
             return None
 
     def _decode_deferred(
@@ -1046,8 +1181,19 @@ class _QueueRuntime:
                               if d.delivery_tag not in dropped]
                 if not deliveries:
                     return
-        bodies = [bytes(d.body) for d in deliveries]
-        native = codec.decode_batch(bodies) if codec.available() else None
+        # Consume-time decoded windows (ISSUE 12): when EVERY lane carries
+        # a burst-decoded row reference (Delivery.row, set by the ingress
+        # shard workers), the flush decode is skipped entirely and the
+        # column assembly below gathers from the burst columns. A MIXED
+        # window (redeliveries consumed through the per-delivery fault
+        # path have no row) re-decodes wholesale — rare, and correct by
+        # construction (the body is unchanged).
+        pre = all(d.row is not None for d in deliveries)
+        native = None
+        t_dec = time.perf_counter()
+        if not pre:
+            bodies = [bytes(d.body) for d in deliveries]
+            native = codec.decode_batch(bodies) if codec.available() else None
 
         traced = any(d.trace is not None for d in deliveries)
         if traced:
@@ -1063,35 +1209,41 @@ class _QueueRuntime:
             ids_n, rating_n, rd_n, thr_n, regions_n, modes_n, status_n = native
             status_l = status_n.tolist()
         rows: list[tuple[int, str, SearchRequest | None]] = []
-        for i, delivery in enumerate(deliveries):
-            st = int(status_l[i]) if native is not None else codec.NEEDS_PYTHON
-            if st == codec.OK:
-                rows.append((i, ids_n[i], None))
-                continue
-            if st != codec.NEEDS_PYTHON:
-                self.app.metrics.counters.inc("rejected_by_middleware")
-                self._respond_error(delivery, codec.error_code(st),
-                                    "malformed payload")
-                self._ack(delivery)
-                if delivery.trace is not None:
-                    delivery.trace.mark("reject")
-                    self._settle_trace(delivery, "rejected")
-                continue
-            # Python fallback (codec unavailable or NEEDS_PYTHON row).
-            req = self._decode_or_reject(delivery, now)
-            if req is None:
-                continue
-            if req.party_size > 1:
-                # 1v1 queue: parties are unservable (oracle semantics).
-                self.app.metrics.counters.inc("rejected_by_engine")
-                self._respond_error(delivery, "party_not_supported",
-                                    "engine rejected request: party_not_supported")
-                self._ack(delivery)
-                if delivery.trace is not None:
-                    delivery.trace.mark("reject")
-                    self._settle_trace(delivery, "rejected")
-                continue
-            rows.append((i, req.id, req))
+        if pre:
+            # Burst-decoded: every row is valid (malformed rows settled at
+            # consume); the pid column reads straight out of the burst.
+            for i, delivery in enumerate(deliveries):
+                burst, j = delivery.row
+                rows.append((i, burst.ids[j], None))
+        else:
+            for i, delivery in enumerate(deliveries):
+                st = (int(status_l[i]) if native is not None
+                      else codec.NEEDS_PYTHON)
+                if st == codec.OK:
+                    rows.append((i, ids_n[i], None))
+                    continue
+                if st != codec.NEEDS_PYTHON:
+                    self._reject_delivery(delivery, "rejected_by_middleware",
+                                          codec.error_code(st),
+                                          "malformed payload")
+                    continue
+                # Python fallback (codec unavailable or NEEDS_PYTHON row).
+                req = self._decode_or_reject(delivery, now)
+                if req is None:
+                    continue
+                if req.party_size > 1:
+                    # 1v1 queue: parties are unservable (oracle semantics).
+                    self._reject_delivery(
+                        delivery, "rejected_by_engine",
+                        "party_not_supported",
+                        "engine rejected request: party_not_supported")
+                    continue
+                rows.append((i, req.id, req))
+            # Flush-time decode accounting (the consume_batch=off twin of
+            # the burst-decode observation — same category, same meaning).
+            self.app.attribution.observe_ingest(
+                self.queue_cfg.name, "decode",
+                time.perf_counter() - t_dec, len(deliveries))
         if traced:
             for src, pid, _req in rows:
                 tr = deliveries[src].trace
@@ -1110,7 +1262,7 @@ class _QueueRuntime:
             delivery = deliveries[src]
             cached = recent.get(pid)
             if cached is not None and cached[1] <= now:
-                del recent[pid]  # expired: a genuine re-queue
+                recent.pop(pid)  # expired: a genuine re-queue
                 cached = None
             if cached is not None:
                 self.app.metrics.counters.inc("deduped_replays")
@@ -1170,9 +1322,29 @@ class _QueueRuntime:
         corr_col = np.fromiter(
             (deliveries[s].properties.correlation_id for s, _, _ in keep),
             object, k)
-        all_native = native is not None and all(
-            req is None for _, _, req in keep)
-        if all_native:
+        if pre:
+            # Merge shard/burst columns at the EDF cut (ISSUE 12): one
+            # vectorized take per (burst, column) in final window order.
+            # Region/mode are interned HERE — codes belong to the current
+            # engine incarnation (a revive between consume and flush
+            # rebuilds the interners).
+            g_ids, g_rating, g_rd, g_thr, g_reg, g_mode = gather_rows(
+                [deliveries[s].row for s, _, _ in keep])
+            cols = RequestColumns(
+                ids=g_ids,
+                rating=g_rating,
+                rd=g_rd,
+                region=np.fromiter(
+                    (0 if r == "" else interner_r(r)
+                     for r in g_reg.tolist()), np.int32, k),
+                mode=np.fromiter(
+                    (0 if m == "" else interner_m(m)
+                     for m in g_mode.tolist()), np.int32, k),
+                threshold=g_thr,
+                enqueued_at=enq_col, reply_to=reply_col,
+                correlation_id=corr_col, tier=tier_col, deadline=dl_col,
+            )
+        elif native is not None and all(req is None for _, _, req in keep):
             sel = np.fromiter((s for s, _, _ in keep), np.int64, k)
             cols = RequestColumns(
                 ids=ids_n[sel],
@@ -1280,11 +1452,14 @@ class _QueueRuntime:
                 for d in deliveries_in:
                     self._nack(d)
                 return
+            # Depth-1/never-empty by the flush() return contract: the
+            # closure dispatched exactly one window under the lock, so
+            # this loop's body runs exactly once — matchlint's settlement
+            # rule now PROVES that shape (the flush-return value-flow
+            # refinement), retiring the two inline ignores that sat here.
             for tok, out in outs:
                 self._merge_window_marks(tok, deliveries_in)
-                # matchlint: ignore[settlement] depth-1 branch: flush() returns exactly this one window, so the loop body runs once
                 self._handle_columnar_out(out, by_id, deliveries_in, now)
-            # matchlint: ignore[settlement] outs is never empty here (the window just dispatched always lands in flush())
             return
 
         # Pipelined path: dispatch without waiting; outcomes (publish + ack)
@@ -2085,7 +2260,7 @@ class _QueueRuntime:
                                trace=trs.get(req.id))
 
     def _remember(self, player_id: str, body: bytes, now: float) -> None:
-        self._recent[player_id] = (body, now + self.queue_cfg.dedup_ttl_s)
+        self._recent.set(player_id, (body, now + self.queue_cfg.dedup_ttl_s))
 
     def dedup_cache_size(self) -> int:
         """Public dedup-cache occupancy for observability (/metrics reads
@@ -2099,7 +2274,7 @@ class _QueueRuntime:
         # hot-path overhead under sustained load; expiry only moves at TTL
         # granularity anyway.
         if len(self._recent) > 4096 and now >= self._next_prune:
-            self._recent = {k: v for k, v in self._recent.items() if v[1] > now}
+            self._recent.prune(now)
             self._next_prune = now + self.queue_cfg.dedup_ttl_s / 2.0
 
     def _respond(self, req: SearchRequest, resp: SearchResponse,
@@ -3108,7 +3283,8 @@ async def serve(stop: "asyncio.Event | None" = None,
         from matchmaking_tpu.service.amqp_transport import AmqpBroker
 
         broker = AmqpBroker(url, prefetch=cfg.broker.prefetch,
-                            pika_module=pika_module)
+                            pika_module=pika_module,
+                            consume_batch_max=cfg.broker.consume_batch_max)
         logging.getLogger(__name__).info("serving against AMQP broker %s", url)
     else:
         logging.getLogger(__name__).info(

@@ -220,7 +220,8 @@ class _RescanStats:
 
 class _QueueAttribution:
     __slots__ = ("categories", "work_s", "wait_s", "spans", "total_hist",
-                 "statuses", "slo_good", "slo_total", "tiers", "rescan")
+                 "statuses", "slo_good", "slo_total", "tiers", "rescan",
+                 "ingest")
 
     def __init__(self, buckets: tuple[float, ...]):
         self.categories: dict[str, _Category] = {}
@@ -233,6 +234,17 @@ class _QueueAttribution:
         self.slo_total = 0
         self.tiers: dict[int, _TierStats] = {}
         self.rescan: _RescanStats | None = None
+        #: Ingest-side WORK categories (ISSUE 12): ``consume`` (broker
+        #: consume machinery + admission pre-checks + batcher hand-off)
+        #: and ``decode`` (wire-body → columns, native or contract path),
+        #: measured DIRECTLY at the burst/window site — one observation
+        #: per burst, not one per trace — so the per-delivery cost is a
+        #: true wall-clock sum on both the batched and the per-delivery
+        #: ingress, comparable across the consume_batch on/off configs.
+        #: Kept OUT of work_s/wait_s: those telescope to settled-trace
+        #: spans exactly (the check.sh identity), and these spans overlap
+        #: trace gaps that are already classified.
+        self.ingest: dict[str, _Category] = {}
 
 
 class Attribution:
@@ -307,6 +319,24 @@ class Attribution:
                 if good:
                     ts.slo_good += 1
 
+    def observe_ingest(self, queue: str, category: str, seconds: float,
+                       rows: int) -> None:
+        """Record one ingest-side work span (ISSUE 12): ``category`` is
+        ``"consume"`` or ``"decode"``, ``seconds`` the measured wall time
+        of one burst/window's worth of that work, ``rows`` the deliveries
+        it covered. Monotone counters, one call per burst — the 2×-down
+        acceptance gate reads the resulting per-category share."""
+        if seconds < 0.0:
+            return
+        qa = self._queue(queue)
+        cat = qa.ingest.get(category)
+        if cat is None:
+            cat = qa.ingest[category] = _Category(WORK, self.buckets)
+        cat.gaps += 1
+        cat.traces += max(0, rows)
+        cat.total_s += seconds
+        cat.hist.observe(seconds)
+
     def observe_rescan(self, queue: str, marks) -> None:
         """Record one finalized rescan window's engine marks (dispatch →
         h2d/device_step… → collect) into the queue's rescan bucket. Not a
@@ -373,6 +403,22 @@ class Attribution:
                 }
                 for name, cat in sorted(qa.categories.items())
             }
+            # Ingest categories (ISSUE 12): measured at the burst/window
+            # site, reported alongside the trace-derived ones with the
+            # same share denominator (the queue's settled span) so
+            # "consume/decode share" is directly comparable round over
+            # round and across the consume_batch on/off configs.
+            for name, cat in sorted(qa.ingest.items()):
+                cats[name] = {
+                    "kind": cat.kind,
+                    "gaps": cat.gaps,
+                    "traces": cat.traces,
+                    "total_s": round(cat.total_s, 6),
+                    "share": (round(cat.total_s / span_s, 4)
+                              if span_s else 0.0),
+                    "p99_ms": (round(cat.hist.percentile(99) * 1e3, 3)
+                               if cat.hist.count else None),
+                }
             entry: dict[str, Any] = {
                 "spans": qa.spans,
                 "work_s": round(qa.work_s, 6),

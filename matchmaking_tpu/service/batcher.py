@@ -73,6 +73,24 @@ class Batcher(Generic[T]):
         if len(self._pending) >= self.cfg.max_batch:
             self._full.set()
 
+    def submit_many(self, items: "list[T]") -> None:
+        """Burst submit (ISSUE 12, the consume_batch ingress): one extend,
+        one clock read, and one trigger check for a whole consume burst —
+        the per-item bookkeeping of N ``submit`` calls, amortized. The
+        shared submit timestamp is the burst's arrival instant, which is
+        when every member actually became pending."""
+        if self._closed:
+            raise RuntimeError("batcher closed")
+        if not items:
+            return
+        self._pending.extend(items)
+        if self._observe is not None:
+            now = time.monotonic()
+            self._submitted.extend([now] * len(items))
+        self._first.set()
+        if len(self._pending) >= self.cfg.max_batch:
+            self._full.set()
+
     def _cut(self) -> list[T]:
         """Slice the next window off the pending list and report it."""
         if self._sort_key is not None and len(self._pending) > 1:

@@ -82,6 +82,13 @@ class Delivery:
     #: Re-stamped on every submit, so redeliveries order by re-consume
     #: time exactly as per-delivery admission did.
     arrival: int = -1
+    #: Consume-time decoded row (ISSUE 12, consume_batch ingress): a
+    #: ``(DecodedBurst, index)`` reference into the burst's preparsed
+    #: columns, set by the ingress shard workers so the window flush
+    #: assembles columns by vectorized gather instead of re-decoding.
+    #: None = not burst-decoded (per-delivery path, or a redelivery whose
+    #: burst is gone — the flush's contract-path fallback decodes it).
+    row: Any = None
 
 
 class _Queue:
@@ -114,7 +121,8 @@ class _BatchState:
 class _Consumer:
     def __init__(self, broker: "InProcBroker", queue: _Queue,
                  callback: Callable[[Delivery], Awaitable[None]], prefetch: int,
-                 batch_hint: bool = False):
+                 batch_hint: bool = False,
+                 batch_callback: "Callable[[list[Delivery]], Awaitable[None]] | None" = None):
         self.broker = broker
         self.queue = queue
         self.callback = callback
@@ -130,6 +138,15 @@ class _Consumer:
         #: CONCURRENTLY up to prefetch — the reference's Search.Worker
         #: GenServer-pool parallelism (SURVEY.md §2).
         self.batch_hint = batch_hint
+        #: Columnar consume_batch seam (ISSUE 12): when set, a drained
+        #: burst is handed to the app as ONE ``batch_callback(batch)``
+        #: call — no per-delivery handler invocation or bookkeeping at
+        #: all. Falls back to the per-delivery ``callback`` whenever the
+        #: broker has consume-side fault injection armed (delay/chaos
+        #: drops are per-delivery decisions whose replay identity must
+        #: not change with batching).
+        self.batch_callback = batch_callback
+        self._burst_max = max(1, broker.cfg.consume_batch_max)
         self._cancel_requeued: set[int] = set()
         self._batch_states: set[_BatchState] = set()
         self._free = self.prefetch
@@ -177,8 +194,8 @@ class _Consumer:
                 self._release()
                 return
             batch = [delivery]
-            if self.batch_hint:
-                while (len(batch) < 256
+            if self.batch_hint or self.batch_callback is not None:
+                while (len(batch) < self._burst_max
                        and not self.queue.messages.empty()
                        and self._try_acquire()):
                     batch.append(self.queue.messages.get_nowait())
@@ -186,7 +203,11 @@ class _Consumer:
             # deliveries even if the task is cancelled before it ever runs.
             state = _BatchState(batch)
             self._batch_states.add(state)
-            task = asyncio.create_task(self._handle_batch(state))
+            handler = (self._handle_burst
+                       if (self.batch_callback is not None
+                           and not self.broker.consume_faults_enabled)
+                       else self._handle_batch)
+            task = asyncio.create_task(handler(state))
             self._handlers.add(task)
             task.add_done_callback(self._handlers.discard)
 
@@ -220,6 +241,33 @@ class _Consumer:
                 state.i += 1
         finally:
             self._requeue_batch_rest(state)
+
+    async def _handle_burst(self, state: _BatchState) -> None:
+        """Columnar consume_batch handler (ISSUE 12): ONE app callback for
+        the whole drained burst. Every delivery registers in ``unacked``
+        BEFORE the callback — the at-least-once contract moves wholesale:
+        cancel()'s unacked sweep requeues them, acks/nacks settle them one
+        by one as the app's windows finish, and a crashing batch callback
+        nack-requeues whatever it had not settled yet (exactly the
+        per-delivery crash semantics, amortized)."""
+        batch = state.batch
+        for delivery in batch:
+            self.unacked[delivery.delivery_tag] = delivery
+        # The burst is owned by unacked now: the pre-start cancel sweep
+        # (_requeue_batch_rest) must not requeue it a second time.
+        state.i = len(batch)
+        state.current = None
+        self._batch_states.discard(state)
+        try:
+            await self.batch_callback(batch)
+        except Exception:
+            # A crashing burst callback must not lose deliveries: requeue
+            # every one it had not settled (OTP-style let-it-crash +
+            # redeliver — the per-delivery _handle contract, batched).
+            self.broker.stats["consumer_errors"] += 1
+            for delivery in batch:
+                if delivery.delivery_tag in self.unacked:
+                    self.nack(delivery.delivery_tag, requeue=True)
 
     async def _handle(self, delivery: Delivery) -> None:
         broker = self.broker
@@ -483,11 +531,13 @@ class InProcBroker:
     def basic_consume(self, queue: str,
                       callback: Callable[[Delivery], Awaitable[None]],
                       prefetch: int | None = None,
-                      batch_hint: bool = False) -> str:
+                      batch_hint: bool = False,
+                      batch_callback: "Callable[[list[Delivery]], Awaitable[None]] | None" = None) -> str:
         self.declare_queue(queue)
         consumer = _Consumer(self, self._queues[queue], callback,
                              prefetch or self.cfg.prefetch,
-                             batch_hint=batch_hint)
+                             batch_hint=batch_hint,
+                             batch_callback=batch_callback)
         self._queues[queue].consumers.append(consumer)
         self._consumers[consumer.tag] = consumer
         return consumer.tag

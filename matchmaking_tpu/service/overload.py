@@ -308,6 +308,14 @@ class AdmissionController:
             return SHED
         return ADMIT
 
+    def pre_decide_batch(self, deliveries, now: float) -> list[str]:
+        """One pre-check pass over a consume burst (ISSUE 12): the exact
+        ``pre_decide`` per-row logic, amortized to one call per burst —
+        the only per-delivery admission work the batched ingress pays
+        before the window-cut ladder. Rows evolve in burst (= arrival)
+        order, so decisions replay identically to the per-delivery path."""
+        return [self.pre_decide(d, now) for d in deliveries]
+
     def decide_batch(self, deliveries, now: float, pool_size: int,
                      pool_tiers: "Sequence[int] | None" = None) -> list[str]:
         """One admission pass over a cut window (ISSUE 9): the exact

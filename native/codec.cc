@@ -360,6 +360,58 @@ int64_t mm_decode_requests(const char** bufs, const int32_t* lens, int32_t n,
   return used;
 }
 
+// Concat variant (ISSUE 12, the consume_batch ingress layout): identical
+// row decode, but the input is ONE contiguous buffer of n bodies packed
+// back-to-back with offsets `boff` ([n+1]; body i spans boff[i]..boff[i+1])
+// — the mirror of the encoders' arena+offset OUTPUT layout, so a consume
+// burst's bodies flow broker → decoder without materializing a per-row
+// pointer table. Same outputs and arena contract as mm_decode_requests;
+// a row whose offsets are inverted or out of bounds is BAD_JSON (hostile
+// offsets must not read outside the buffer).
+int64_t mm_decode_requests_concat(const char* buf, int64_t buf_len,
+                                  const int64_t* boff, int32_t n,
+                                  float* rating, float* rd, float* threshold,
+                                  int32_t* status, char* arena, int64_t cap,
+                                  int64_t* id_off, int64_t* region_off,
+                                  int64_t* mode_off) {
+  int64_t used = 0;
+  for (int32_t i = 0; i < n; ++i) {
+    Row row;
+    int64_t b0 = boff[i], b1 = boff[i + 1];
+    if (b0 < 0 || b1 < b0 || b1 > buf_len || b1 - b0 > 0x7fffffff) {
+      row.status = BAD_JSON;
+    } else {
+      decode_one(buf + b0, (int)(b1 - b0), row);
+    }
+    status[i] = row.status;
+    rating[i] = (float)row.rating;
+    rd[i] = (float)row.rd;
+    threshold[i] = (float)row.threshold;
+    id_off[i] = used;
+    if (row.status == OK) {
+      if (used + row.id_len > cap) return -1;
+      memcpy(arena + used, row.id, row.id_len);
+      used += row.id_len;
+    }
+    region_off[i] = used;
+    if (row.status == OK && row.region_len > 0) {
+      if (used + row.region_len > cap) return -1;
+      memcpy(arena + used, row.region, row.region_len);
+      used += row.region_len;
+    }
+    mode_off[i] = used;
+    if (row.status == OK && row.mode_len > 0) {
+      if (used + row.mode_len > cap) return -1;
+      memcpy(arena + used, row.mode, row.mode_len);
+      used += row.mode_len;
+    }
+  }
+  id_off[n] = used;
+  region_off[n] = used;
+  mode_off[n] = used;
+  return used;
+}
+
 }  // extern "C"
 
 // ---- batch response encoder ------------------------------------------------

@@ -47,6 +47,10 @@ TOP_LEVEL_METRICS: dict[str, bool] = {
     "e2e_slo_attainment": True,
     "p99_ms": False,
     "e2e_p99_ms": False,
+    # Consume/decode ingest share of the settled span (ISSUE 12): the
+    # broker-consume + wire-decode work fraction the consume_batch seam
+    # shrinks — regressing it re-opens the per-delivery ingress wall.
+    "e2e_consume_share": False,
     # Elastic placement soak (ISSUE 11, bench.py --placement-soak):
     # migration blackout and delivery accounting regress downward only.
     # lost/dup have a zero baseline on a healthy run, so ANY nonzero
@@ -88,6 +92,24 @@ def load_result(path: str) -> dict:
     if not isinstance(doc, dict):
         raise SystemExit(f"{path}: expected a JSON object")
     return doc
+
+
+def abort_reason_of(doc: dict) -> str | None:
+    """The round's gate-skipping abort reason (ISSUE 12 satellite — what
+    burned BENCH_r05): an ``abort_reason``/``error`` on a round with NO
+    usable headline ``value``. A PARTIAL abort (reason recorded but the
+    headline measured — e.g. the cpu-fallback's e2e leg failed after the
+    comms rows landed) keeps the gate: its present metrics still compare,
+    and the missing ones are skipped per-metric anyway."""
+    if doc.get("value") is not None:
+        return None
+    reason = doc.get("abort_reason")
+    if isinstance(reason, str) and reason:
+        return reason
+    err = doc.get("error")
+    if isinstance(err, str) and err:
+        return err
+    return None
 
 
 def newest_committed_baseline(root: str) -> str | None:
@@ -187,6 +209,16 @@ def main(argv=None) -> int:
         return 0
     baseline = load_result(baseline_path)
     fresh = load_result(args.fresh)
+    # Aborted rounds are SKIPPED, not failed (ISSUE 12 satellite): a
+    # backend outage is an environment fact, not a regression — the round
+    # keeps its partial results and the gate simply declines to compare.
+    for side, doc, path in (("fresh", fresh, args.fresh),
+                            ("baseline", baseline, baseline_path)):
+        reason = abort_reason_of(doc)
+        if reason is not None:
+            print(f"bench_diff: {side} round {path} aborted "
+                  f"({reason}) — skipping the gate")
+            return 0
     rows = diff(baseline, fresh, threshold=args.threshold)
     regressions = [r for r in rows if r["regressed"]]
     if args.json:

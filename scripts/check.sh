@@ -39,6 +39,18 @@ raise SystemExit(0 if ok else 1)'
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'codec and not slow' \
     --continue-on-collection-errors -p no:cacheprovider
 
+echo "== ingress =="
+# ISSUE 12 gate: the columnar consume_batch ingress. libmmcodec.so was
+# rebuilt FROM SOURCE by the codec section above, so the concat decoder
+# under test is never a stale checked-in binary. The suite runs by
+# marker: broker burst-callback seam units, the consume-time decode, and
+# the equivalence soaks (consume_batch on vs off, ingress shards 1 vs 4 —
+# identical pairings, normalized responses, and settlement counters).
+# The consume-share regression gate rides the bench-diff section below
+# (e2e_consume_share, direction-aware) whenever MM_BENCH_JSON is set.
+JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'ingress and not slow' \
+    --continue-on-collection-errors -p no:cacheprovider
+
 echo "== attribution smoke =="
 # ISSUE 6 fast gate: a seeded 400-player soak must decompose every settled
 # trace into work + wait that sums to its e2e span (telescoping identity),

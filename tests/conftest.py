@@ -67,6 +67,11 @@ def pytest_configure(config):
         "arbitration — scripts/check.sh runs it by marker; the fast ones "
         "are tier-1, soaks additionally carry `slow`)")
     config.addinivalue_line(
+        "markers", "ingress: consume-batch / sharded-ingress suite "
+        "(burst-callback broker seam, consume-time decode, equivalence "
+        "soaks consume_batch on/off and shards 1/4 — scripts/check.sh "
+        "runs it by marker; part of tier-1)")
+    config.addinivalue_line(
         "markers", "codec: native-codec parity fuzz (byte/field equality "
         "vs the Python contract module over a seeded corpus — "
         "scripts/check.sh runs it by marker after rebuilding "

@@ -145,6 +145,115 @@ def test_fuzz_decode_requests_vs_contract():
     assert n_ok > N // 2  # the fast path must carry the bulk of the corpus
 
 
+def _concat(bodies: list[bytes]):
+    buf = b"".join(bodies)
+    off = np.zeros(len(bodies) + 1, np.int64)
+    np.cumsum(np.fromiter((len(b) for b in bodies), np.int64, len(bodies)),
+              out=off[1:])
+    return buf, off
+
+
+def test_fuzz_decode_concat_matches_pointer_decoder():
+    """The concat decoder (ISSUE 12 — the consume_batch body layout) must
+    agree with the per-pointer decoder row for row over the same corpus:
+    same statuses, same fields, same NEEDS_PYTHON/reject classes — it IS
+    the same row decode, fed from the encoders' arena+offset layout."""
+    rng = random.Random(20260804)
+    bodies: list[bytes] = []
+    for i in range(N):
+        roll = rng.random()
+        if roll < 0.10:
+            bodies.append(rng.choice([
+                b"", b"{", b"not json", b'{"id":"x","rating":+5}',
+                b'{"id":"x","rating":1e7}', b'[1]', b'{"rating":1}',
+                b'{"id":"x","rating":5.}',
+            ]))
+            continue
+        payload: dict = {"id": _rand_id(rng),
+                         "rating": _rand_float(rng) % 9e4}
+        if rng.random() < 0.4:
+            payload["region"] = rng.choice(["eu", "na", "*"])
+        if rng.random() < 0.3:
+            payload["rating_threshold"] = rng.uniform(0.5, 400.0)
+        if rng.random() < 0.1:
+            payload["party"] = [{"id": f"q{i}", "rating": 1500}]
+        bodies.append(json.dumps(payload).encode())
+    ref = codec.decode_batch(bodies)
+    buf, off = _concat(bodies)
+    got = codec.decode_batch_concat(buf, off)
+    assert ref is not None and got is not None
+    r_ids, r_rat, r_rd, r_thr, r_reg, r_mode, r_st = ref
+    g_ids, g_rat, g_rd, g_thr, g_reg, g_mode, g_st = got
+    assert (r_st == g_st).all()
+    for i in range(N):
+        if int(r_st[i]) != codec.OK:
+            continue
+        assert g_ids[i] == r_ids[i]
+        assert g_rat[i] == r_rat[i] and g_rd[i] == r_rd[i]
+        assert (math.isnan(g_thr[i]) if math.isnan(r_thr[i])
+                else g_thr[i] == r_thr[i])
+        assert g_reg[i] == r_reg[i] and g_mode[i] == r_mode[i]
+        # Field parity vs the semantic source of truth, directly.
+        py = decode_request(bodies[i])
+        assert g_ids[i] == py.id
+        assert g_rat[i] == pytest.approx(py.rating, rel=1e-6, abs=1e-6)
+
+
+def test_decode_concat_hostile_offsets_are_bad_json():
+    """Inverted, out-of-range, and truncating offsets must come back as
+    per-row bad_json — never a read outside the buffer or a crash."""
+    bodies = [b'{"id":"a","rating":1}', b'{"id":"b","rating":2}']
+    buf, off = _concat(bodies)
+    # Truncated final body (offset cut mid-JSON).
+    off_trunc = off.copy()
+    off_trunc[2] = off[2] - 5
+    out = codec.decode_batch_concat(buf, off_trunc)
+    assert out is not None
+    assert int(out[6][0]) == codec.OK and int(out[6][1]) != codec.OK
+    # Inverted span.
+    off_inv = off.copy()
+    off_inv[1] = off[2]
+    off_inv[2] = 0
+    out = codec.decode_batch_concat(buf, off_inv)
+    assert out is not None and int(out[6][1]) != codec.OK
+    # Out-of-range end.
+    off_oob = off.copy()
+    off_oob[2] = len(buf) + 64
+    out = codec.decode_batch_concat(buf, off_oob)
+    assert out is not None and int(out[6][1]) != codec.OK
+    # Negative start.
+    off_neg = off.copy()
+    off_neg[0] = -3
+    out = codec.decode_batch_concat(buf, off_neg)
+    assert out is not None and int(out[6][0]) != codec.OK
+    # Empty batch.
+    out = codec.decode_batch_concat(b"", np.zeros(1, np.int64))
+    assert out is not None and len(out[0]) == 0
+
+
+def test_decode_concat_needs_python_rows_fall_back():
+    """Every NEEDS_PYTHON row of the concat decoder must decode through
+    the Python contract (the fallback cannot dead-end), and adjacent rows
+    in the arena must not bleed into each other."""
+    bodies = [
+        json.dumps({"id": 'q"uote', "rating": 1500}).encode(),
+        b'{"id":"plain","rating":1400,"region":"eu"}',
+        json.dumps({"id": "p", "rating": 1300,
+                    "party": [{"id": "m", "rating": 1200}]}).encode(),
+    ]
+    buf, off = _concat(bodies)
+    out = codec.decode_batch_concat(buf, off)
+    assert out is not None
+    ids, rating, rd, thr, reg, mode, st = out
+    assert int(st[0]) == codec.NEEDS_PYTHON
+    assert int(st[1]) == codec.OK
+    assert int(st[2]) == codec.NEEDS_PYTHON
+    assert ids[1] == "plain" and reg[1] == "eu"
+    for i in (0, 2):
+        py = decode_request(bodies[i])  # fallback must succeed
+        assert py.rating > 0
+
+
 # ---------------------------------------------------------------------------
 # encode: matched pairs
 

@@ -685,6 +685,64 @@ class Runtime:
     assert [f for f in findings if f.rule == "settlement"] == []
 
 
+def test_settlement_flush_return_contract_refinement():
+    """ISSUE 12: a loop over the result of ``to_thread(closure)`` where
+    the closure dispatches a window and returns ``engine.flush()`` runs
+    its body EXACTLY ONCE (depth-1/never-empty flush() return contract) —
+    settling the window's deliveries inside it is neither a double-settle
+    (no second iteration) nor conditional (no zero-iteration path). The
+    exact shape the two retired ``ignore[settlement]`` comments covered
+    in _flush_columnar's non-pipelined branch."""
+    clean = analyze_source('''
+class Runtime:
+    # settles: delivery
+    def _ack(self, delivery):
+        self.app.broker.ack(self.consumer_tag, delivery.delivery_tag)
+
+    # settles: *deliveries
+    def _handle_out(self, out, deliveries, now):
+        for d in deliveries:
+            self._ack(d)
+
+    # settles: *deliveries
+    async def _flush_sync(self, cols, deliveries, now):
+        def run_engine():
+            self.engine.search_columns_async(cols, now)
+            return self.engine.flush()
+
+        outs = await asyncio.to_thread(run_engine)
+        for tok, out in outs:
+            self._handle_out(out, deliveries, now)
+        return
+''', path="matchmaking_tpu/service/fixture.py")
+    assert [f for f in clean if f.rule == "settlement"] == [], clean
+    # The refinement is value-flow-narrow: a flush() WITHOUT the dispatch
+    # in the same closure (a drain — 0..depth windows) keeps both paths,
+    # so the conditional settlement is still reported.
+    dirty = analyze_source('''
+class Runtime:
+    # settles: delivery
+    def _ack(self, delivery):
+        self.app.broker.ack(self.consumer_tag, delivery.delivery_tag)
+
+    # settles: *deliveries
+    def _handle_out(self, out, deliveries, now):
+        for d in deliveries:
+            self._ack(d)
+
+    # settles: *deliveries
+    async def _drain(self, deliveries, now):
+        def collect():
+            return self.engine.flush()
+
+        outs = await asyncio.to_thread(collect)
+        for tok, out in outs:
+            self._handle_out(out, deliveries, now)
+        return
+''', path="matchmaking_tpu/service/fixture.py")
+    assert [f for f in dirty if f.rule == "settlement"], dirty
+
+
 def test_settlement_admit_loop_without_settle_leaks_per_iteration():
     findings = analyze_source('''
 class Runtime:
