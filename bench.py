@@ -1375,6 +1375,225 @@ def bench_placement_soak(args) -> dict:
     return asyncio.run(run())
 
 
+async def _scenario_cell(args, scn) -> dict:
+    """One matrix cell: a fresh single-queue app driven by one scenario's
+    seeded population load, with the autotuner closing the loop (unless
+    ``--scenario-no-autotune``). The cell artifact is the full
+    observability story — telemetry-ring trajectory, attribution shares,
+    per-tier SLO attainment, quality, shed/expired, autotuner audit —
+    not just a throughput number."""
+    from matchmaking_tpu.config import (
+        AutotuneConfig,
+        BatcherConfig,
+        BrokerConfig,
+        ChaosConfig,
+        Config,
+        EngineConfig,
+        ObservabilityConfig,
+        OverloadConfig,
+        QueueConfig,
+    )
+    from matchmaking_tpu.service.app import MatchmakingApp
+    from matchmaking_tpu.service.loadgen import offered_load
+
+    q = "matchmaking.search"
+    tiers = scn.max_tier + 1 if scn.tiered else 1
+    has_deadlines = any(c.deadline_ms > 0 for c in scn.cohorts)
+    chaos = scn.chaos_config(q, seed=args.scenario_seed)
+    slo_ms = float(args.scenario_slo_ms)
+    cfg = Config(
+        queues=(QueueConfig(rating_threshold=100.0,
+                            send_queued_ack=False),),
+        engine=EngineConfig(
+            backend="tpu", pool_capacity=8192, pool_block=2048,
+            batch_buckets=(16, 64, 256), top_k=8, pipeline_depth=2,
+            warm_start=True),
+        # The cell's STATIC base config is deliberately mid-range (window
+        # wait included) — the point of the matrix is watching the tuner
+        # move it per workload, and diffing the converged knobs.
+        batcher=BatcherConfig(max_batch=256,
+                              max_wait_ms=float(args.scenario_wait_ms)),
+        broker=BrokerConfig(prefetch=8192),
+        overload=OverloadConfig(
+            max_waiting=int(args.scenario_max_waiting),
+            tiers=tiers,
+            deadline_sweep_ms=(25.0 if has_deadlines else 0.0)),
+        chaos=chaos if chaos is not None else ChaosConfig(),
+        observability=ObservabilityConfig(
+            slo_target_ms=slo_ms, slo_objective=0.99,
+            slo_fast_window_s=1.0, slo_slow_window_s=4.0,
+            snapshot_interval_s=0.25),
+        autotune=(AutotuneConfig() if args.scenario_no_autotune
+                  else AutotuneConfig(interval_s=0.25,
+                                      max_wait_ms_min=1.0)),
+    )
+    app = MatchmakingApp(cfg)
+    try:
+        # start() inside the try: a backend-outage abort (the advertised
+        # cell-abort case) still runs the stop/no-op cleanup below.
+        await app.start()
+        res = await offered_load(
+            app, q, rate=0.0, duration=0.0, seed=args.scenario_seed,
+            scenario=scn, rate_scale=float(args.scenario_rate_scale),
+            time_scale=float(args.scenario_time_scale))
+        app.sample_telemetry()  # final trajectory point before teardown
+        attr_q = app.attribution.snapshot()["queues"].get(q, {})
+        cats = attr_q.get("categories") or {}
+        hist = app.metrics.stages.get(q, {}).get("total")
+        cell: dict = {
+            "scenario": scn.name,
+            "seed": args.scenario_seed,
+            "rate_scale": float(args.scenario_rate_scale),
+            "time_scale": float(args.scenario_time_scale),
+            "duration_s": res.get("duration_s"),
+            "scenario_digest": res.get("scenario_digest"),
+            "offered": res["sent"],
+            "sent_req_s": res["sent_req_s"],
+            "matched": res["players_matched"],
+            "matched_per_s": res["matched_per_s"],
+            "queued_acks": res["queued_acks"],
+            "shed": res["shed_requests"],
+            "expired": res["expired_requests"],
+            "retries_sent": res.get("retries_sent", 0),
+            "cohorts": res.get("cohorts"),
+            "slo_target_ms": slo_ms,
+            "slo_attainment": attr_q.get("slo_attainment"),
+            "admitted_p99_ms": (round(hist.percentile(99) * 1e3, 3)
+                                if hist is not None and hist.count
+                                else None),
+            "attribution": {
+                name: {"kind": cat.get("kind"), "share": cat.get("share")}
+                for name, cat in cats.items()
+            },
+            "abort_reason": None,
+        }
+        if tiers > 1:
+            per_tier = {}
+            for t in range(tiers):
+                good, total = app.attribution.slo_counts_tier(q, t)
+                per_tier[str(t)] = {
+                    "slo_good": good, "slo_total": total,
+                    "attainment": (round(good / total, 4) if total
+                                   else None),
+                    "shed": int(app.metrics.counters.get(
+                        f"shed_requests_t{t}")),
+                    "expired": int(app.metrics.counters.get(
+                        f"expired_requests_t{t}")),
+                }
+            cell["tiers"] = per_tier
+        qentry = (app.quality.snapshot(q).get("queues") or {}).get(q)
+        if qentry:
+            tier_rows = qentry.get("tiers") or {}
+            n_matched = qentry.get("matched_players") or 0
+            # Matched-player-weighted aggregate over the tier rows (the
+            # service ledger conditions on tier; the cell headline wants
+            # the population view).
+            q_sum = sum(r.get("quality_sum") or 0.0
+                        for r in tier_rows.values())
+            p10s = [r.get("quality_p10") for r in tier_rows.values()
+                    if r.get("quality_p10") is not None]
+            w99s = [r.get("wait_p99_s") for r in tier_rows.values()
+                    if r.get("wait_p99_s") is not None]
+            cell["quality"] = {
+                "matched": n_matched,
+                "quality_mean": (round(q_sum / n_matched, 6)
+                                 if n_matched else None),
+                "quality_p10": (round(min(p10s), 6) if p10s else None),
+                "wait_p99_s": (round(max(w99s), 6) if w99s else None),
+            }
+        rt = app.runtime(q)
+        if hasattr(rt.engine, "util_report"):
+            u = rt.engine.util_report()
+            cell["idle_fraction"] = u["idle_fraction"]
+            cell["effective_occupancy"] = u["effective_occupancy"]
+        cell["telemetry"] = app.telemetry.snapshot(
+            limit=int(args.scenario_trajectory),
+            prefixes=("idle_frac", "slo_good", "slo_total", "pool_size",
+                      "stage_total_p99_ms", "batch_fill", "shed_total",
+                      "expired_total"))
+        if app.autotune is not None:
+            cell["autotune"] = {
+                "moves": app.autotune.moves,
+                "failures": app.autotune.failures,
+                "ticks": app.autotune.ticks,
+                "knobs": app.autotune.knobs(),
+                "trace": [list(row)
+                          for row in app.autotune.decision_trace()],
+            }
+            if args.scenario_tuned_dir:
+                os.makedirs(args.scenario_tuned_dir, exist_ok=True)
+                path = os.path.join(args.scenario_tuned_dir,
+                                    f"{scn.name}.json")
+                with open(path, "w") as f:
+                    json.dump(app.autotune.tuned_config(
+                        scenario=scn.name, seed=args.scenario_seed),
+                        f, indent=1, sort_keys=True)
+                    f.write("\n")
+                cell["tuned_config"] = path
+        return cell
+    finally:
+        await app.stop()
+
+
+def bench_scenario_matrix(args) -> dict:
+    """The scenario observatory (ISSUE 13): run every requested scenario
+    as one matrix cell — fresh app, seeded population load, autotuner
+    closing the loop — and emit one artifact per cell. A cell abort
+    (backend outage, cell crash) records the structured ``abort_reason``
+    the PR 12 machinery introduced and the MATRIX continues; bench_diff
+    skips aborted cells and gates the rest (slo_attainment /
+    admitted_p99_ms / quality, direction-aware, matched by scenario
+    name)."""
+    import asyncio
+
+    from matchmaking_tpu.scenario import load_scenario, scenario_names
+
+    spec = args.scenario_matrix
+    names = (scenario_names() if spec == "all"
+             else [n.strip() for n in spec.split(",") if n.strip()])
+    cells: list[dict] = []
+    for name in names:
+        log(f"[scenario] cell {name}")
+        try:
+            scn = load_scenario(name)
+            cell = asyncio.run(_scenario_cell(args, scn))
+            log(f"[scenario {name}] attainment="
+                f"{cell.get('slo_attainment')} shed={cell.get('shed')} "
+                f"admitted_p99={cell.get('admitted_p99_ms')} ms "
+                f"autotune_moves="
+                f"{(cell.get('autotune') or {}).get('moves')}")
+        except Exception as e:
+            # Structured per-CELL abort (ISSUE 13 satellite on the PR 12
+            # machinery): a backend outage aborts this cell, not the
+            # matrix — partials keep their reasons and bench_diff skips
+            # them.
+            log(f"[scenario {name}] ABORTED: {e!r}")
+            reason = ("backend_unavailable"
+                      if "backend" in repr(e).lower()
+                      or "device" in repr(e).lower() else "cell_failed")
+            cell = {"scenario": name, "abort_reason": reason,
+                    "abort_detail": repr(e),
+                    "abort_config": {
+                        "seed": args.scenario_seed,
+                        "rate_scale": args.scenario_rate_scale,
+                        "time_scale": args.scenario_time_scale,
+                        "slo_ms": args.scenario_slo_ms,
+                    }}
+        cells.append(cell)
+    ok = [c for c in cells if c.get("abort_reason") is None]
+    attainments = [c["slo_attainment"] for c in ok
+                   if c.get("slo_attainment") is not None]
+    return {
+        "metric": (f"scenario-matrix worst-cell SLO attainment "
+                   f"({len(ok)}/{len(cells)} cells)"),
+        "value": (round(min(attainments), 4) if attainments else None),
+        "unit": "attainment",
+        "vs_baseline": None,
+        "scenario_seed": args.scenario_seed,
+        "scenario_matrix": cells,
+    }
+
+
 def main() -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--pool", type=int, default=100_000,
@@ -1534,7 +1753,50 @@ def main() -> None:
     p.add_argument("--placement-window", type=int, default=256,
                    help="soak batcher window / top batch bucket")
     p.add_argument("--placement-seed", type=int, default=17)
+    p.add_argument("--scenario-matrix", default="",
+                   help="scenario observatory (ISSUE 13): run the named "
+                        "population-model scenarios (comma list, or 'all' "
+                        "for every configs/scenarios/*.json) as a soak "
+                        "matrix — one fresh app per cell, seeded arrival "
+                        "transcript, autotuner closing the loop — and "
+                        "emit per-cell telemetry-trajectory + attribution "
+                        "+ SLO/quality/shed artifacts (scenario_matrix "
+                        "rows, gated by scripts/bench_diff.py). "
+                        "Standalone mode: skips every other phase")
+    p.add_argument("--scenario-seed", type=int, default=21,
+                   help="arrival/chaos seed for every matrix cell")
+    p.add_argument("--scenario-rate-scale", type=float, default=1.0,
+                   help="multiply every scenario segment's offered rate")
+    p.add_argument("--scenario-time-scale", type=float, default=1.0,
+                   help="compress/stretch every scenario's curve "
+                        "(0.5 = replay in half the time)")
+    p.add_argument("--scenario-slo-ms", type=float, default=100.0,
+                   help="per-cell SLO target (ms) — also the autotuner's "
+                        "steering target")
+    p.add_argument("--scenario-wait-ms", type=float, default=25.0,
+                   help="each cell's STATIC batcher window wait; the "
+                        "autotuner tightens it per workload (the knob "
+                        "trajectory is the artifact's point)")
+    p.add_argument("--scenario-max-waiting", type=int, default=2048,
+                   help="per-cell admission waiting-pool cap "
+                        "(OverloadConfig.max_waiting)")
+    p.add_argument("--scenario-trajectory", type=int, default=120,
+                   help="telemetry-ring snapshots embedded per cell")
+    p.add_argument("--scenario-no-autotune", action="store_true",
+                   help="run the matrix with static knobs (the baseline "
+                        "the closed-loop win is measured against)")
+    p.add_argument("--scenario-tuned-dir", default="",
+                   help="write each cell's converged knob artifact to "
+                        "<dir>/<scenario>.json (the configs/tuned/ "
+                        "capacity artifacts)")
     args = p.parse_args()
+    if args.scenario_matrix:
+        # Standalone like --placement-soak: the matrix is its own
+        # artifact. Cells run on whatever backend jax initializes (the
+        # check.sh smoke pins JAX_PLATFORMS=cpu); a backend outage aborts
+        # cells, not the process.
+        print(json.dumps(bench_scenario_matrix(args)), flush=True)
+        return
     if args.placement_soak:
         # Before any jax import: the soak needs >= 2 devices for the
         # migrate legs (4 for the shard cycle).  The host-platform flag

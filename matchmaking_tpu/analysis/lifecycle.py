@@ -227,6 +227,39 @@ def _alias_sources(value: ast.AST) -> set[str]:
     return set()
 
 
+def _filter_predicates(value: ast.AST) -> set[str]:
+    """Normalized (``ast.dump``) filter predicates applied by
+    comprehensions inside an assignment RHS — the inputs to the
+    collection-length value-flow refinement (ISSUE 13 satellite). Two
+    shapes produce a predicate:
+
+    - a generator ``if`` condition (``[d for ... in ... if pid not in
+      drop]``), and
+    - the boolean MASK-VECTOR element (no ``if``s, a bare Compare/BoolOp
+      element — the ``np.fromiter((pid not in drop for pid in ...),
+      bool, n)`` idiom whose result feeds ``.take(mask)``).
+
+    Predicates that reference no name beyond the comprehension's own
+    targets are dropped: an unanchored filter (``if x`` over the loop
+    variable alone) identifies nothing across assignments."""
+    out: set[str] = set()
+    for sub in ast.walk(value):
+        if not isinstance(sub, (ast.ListComp, ast.GeneratorExp,
+                                ast.SetComp)):
+            continue
+        bound: set[str] = set()
+        conds: list[ast.AST] = []
+        for gen in sub.generators:
+            bound |= set(_binding_names(gen.target))
+            conds.extend(gen.ifs)
+        if not conds and isinstance(sub.elt, (ast.Compare, ast.BoolOp)):
+            conds = [sub.elt]
+        for cond in conds:
+            if _names_in(cond) - bound:
+                out.add(ast.dump(cond))
+    return out
+
+
 def _binding_names(target: ast.AST) -> list[str]:
     """Plain Name targets bound by an assignment/loop target."""
     out = []
@@ -465,6 +498,69 @@ class _FnScan:
             if len(trues) == 1 and trues[0] is true_stmt and falses \
                     and not others:
                 self.guard_flags[flag] = root
+        # Collection-length value-flow refinement (ISSUE 13 satellite):
+        # locals assigned as FILTERED VIEWS driven by the same predicate
+        # have pairwise-equal lengths — `mask = (pid not in drop for pid
+        # in X)` → `cols = cols.take(mask)` in one column plane, and
+        # `deliveries_in = [deliveries[s] ... if pid not in drop]` in the
+        # object plane, keep row-parallel residues by construction. So an
+        # emptiness test on ONE of them (`if not len(cols): return`)
+        # vacuously settles the PARTNERS' groups too: every row the
+        # filter removed was settled by whoever produced `drop`
+        # (settles-some), and zero residue on the tested side means zero
+        # residue on the partner side. This retired the last
+        # `ignore[settlement]` in _flush_columnar. Deliberately narrow:
+        # predicates compare by exact ast.dump, must be anchored in a
+        # free name, and `.take(mask)` inherits only a Name mask's
+        # predicates.
+        # ``pred_of``: name → its live filter-predicate dumps; ``takes``:
+        # the subset of names whose predicates arrived through a
+        # ``.take(mask)`` (a mask-filtered COLUMN view, not a list).
+        # Linking is restricted to take-view ↔ comprehension pairs: two
+        # plain comprehensions over different base collections can share
+        # a predicate text without sharing a length, but a mask built
+        # over the column view's own rows and a comprehension filtered by
+        # the same anchored test are the paired-plane idiom this exists
+        # for. A later REBIND of either name to an unfiltered value
+        # clears its predicates — the contract follows the binding, not
+        # the name.
+        pred_of: dict[str, set[str]] = {}
+        takes: set[str] = set()
+        assigns = sorted(
+            (n for n in ast.walk(fn)
+             if isinstance(n, ast.Assign) and len(n.targets) == 1
+             and isinstance(n.targets[0], ast.Name)),
+            key=lambda n: (n.lineno, n.col_offset))
+        for node in assigns:
+            tgt = node.targets[0].id
+            preds = _filter_predicates(node.value)
+            v = node.value
+            is_take = (isinstance(v, ast.Call)
+                       and isinstance(v.func, ast.Attribute)
+                       and v.func.attr == "take" and v.args
+                       and isinstance(v.args[0], ast.Name))
+            if is_take:
+                # X = X.take(mask): the filtered view inherits the mask's
+                # predicate identity.
+                preds = preds | pred_of.get(v.args[0].id, set())
+            if preds:
+                pred_of[tgt] = pred_of.get(tgt, set()) | preds
+                if is_take:
+                    takes.add(tgt)
+            else:
+                # Rebound to something unfiltered: drop the stale
+                # identity (and take-ness) or a fresh unsettled binding
+                # would inherit the old emptiness correlation.
+                pred_of.pop(tgt, None)
+                takes.discard(tgt)
+        self.len_partners: dict[str, set[str]] = {}
+        for a, pa in pred_of.items():
+            for b, pb in pred_of.items():
+                if a == b or not (pa & pb):
+                    continue
+                if (a in takes) == (b in takes):
+                    continue  # same plane: lengths not provably parallel
+                self.len_partners.setdefault(a, set()).add(b)
         #: For linenos whose body settles/hands-off the loop target on
         #: EVERY path — computed per loop over a sub-CFG of the body alone
         #: so stale bindings from earlier loops cannot join in.  Filled by
@@ -832,7 +928,14 @@ class _SettlementAnalysis(df.Analysis):
                 names = _names_in(test.args[0])
             empty_kind = df.TRUE if neg else df.FALSE
             if kind == empty_kind:
+                # Length-parallel partners (ISSUE 13 satellite): an
+                # emptiness test on a filtered view also empties every
+                # same-predicate filtered partner — see the scan's
+                # len_partners construction for the value-flow argument.
+                expanded = set(names)
                 for n in names:
+                    expanded |= self.scan.len_partners.get(n, set())
+                for n in expanded:
                     key = self.scan.group_key(n)
                     if key in out and out[key] in (PEND, MIX):
                         out[key] = SETTLED  # vacuously: it is empty

@@ -513,6 +513,66 @@ class PlacementConfig:
 
 
 @dataclass(frozen=True)
+class AutotuneConfig:
+    """Telemetry-driven online autotuner (matchmaking_tpu/control/
+    autotune.py, ISSUE 13): a supervised tick loop — the same audited
+    decision shape as the placement controller — that reads the telemetry
+    ring (stage p99, batch fill, idle fraction, shed deltas) and the SLO
+    burn monitors, and moves ONE serving knob per tick within the declared
+    safe ranges below:
+
+    - ``max_wait_ms`` (the batcher window wait) — tightened multiplicatively
+      while the queue's p99 exceeds the target; NEVER widened back by the
+      tuner (a one-way ratchet: widening trades latency for batch fill,
+      a tradeoff the frontier bench owns, not an online controller).
+    - ``edf`` — earliest-deadline-first window cutting switched ON for a
+      burning queue whose deliveries carry deadlines (also a ratchet).
+    - ``pipeline_depth`` — in-flight window cap stepped down when latency
+      stays high after the window floor, stepped back up once calm.
+    - ``credit_fraction`` — the admission credit scale stepped down so an
+      overloaded queue sheds earlier with honest responses; stepped back
+      toward 1.0 once calm. Skipped when ``OverloadConfig.adaptive`` is on
+      (that controller owns the fraction — two writers would fight).
+
+    Safety model: every move is clamped to the range knobs below, applied
+    one per tick so each effect is observable before the next decision,
+    and recorded — trigger signals, from→to, observed effect one tick
+    later — in a bounded audit ring served at ``/debug/autotune``. The
+    plan step is a pure function of the signal view (no RNG, no clock
+    reads), so a deterministic signal trajectory replays a bit-identical
+    decision trace (tests/test_autotune.py pins it)."""
+
+    #: Tick interval (seconds; 0 disables — no task, no knob writes).
+    interval_s: float = 0.0
+    #: The latency target the tuner steers to: tighten while the queue's
+    #: rolling stage-total p99 exceeds this, relax when it falls below
+    #: half of it. 0 → inherit ``ObservabilityConfig.slo_target_ms``.
+    target_p99_ms: float = 0.0
+    #: Safe range for the batcher window wait.
+    max_wait_ms_min: float = 0.5
+    max_wait_ms_max: float = 50.0
+    #: Safe range for the pipeline depth (upper bound additionally clamped
+    #: to the engine's configured ``pipeline_depth``).
+    pipeline_depth_min: int = 1
+    #: Floor for the admission credit fraction (the controller's own
+    #: ``min_credit_fraction`` still applies; the tighter bound wins).
+    credit_fraction_min: float = 0.25
+    #: Multiplicative steps (tighten < 1 < relax).
+    wait_step: float = 0.5
+    fraction_step: float = 0.8
+    #: Ticks a queue must stay calm (p99 < target/2, not burning) before a
+    #: relax move, and the minimum ticks between ANY two moves on one
+    #: queue — each move's effect must land in the telemetry ring before
+    #: the next decision reads it.
+    settle_ticks: int = 2
+    #: Decisions kept in the audit ring (/debug/autotune).
+    decision_ring: int = 256
+
+    def enabled(self) -> bool:
+        return self.interval_s > 0
+
+
+@dataclass(frozen=True)
 class ObservabilityConfig:
     """Request-lifecycle flight recorder + debug surfaces (utils/trace.py,
     service/observability.py). The BASELINE north star asserts a p99;
@@ -642,6 +702,9 @@ class Config:
     #: Elastic queue→device placement control plane (off by default — see
     #: PlacementConfig.enabled()).
     placement: PlacementConfig = field(default_factory=PlacementConfig)
+    #: Telemetry-driven online autotuner (off by default — see
+    #: AutotuneConfig.enabled()).
+    autotune: AutotuneConfig = field(default_factory=AutotuneConfig)
     #: Number of concurrent search workers draining batches (the reference's
     #: GenServer pool size analog — SURVEY.md §2 C7).
     workers: int = 2
@@ -675,6 +738,7 @@ class Config:
             ("overload", OverloadConfig),
             ("observability", ObservabilityConfig),
             ("placement", PlacementConfig),
+            ("autotune", AutotuneConfig),
         ):
             if name in d:
                 sub = dict(d[name])

@@ -28,9 +28,19 @@ Layout::
     executor.py    the engine rebuild primitive (snapshot → build on the
                    target devices → restore → verify)
     controller.py  the live control loop + /debug/placement snapshot
+    autotune.py    the online knob autotuner (ISSUE 13): telemetry-driven
+                   window/EDF/pipeline/admission moves within declared
+                   safe ranges, audited at /debug/autotune
 """
 
 from matchmaking_tpu.control.arbiter import DispatchArbiter
+from matchmaking_tpu.control.autotune import (
+    AutoTuner,
+    KnobDecision,
+    KnobMove,
+    QueueTune,
+    TuneView,
+)
 from matchmaking_tpu.control.controller import PlacementController
 from matchmaking_tpu.control.policy import (
     Action,
@@ -48,7 +58,12 @@ from matchmaking_tpu.control.state import (
 
 __all__ = [
     "Action",
+    "AutoTuner",
     "DispatchArbiter",
+    "KnobDecision",
+    "KnobMove",
+    "QueueTune",
+    "TuneView",
     "GreedyPolicy",
     "PlacementController",
     "PlacementDecision",

@@ -122,6 +122,13 @@ class _QueueRuntime:
             # window. The key is a pure function of the delivery's cached
             # tier + stamped header — no clock reads (determinism rule).
             sort_key=self._edf_key if app.cfg.overload.edf else None)
+        #: Live in-flight window cap (the backpressure gate in
+        #: _dispatch_pipelined), initialized from the frozen engine
+        #: config. The online autotuner (control/autotune.py, ISSUE 13)
+        #: steps it within [1, cfg.pipeline_depth] — the pipelined/sync
+        #: path CHOICE stays the boot-time config's (depth 1 here only
+        #: gates in-flight windows, it does not de-pipeline the flush).
+        self.pipeline_depth = app.cfg.engine.pipeline_depth
         # Serializes ALL engine access (window flushes vs the timeout
         # sweeper): engines are single-writer objects with no internal locks.
         # Attributes below marked ``guarded-by: _engine_lock`` are checked
@@ -395,7 +402,7 @@ class _QueueRuntime:
         m = self.app.metrics
         q = self.queue_cfg.name
         m.observe_stage(q, "batch_window", age_s)
-        fill = size / max(1, self.app.cfg.batcher.max_batch)
+        fill = size / max(1, self.batcher.max_batch)
         m.set_gauge(f"batch_fill[{q}]", fill)
         if self.admission is not None and self.app.cfg.overload.adaptive:
             # Adaptive shedding feeds on the signals the service already
@@ -406,7 +413,7 @@ class _QueueRuntime:
             # p99 is over the DELTA since the previous window (the
             # histogram is lifetime-cumulative; tightening on all-time
             # history would hold the limiter down long after recovery).
-            depth = self.app.cfg.engine.pipeline_depth
+            depth = self.pipeline_depth
             pipeline_frac = (self.engine.inflight() / depth
                              if depth > 0 and hasattr(self.engine, "inflight")
                              else 0.0)
@@ -610,6 +617,19 @@ class _QueueRuntime:
         _, delivery = item
         deadline = _QueueRuntime._delivery_deadline(delivery)
         return (delivery.tier, deadline if deadline else float("inf"))
+
+    @property
+    def edf_on(self) -> bool:
+        return self.batcher.sort_key is not None
+
+    def set_edf(self, on: bool) -> None:
+        """Toggle EDF window cutting at runtime (the autotuner's knob,
+        control/autotune.py). The key reads only the tier/deadline caches
+        admission stamps, so flipping it mid-traffic is safe — the next
+        cut simply sorts (or stops sorting) the backlog. Callers gate on
+        ``admission is not None`` (without admission every key is
+        (0, inf) and the sort is a paid no-op)."""
+        self.batcher.sort_key = self._edf_key if on else None
 
     # ---- window-granular admission (ISSUE 9) ------------------------------
 
@@ -1427,7 +1447,6 @@ class _QueueRuntime:
                                 deliveries[s] for s, pid, _ in keep
                                 if pid not in drop]
                             if not len(cols):
-                                # matchlint: ignore[settlement] empty residue: every kept row was a debt victim _pay_debt_locked settled (shed+ack)
                                 return
                     # Arbiter slot (ISSUE 11) — inside the engine lock,
                     # around the dispatch+flush only (see
@@ -1736,7 +1755,7 @@ class _QueueRuntime:
         # host oracle (no inflight()) while this loop is parked on the
         # sleep — the swap already nacked our window's meta, so there is
         # nothing left to wait for.
-        depth = self.app.cfg.engine.pipeline_depth
+        depth = self.pipeline_depth
         while (hasattr(self.engine, "inflight")
                and self.engine.inflight() >= depth):
             await asyncio.sleep(0.001)
@@ -2856,6 +2875,9 @@ class MatchmakingApp:
         #: Built at start(): the controller needs the runtimes to bind
         #: boot placements and the telemetry ring to steer.
         self.placement = None
+        #: Online autotuner (control/autotune.py, ISSUE 13; None =
+        #: disabled). Built at start() like the placement controller.
+        self.autotune = None
 
     async def start(self) -> None:
         assert not self._started
@@ -2873,6 +2895,11 @@ class MatchmakingApp:
         if self.placement is not None:
             self.placement.bind_boot_placements()
             self.placement.start()
+        if self.cfg.autotune.enabled():
+            from matchmaking_tpu.control.autotune import AutoTuner
+
+            self.autotune = AutoTuner(self, self.cfg.autotune)
+            self.autotune.start()
         obs = self.cfg.observability
         if obs.slo_target_ms > 0:
             def _monitor(key: str) -> SloMonitor:
@@ -2955,6 +2982,8 @@ class MatchmakingApp:
             return  # drain() already shut everything down
         if self.placement is not None:
             await self.placement.stop()
+        if self.autotune is not None:
+            await self.autotune.stop()
         self._stop_telemetry()
         if self._observability is not None:
             await self._observability.stop()
@@ -2983,6 +3012,10 @@ class MatchmakingApp:
             # would rebuild an engine the checkpoint walk below is about
             # to read.
             await self.placement.stop()
+        if self.autotune is not None:
+            # Knob writes stop before the per-queue close: a window-wait
+            # retune racing a draining batcher is harmless but noisy.
+            await self.autotune.stop()
         self._stop_telemetry()
         self.events.append("drain_begin", "",
                            f"checkpoint={'on' if directory else 'off'}")

@@ -43,8 +43,11 @@ class Batcher(Generic[T]):
         #: request behind backlog. The sort is stable (FIFO within equal
         #: keys) and the key must be a pure function of the item (no clock
         #: reads — matchlint's determinism rule owns that), so cut
-        #: composition replays bit-identically.
-        self._sort_key = sort_key
+        #: composition replays bit-identically. PUBLIC like the
+        #: max_batch/max_wait_ms live knobs below: the online autotuner's
+        #: EDF toggle (_QueueRuntime.set_edf) swaps it at tick time, and
+        #: the next _cut picks the change up.
+        self.sort_key = sort_key
         #: Observability hook, called once per cut window with
         #: ``(window_size, open_age_seconds)`` — batch fill and batcher
         #: wait are BASELINE headline metrics (utils/metrics docstring) and
@@ -52,6 +55,14 @@ class Batcher(Generic[T]):
         #: reports them itself instead of making callers reverse-engineer
         #: the window boundaries from item timestamps.
         self._observe = observe_window
+        #: Live window knobs, initialized from the (frozen) config. The
+        #: online autotuner (control/autotune.py, ISSUE 13) adjusts
+        #: ``max_wait_ms`` within its declared safe range at tick time;
+        #: ``_run`` re-reads it every window so a change takes effect on
+        #: the NEXT cut, never mid-window. Event-loop-confined like the
+        #: rest of the batcher state.
+        self.max_batch = cfg.max_batch
+        self.max_wait_ms = cfg.max_wait_ms
         self._pending: list[T] = []
         #: Per-item submit times, parallel to _pending — the cut reports
         #: the OLDEST remaining item's true wait, so carried-over backlog
@@ -70,7 +81,7 @@ class Batcher(Generic[T]):
         if self._observe is not None:
             self._submitted.append(time.monotonic())
         self._first.set()
-        if len(self._pending) >= self.cfg.max_batch:
+        if len(self._pending) >= self.max_batch:
             self._full.set()
 
     def submit_many(self, items: "list[T]") -> None:
@@ -88,24 +99,24 @@ class Batcher(Generic[T]):
             now = time.monotonic()
             self._submitted.extend([now] * len(items))
         self._first.set()
-        if len(self._pending) >= self.cfg.max_batch:
+        if len(self._pending) >= self.max_batch:
             self._full.set()
 
     def _cut(self) -> list[T]:
         """Slice the next window off the pending list and report it."""
-        if self._sort_key is not None and len(self._pending) > 1:
+        if self.sort_key is not None and len(self._pending) > 1:
             # EDF: stable-sort the WHOLE backlog, then slice — the window
             # is the min-key prefix, and the carried-over remainder stays
             # ordered for the next cut. O(n log n) on the backlog; the
             # backlog is bounded by admission (and by prefetch without it).
-            key = self._sort_key
+            key = self.sort_key
             order = sorted(range(len(self._pending)),
                            key=lambda i: key(self._pending[i]))
             self._pending = [self._pending[i] for i in order]
             if self._observe is not None:
                 self._submitted = [self._submitted[i] for i in order]
-        window = self._pending[: self.cfg.max_batch]
-        self._pending = self._pending[self.cfg.max_batch:]
+        window = self._pending[: self.max_batch]
+        self._pending = self._pending[self.max_batch:]
         if self._observe is not None and window:
             # Oldest item still PENDING at the cut (window + remainder):
             # under FIFO that is index 0, the pre-EDF behavior exactly;
@@ -119,8 +130,9 @@ class Batcher(Generic[T]):
         return window
 
     async def _run(self) -> None:
-        max_wait = self.cfg.max_wait_ms / 1000.0
         while not self._closed:
+            # Re-read per window: the autotuner may retune the wait knob.
+            max_wait = self.max_wait_ms / 1000.0
             if not self._pending:
                 # Idle: wake immediately on the window's first item.
                 self._first.clear()
@@ -133,7 +145,7 @@ class Batcher(Generic[T]):
             # Window open: close after max_wait unless the size trigger
             # fires first.
             self._full.clear()
-            if len(self._pending) < self.cfg.max_batch:
+            if len(self._pending) < self.max_batch:
                 try:
                     await asyncio.wait_for(self._full.wait(), timeout=max_wait)
                 except asyncio.TimeoutError:

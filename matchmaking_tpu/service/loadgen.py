@@ -25,6 +25,17 @@ class PER TIER (the loadgen assigned each correlation id its tier, so the
 split needs no tier echo from the service). The tier draw is a pure
 function of the seed, so a tiered soak replays bit-identically.
 
+Scenario mode (``--scenario``, ISSUE 13): drive a population-model load
+spec (matchmaking_tpu/scenario.py) instead of the flat Poisson knobs —
+piecewise rate curves, rating-mixture cohorts with per-cohort tier/
+deadline/retry behavior, scripted incidents. The arrival transcript is a
+pure function of ``(seed, scenario, scales)``; per-cohort response
+accounting joins the per-tier split, and cohorts flagged ``retry_on_shed``
+re-publish once after a shed (the retry DECISION is drawn up front —
+seeded — while the retry send time follows the reply, which is behavior,
+not transcript). ``scenario="steady"`` reduces to the legacy model byte
+for byte (tests/test_scenario.py pins it).
+
 Env contract (set by the bench on top of the multiproc worker env; each has
 a CLI flag that wins when both are given):
     MM_LOADGEN_RATE         offered req/s (Poisson)      (--offered-rate)
@@ -33,6 +44,9 @@ a CLI flag that wins when both are given):
     MM_LOADGEN_DEADLINE_MS  per-request deadline, 0=off  (--deadline-ms)
     MM_LOADGEN_TIER_MIX     tier mix, "" = untiered      (--tier-mix)
     MM_LOADGEN_QUALITY      "1" = quality accounting     (--quality)
+    MM_LOADGEN_SCENARIO     scenario name/path, "" = off (--scenario)
+    MM_LOADGEN_RATE_SCALE   scenario rate multiplier     (--rate-scale)
+    MM_LOADGEN_TIME_SCALE   scenario time compression    (--time-scale)
     MM_LOADGEN_OUT          path for the JSON result     (--out)
 """
 
@@ -78,7 +92,9 @@ async def offered_load(app, queue: str, *, rate: float, duration: float,
                        reply_q: str = "loadgen.replies",
                        drain_polls: int = 200,
                        quality_stats: bool = False,
-                       rating_sigma: float | None = None) -> dict:
+                       rating_sigma: float | None = None,
+                       scenario=None, rate_scale: float = 1.0,
+                       time_scale: float = 1.0) -> dict:
     """Offer a seeded Poisson load to ``app``'s broker and account for
     every response class. Reusable by the CLI below, bench.py's workers,
     and the overload soak (tests/test_overload.py) — one load driver, not
@@ -100,19 +116,52 @@ async def offered_load(app, queue: str, *, rate: float, duration: float,
     ``wait_gap_ms_mean`` = mean(latency − waited), the collect+publish
     queueing the engine did NOT charge the match for. Costs one json.loads
     per matched reply (like tiered runs).
+
+    ``scenario`` (ISSUE 13) replaces the flat (rate, duration,
+    rating_sigma, tier_mix) model with a population spec
+    (matchmaking_tpu/scenario.py): the arrival transcript — times,
+    ratings, cohorts, tiers, deadlines, retry flags — is built up front as
+    a pure function of ``(seed, scenario, rate_scale, time_scale)``, and
+    per-cohort accounting joins the result. Mutually exclusive with
+    ``tier_mix``/``rating_sigma`` (the scenario's cohorts own both).
     """
     from matchmaking_tpu.service.broker import Properties
     from matchmaking_tpu.service.overload import stamp_deadline, stamp_tier
+
+    arrivals = None
+    if scenario is not None:
+        if tier_mix or rating_sigma is not None:
+            raise ValueError("scenario mode owns the tier/rating model — "
+                             "drop tier_mix/rating_sigma")
+        arrivals = scenario.build_arrivals(
+            seed, rate_scale=rate_scale, time_scale=time_scale)
+        duration = arrivals.duration_s
 
     app.broker.declare_queue(reply_q)
     tally = {name: 0 for name, _ in _STATUS_PROBES}
     tally["replies"] = 0
     tier_of_corr: dict[str, int] = {}
     per_tier: dict[int, dict] = {}
-    if tier_mix:
+    tier_keys: "tuple[int, ...]" = tuple(tier_mix or ())
+    if arrivals is not None and arrivals.stamp_tiers:
+        tier_keys = tuple(sorted(set(arrivals.tier.tolist())))
+    if tier_keys:
         per_tier = {t: {**{name: 0 for name, _ in _STATUS_PROBES},
-                        "offered": 0, "latencies_ms": []}
-                    for t in tier_mix}
+                        "offered": 0, "retries": 0, "latencies_ms": []}
+                    for t in tier_keys}
+    #: Scenario mode: correlation id → cohort index + per-cohort rows, and
+    #: the once-per-arrival retry machinery (retry decisions were drawn in
+    #: the transcript; only the send time follows the reply).
+    cohort_of_corr: dict[str, int] = {}
+    idx_of_corr: dict[str, int] = {}
+    per_cohort: dict[int, dict] = {}
+    retried: set[str] = set()
+    retry_tasks: list = []
+    retries_sent = 0
+    if arrivals is not None:
+        per_cohort = {j: {**{name: 0 for name, _ in _STATUS_PROBES},
+                          "offered": 0, "retries": 0}
+                      for j in range(len(scenario.cohorts))}
 
     #: quality_stats rows: (quality, waited_ms, latency_ms) per matched
     #: reply.
@@ -136,9 +185,26 @@ async def offered_load(app, queue: str, *, rate: float, duration: float,
                     float(d.get("latency_ms", 0.0))))
             except (ValueError, TypeError):
                 pass
-        if not per_tier or not status:
+        if not status:
             return
-        t = tier_of_corr.get(delivery.properties.correlation_id)
+        corr = delivery.properties.correlation_id
+        if per_cohort:
+            j = cohort_of_corr.get(corr)
+            if j is not None:
+                per_cohort[j][status] += 1
+            if status == "shed":
+                i = idx_of_corr.get(corr)
+                if (i is not None and arrivals.retry[i]
+                        and corr not in retried):
+                    # One client retry per shed arrival, seeded decision
+                    # (arr.retry), delayed by the cohort's backoff — the
+                    # retry-storm ingredient.
+                    retried.add(corr)
+                    retry_tasks.append(
+                        asyncio.ensure_future(retry_arrival(i, corr)))
+        if not per_tier:
+            return
+        t = tier_of_corr.get(corr)
         if t is None:
             return
         row = per_tier[t]
@@ -163,56 +229,118 @@ async def offered_load(app, queue: str, *, rate: float, duration: float,
     expired0 = counters.get("expired_requests")
     tier_base = {t: (counters.get(f"shed_requests_t{t}"),
                      counters.get(f"expired_requests_t{t}"))
-                 for t in (tier_mix or ())}
+                 for t in tier_keys}
 
-    rng = np.random.default_rng(seed)
-    n_max = int(rate * duration * 2) + 16
-    # Default (rating_sigma=None): consecutive near-equal ratings, so the
-    # measured cost is ingress/admission (see the docstring). A quality/
-    # frontier run wants the OPPOSITE — iid diverse ratings, so the rating
-    # threshold actually bites and wait/quality trade off.
-    if rating_sigma is None:
-        ratings = np.repeat(rng.normal(1500.0, 300.0, size=n_max // 2 + 1), 2)
+    if arrivals is not None:
+        # Scenario mode: the whole transcript was drawn up front.
+        sched = arrivals.t
+        ratings = arrivals.rating
+        n_max = len(arrivals)
+        tiers = arrivals.tier if arrivals.stamp_tiers else None
+        deadlines = arrivals.deadline_s
     else:
-        ratings = rng.normal(1500.0, rating_sigma, size=n_max)
-    gaps = rng.exponential(1.0 / rate, size=n_max)
-    sched = np.cumsum(gaps)
-    tiers = None
-    if tier_mix:
-        # Seeded per-arrival tier draw (pure function of the seed, drawn
-        # up front like ratings/gaps — replay-identical by construction).
-        tiers = rng.choice(np.fromiter(tier_mix, np.int64, len(tier_mix)),
-                           size=n_max,
-                           p=np.fromiter(tier_mix.values(), np.float64,
-                                         len(tier_mix)))
+        rng = np.random.default_rng(seed)
+        n_max = int(rate * duration * 2) + 16
+        # Default (rating_sigma=None): consecutive near-equal ratings, so
+        # the measured cost is ingress/admission (see the docstring). A
+        # quality/frontier run wants the OPPOSITE — iid diverse ratings,
+        # so the rating threshold actually bites and wait/quality trade
+        # off.
+        if rating_sigma is None:
+            ratings = np.repeat(
+                rng.normal(1500.0, 300.0, size=n_max // 2 + 1), 2)
+        else:
+            ratings = rng.normal(1500.0, rating_sigma, size=n_max)
+        gaps = rng.exponential(1.0 / rate, size=n_max)
+        sched = np.cumsum(gaps)
+        tiers = None
+        deadlines = None
+        if tier_mix:
+            # Seeded per-arrival tier draw (pure function of the seed,
+            # drawn up front like ratings/gaps — replay-identical by
+            # construction).
+            tiers = rng.choice(
+                np.fromiter(tier_mix, np.int64, len(tier_mix)),
+                size=n_max,
+                p=np.fromiter(tier_mix.values(), np.float64,
+                              len(tier_mix)))
+
+    def publish_arrival(i: int, corr: str) -> None:
+        """One request publish (arrival or scenario retry): headers
+        stamped from the per-arrival deadline/tier columns; a retry keeps
+        its PLAYER id (the same player re-requesting) under a fresh
+        correlation id."""
+        headers: dict = {}
+        budget = deadline_s
+        if deadlines is not None and deadlines[i] > 0:
+            budget = float(deadlines[i])
+        if budget > 0:
+            stamp_deadline(headers, time.time(), budget)
+        if tiers is not None:
+            t = int(tiers[i])
+            stamp_tier(headers, t)
+            tier_of_corr[corr] = t
+        app.broker.publish(
+            queue,
+            f'{{"id":"g{seed}_{i}","rating":{ratings[i]:.2f}}}'.encode(),
+            Properties(reply_to=reply_q, correlation_id=corr,
+                       headers=headers))
+
+    async def retry_arrival(i: int, corr: str) -> None:
+        nonlocal retries_sent
+        await asyncio.sleep(float(arrivals.retry_delay_s[i]))
+        rid = corr + "r"
+        j = int(arrivals.cohort[i])
+        cohort_of_corr[rid] = j
+        per_cohort[j]["retries"] += 1
+        if per_tier:
+            # The retry's reply will land in this tier's status row (its
+            # corr id is tier-mapped by publish_arrival) — count the
+            # retry SEND too, so per-tier statuses never exceed
+            # offered + retries.
+            per_tier[int(arrivals.tier[i])]["retries"] += 1
+        retries_sent += 1
+        publish_arrival(i, rid)
+
     t0 = time.perf_counter()
     i = 0
     while i < n_max and sched[i] <= duration:
         now_rel = time.perf_counter() - t0
         while i < n_max and sched[i] <= min(now_rel, duration):
             pid = f"g{seed}_{i}"
-            headers: dict = {}
-            if deadline_s > 0:
-                stamp_deadline(headers, time.time(), deadline_s)
             if tiers is not None:
-                t = int(tiers[i])
-                stamp_tier(headers, t)
-                tier_of_corr[pid] = t
-                per_tier[t]["offered"] += 1
-            app.broker.publish(
-                queue,
-                f'{{"id":"{pid}","rating":{ratings[i]:.2f}}}'.encode(),
-                Properties(reply_to=reply_q, correlation_id=pid,
-                           headers=headers))
+                per_tier[int(tiers[i])]["offered"] += 1
+            if arrivals is not None:
+                j = int(arrivals.cohort[i])
+                cohort_of_corr[pid] = j
+                idx_of_corr[pid] = i
+                per_cohort[j]["offered"] += 1
+            publish_arrival(i, pid)
             i += 1
         if i < n_max and sched[i] > now_rel:
             await asyncio.sleep(min(sched[i] - now_rel, 0.005))
     span = time.perf_counter() - t0
     for _ in range(drain_polls):
         await asyncio.sleep(0.025)
+        if retry_tasks:
+            # Late sheds during the drain can still schedule retries —
+            # let them publish before judging the broker quiet.
+            pending = [tk for tk in retry_tasks if not tk.done()]
+            if pending:
+                await asyncio.gather(*pending, return_exceptions=True)
+                continue
         if (app.broker.queue_depth(queue) == 0
                 and app.broker.handlers_idle()):
             break
+    # A shed reply consumed on the drain loop's last poll can still have
+    # scheduled a retry whose sleep outlives the loop — cancel and reap
+    # so no task publishes after the reply consumer is gone (and no
+    # "Task was destroyed but it is pending" lands at loop close).
+    for tk in retry_tasks:
+        if not tk.done():
+            tk.cancel()
+    if retry_tasks:
+        await asyncio.gather(*retry_tasks, return_exceptions=True)
     app.broker.basic_cancel(tag)
     result = {
         "queue": queue,
@@ -256,6 +384,7 @@ async def offered_load(app, queue: str, *, rate: float, duration: float,
         result["tiers"] = {
             str(t): {
                 "offered": row["offered"],
+                "retries": row["retries"],
                 "matched": row["matched"],
                 "queued_acks": row["queued"],
                 "shed": row["shed"],
@@ -272,6 +401,17 @@ async def offered_load(app, queue: str, *, rate: float, duration: float,
             }
             for t, row in sorted(per_tier.items())
         }
+    if arrivals is not None:
+        result["scenario"] = scenario.name
+        # Replay pin: pure function of (seed, scenario, scales) — two runs
+        # of the same cell must agree (the bench matrix smoke asserts it).
+        result["scenario_digest"] = arrivals.digest()
+        result["duration_s"] = round(duration, 3)
+        result["retries_sent"] = retries_sent
+        result["cohorts"] = {
+            scenario.cohorts[j].name: dict(row)
+            for j, row in sorted(per_cohort.items())
+        }
     return result
 
 
@@ -282,12 +422,22 @@ async def _run(args) -> dict:
     cfg = Config.from_env()
     app = MatchmakingApp(cfg)
     await app.start()
+    scenario = None
+    if args.scenario:
+        from matchmaking_tpu.scenario import load_scenario
+
+        scenario = load_scenario(args.scenario)
     result = await offered_load(
         app, cfg.queues[0].name,
         rate=args.offered_rate, duration=args.seconds, seed=args.seed,
         deadline_s=args.deadline_ms / 1e3 if args.deadline_ms > 0 else 0.0,
+        # Passed through even in scenario mode: offered_load raises the
+        # speakable conflict error instead of this CLI silently dropping
+        # an operator's explicit tier mix.
         tier_mix=parse_tier_mix(args.tier_mix),
-        quality_stats=bool(args.quality))
+        quality_stats=bool(args.quality),
+        scenario=scenario, rate_scale=args.rate_scale,
+        time_scale=args.time_scale)
     result["pid"] = os.getpid()
     await app.stop()
     return result
@@ -324,6 +474,18 @@ def _parse_args(argv=None):
                    help="parse matched replies for match quality + the "
                         "engine-observed waited_ms and report the "
                         "client/engine wait cross-check (ISSUE 8)")
+    p.add_argument("--scenario",
+                   default=env.get("MM_LOADGEN_SCENARIO", ""),
+                   help="population-model scenario name (configs/"
+                        "scenarios/) or spec path (ISSUE 13) — replaces "
+                        "the flat rate/tier-mix model ('' = off)")
+    p.add_argument("--rate-scale", type=float,
+                   default=float(env.get("MM_LOADGEN_RATE_SCALE", "1")),
+                   help="scenario mode: multiply every segment's rate")
+    p.add_argument("--time-scale", type=float,
+                   default=float(env.get("MM_LOADGEN_TIME_SCALE", "1")),
+                   help="scenario mode: compress/stretch the curve "
+                        "(0.5 replays the scenario in half its time)")
     p.add_argument("--out", default=env.get("MM_LOADGEN_OUT", ""),
                    help="path for the one-line JSON result")
     return p.parse_args(argv)

@@ -493,6 +493,23 @@ class ObservabilityServer:
             history = 0
         return web.json_response(ctrl.snapshot(history=history))
 
+    async def _debug_autotune(self, request) -> "web.Response":
+        """Online autotuner (ISSUE 13): the steering target, declared safe
+        ranges, current live knob values per queue, and the knob-decision
+        audit ring — each record with the driving signal snapshot and the
+        observed effect one tick later. ``?n=`` caps the decision history
+        (default: the full ring)."""
+        tuner = getattr(self.app, "autotune", None)
+        if tuner is None:
+            return web.json_response(
+                {"error": "autotuner disabled (set autotune.interval_s)"},
+                status=404)
+        try:
+            history = max(0, int(request.query.get("n", "0")))
+        except ValueError:
+            history = 0
+        return web.json_response(tuner.snapshot(history=history))
+
     async def _debug_telemetry(self, request) -> "web.Response":
         """The continuous telemetry ring (utils/timeseries.py): ``?n=``
         tail length, ``?key=`` comma-separated key-prefix filter
@@ -575,6 +592,7 @@ class ObservabilityServer:
         http_app.router.add_get("/debug/attribution", self._debug_attribution)
         http_app.router.add_get("/debug/quality", self._debug_quality)
         http_app.router.add_get("/debug/placement", self._debug_placement)
+        http_app.router.add_get("/debug/autotune", self._debug_autotune)
         http_app.router.add_get("/debug/telemetry", self._debug_telemetry)
         http_app.router.add_get("/debug/events", self._debug_events)
         http_app.router.add_get("/debug/profile", self._debug_profile)

@@ -460,6 +460,22 @@ class AdmissionController:
                     f"(p99 {0.0 if p99_s is None else p99_s * 1e3:.1f} ms, "
                     f"pipeline {pipeline_frac:.2f})")
 
+    @property
+    def credit_fraction(self) -> float:
+        return self._fraction
+
+    def set_fraction(self, fraction: float) -> float:
+        """Set the credit fraction directly — the online autotuner's
+        admission knob (control/autotune.py, ISSUE 13). Clamped to
+        [min_credit_fraction, 1.0]; returns the applied value. The tuner
+        refuses this knob while ``cfg.adaptive`` is on (observe_window
+        owns the fraction then — two writers would fight), so there is
+        exactly one writer in any configuration."""
+        self._fraction = min(1.0, max(self.cfg.min_credit_fraction,
+                                      float(fraction)))
+        self._publish_gauges()
+        return self._fraction
+
     # ---- checkpoint / restore (ISSUE 11 satellite) ------------------------
 
     def checkpoint(self) -> dict[str, Any]:

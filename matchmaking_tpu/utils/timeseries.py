@@ -35,7 +35,24 @@ itself is the app's ``asyncio.sleep`` loop.
 from __future__ import annotations
 
 from collections import deque
-from typing import Any, Mapping
+from typing import Any, Mapping, NamedTuple
+
+
+class Delta(NamedTuple):
+    """A counter delta over a ring window. Indexes [0]/[1] keep the old
+    ``(value, span_s)`` tuple contract; ``reset`` (ISSUE 13 satellite)
+    flags that the counter RESTARTED inside the window — an engine revive
+    or breaker swap installs a fresh engine whose monotone counters begin
+    again at 0, and a naive newest-minus-oldest difference would go
+    negative (a negative "rate" fed a burn monitor or the autotuner is a
+    corrupt signal, not a datum). When set, ``value`` is the
+    reset-corrected increase: positive increments summed across the
+    window, with each post-reset sample counted from 0 (the Prometheus
+    ``increase()`` convention)."""
+
+    value: float
+    span_s: float
+    reset: bool = False
 
 
 class TelemetryRing:
@@ -83,17 +100,46 @@ class TelemetryRing:
         return first, newest
 
     def delta(self, name: str, span_s: float,
-              now: float | None = None) -> tuple[float, float] | None:
-        """(value delta, time delta) of counter ``name`` over the last
-        ``span_s`` seconds of snapshots; None when the series is absent or
-        fewer than two snapshots cover it."""
+              now: float | None = None) -> Delta | None:
+        """:class:`Delta` of counter ``name`` over the last ``span_s``
+        seconds of snapshots; None when the series is absent or fewer than
+        two snapshots cover it. Counter restarts inside the window (engine
+        revive / breaker swap — counters begin again at 0) are detected by
+        walking the window's consecutive pairs: the delta is clamped to
+        the reset-corrected increase and flagged ``reset=True`` instead of
+        ever going negative."""
         pair = self._window(span_s, now)
         if pair is None:
             return None
-        (_, t0, v0), (_, t1, v1) = pair
+        (seq0, t0, v0), (seq1, t1, v1) = pair
         if name not in v0 or name not in v1:
             return None
-        return v1[name] - v0[name], max(0.0, t1 - t0)
+        span = max(0.0, t1 - t0)
+        naive = v1[name] - v0[name]
+        # Reset scan over the window's consecutive pairs — an endpoint
+        # check alone is not enough (a reset can hide inside a window
+        # whose endpoints still increased). The ring is seq-ascending,
+        # so the walk skips to the window and stops at its end.
+        inc = 0.0
+        reset = False
+        prev = None
+        for seq, _t, vals in self._snaps:
+            if seq > seq1:
+                break
+            if seq < seq0 or name not in vals:
+                continue
+            v = vals[name]
+            if prev is not None:
+                if v >= prev:
+                    inc += v - prev
+                else:
+                    # Counter restarted: this sample counts from 0.
+                    reset = True
+                    inc += v
+            prev = v
+        if not reset:
+            return Delta(naive, span, False)
+        return Delta(inc, span, True)
 
     def rate(self, name: str, span_s: float,
              now: float | None = None) -> float | None:

@@ -28,6 +28,10 @@ Compared metrics (direction-aware):
                        rows (ISSUE 11): placement_blackout_ms_max/mean,
                        placement_lost, placement_dup
 Frontier rows (``e2e_frontier``, ISSUE 8) are matched by threshold.
+Scenario-matrix cells (``scenario_matrix``, ISSUE 13) are matched by
+scenario name — slo_attainment / quality up, admitted_p99_ms / expired
+down — and cells carrying an ``abort_reason`` are skipped on either side,
+exactly like aborted rounds.
 """
 
 from __future__ import annotations
@@ -65,6 +69,20 @@ FRONTIER_METRICS: dict[str, bool] = {
     "quality_mean": True,
     "wait_at_match_ms_p99": False,
     "quality_disparity": False,
+}
+
+#: Scenario-matrix cell metrics (ISSUE 13, ``bench.py --scenario-matrix``):
+#: cells are matched by scenario NAME, aborted cells (``abort_reason``
+#: set) are skipped on either side — a backend outage in one cell is an
+#: environment fact, not a regression.
+SCENARIO_METRICS: dict[str, bool] = {
+    "slo_attainment": True,
+    "admitted_p99_ms": False,
+    "expired": False,
+}
+SCENARIO_QUALITY_METRICS: dict[str, bool] = {
+    "quality_mean": True,
+    "quality_p10": True,
 }
 
 
@@ -185,6 +203,29 @@ def diff(baseline: dict, fresh: dict,
             row = _compare_one(
                 f"e2e_frontier[thr={fr.get('threshold'):g}].{name}",
                 br.get(name), fr.get(name), higher, threshold)
+            if row is not None:
+                rows.append(row)
+    # Scenario-matrix cells matched by scenario name (ISSUE 13); aborted
+    # cells on either side are skipped, like aborted rounds.
+    base_cells = {c.get("scenario"): c
+                  for c in baseline.get("scenario_matrix", [])
+                  if isinstance(c, dict) and not c.get("abort_reason")}
+    for fc in fresh.get("scenario_matrix", []):
+        if not isinstance(fc, dict) or fc.get("abort_reason"):
+            continue
+        bc = base_cells.get(fc.get("scenario"))
+        if bc is None:
+            continue
+        tag = f"scenario[{fc.get('scenario')}]"
+        for name, higher in SCENARIO_METRICS.items():
+            row = _compare_one(f"{tag}.{name}", bc.get(name),
+                               fc.get(name), higher, threshold)
+            if row is not None:
+                rows.append(row)
+        bq, fq = bc.get("quality") or {}, fc.get("quality") or {}
+        for name, higher in SCENARIO_QUALITY_METRICS.items():
+            row = _compare_one(f"{tag}.quality.{name}", bq.get(name),
+                               fq.get(name), higher, threshold)
             if row is not None:
                 rows.append(row)
     return rows

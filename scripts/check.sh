@@ -78,6 +78,18 @@ echo "== quality =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'quality and not slow' \
     --continue-on-collection-errors -p no:cacheprovider
 
+echo "== scenario observatory =="
+# ISSUE 13 gate: population-model scenario determinism (bit-identical
+# arrival transcripts, steady ≡ legacy loadgen byte for byte), the
+# telemetry counter-reset hardening, and the closed-loop autotuner
+# acceptance (autotune-on beats static on a scripted overload, with a
+# bit-identical knob-decision audit trace across two runs). The suite
+# includes the seeded 2-cell mini-matrix smoke driving the REAL
+# bench.py --scenario-matrix path in-process: artifact schema, autotuner
+# audit ring non-empty, and replay identity of the scenario digests.
+JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'scenario and not slow' \
+    --continue-on-collection-errors -p no:cacheprovider
+
 echo "== bench diff =="
 # Trajectory gate (ISSUE 8 satellite): when a fresh BENCH json is supplied
 # (MM_BENCH_JSON=/path scripts/check.sh), compare it against the newest
@@ -88,6 +100,21 @@ if [ -n "${MM_BENCH_JSON:-}" ]; then
     python scripts/bench_diff.py "$MM_BENCH_JSON"
 else
     echo "(skipped: set MM_BENCH_JSON=<fresh BENCH json> to gate)"
+fi
+# Scenario-matrix gate (ISSUE 13): a fresh `bench.py --scenario-matrix`
+# artifact diffs against the newest committed SCENARIOS_r*.json —
+# per-cell slo_attainment/quality up, admitted_p99/expired down, aborted
+# cells skipped.
+if [ -n "${MM_SCENARIO_JSON:-}" ]; then
+    scenario_base=$(ls SCENARIOS_r*.json 2>/dev/null | sort | tail -1)
+    if [ -n "$scenario_base" ]; then
+        python scripts/bench_diff.py "$MM_SCENARIO_JSON" \
+            --baseline "$scenario_base"
+    else
+        echo "(no committed SCENARIOS_r*.json baseline yet)"
+    fi
+else
+    echo "(skipped: set MM_SCENARIO_JSON=<fresh scenario-matrix json> to gate)"
 fi
 
 echo "== tier-1 =="

@@ -233,13 +233,48 @@ def main(argv=None) -> None:
                     help="match-quality & fairness summary "
                          "(/debug/quality; with --bench-json, the "
                          "e2e_frontier rows of a BENCH artifact)")
+    ap.add_argument("--scenario", action="store_true",
+                    help="scenario-matrix artifact summary (ISSUE 13): "
+                         "with --bench-json, the matrix table + per-cell "
+                         "trajectory/autotune rendering (scripts/"
+                         "scenario_report.py); live, the /debug/autotune "
+                         "knob-decision ring")
+    ap.add_argument("--cell", default="",
+                    help="with --scenario --bench-json: one cell's full "
+                         "story")
     ap.add_argument("--bench-json", default="",
                     help="read a BENCH json instead of a live service "
-                         "(with --quality)")
+                         "(with --quality or --scenario)")
     ap.add_argument("--json", action="store_true",
                     help="raw JSON instead of the waterfall rendering")
     args = ap.parse_args(argv)
     base = f"http://{args.host}:{args.port}"
+
+    if args.scenario:
+        if args.bench_json:
+            import scenario_report
+
+            doc = scenario_report._load(args.bench_json)
+            if args.json:
+                print(json.dumps(doc.get("scenario_matrix", []), indent=2))
+            else:
+                scenario_report.render(doc, cell_name=args.cell,
+                                       full=not args.cell)
+            return
+        body = _get(base, "/debug/autotune", {"n": args.n})
+        if args.json:
+            print(json.dumps(body, indent=2))
+            return
+        print(f"autotune: target {body.get('target_p99_ms')} ms, "
+              f"{body.get('moves')} move(s) over {body.get('ticks')} "
+              f"tick(s); knobs {body.get('knobs')}")
+        for d in body.get("decisions", []):
+            print(f"  #{d.get('seq')} t={d.get('t')} {d.get('queue')} "
+                  f"{d.get('knob')}: {d.get('from')} -> {d.get('to')} "
+                  f"[{d.get('status')}] — {d.get('reason')}")
+            if d.get("effect"):
+                print(f"      effect: {d['effect']}")
+        return
 
     if args.quality:
         if args.bench_json:

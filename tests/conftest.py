@@ -72,6 +72,12 @@ def pytest_configure(config):
         "soaks consume_batch on/off and shards 1/4 — scripts/check.sh "
         "runs it by marker; part of tier-1)")
     config.addinivalue_line(
+        "markers", "scenario: population-model load scenarios + online "
+        "autotuner suite (ISSUE 13: transcript determinism, steady≡legacy "
+        "byte identity, the seeded closed-loop autotune acceptance, the "
+        "2-cell mini-matrix smoke — scripts/check.sh runs it by marker; "
+        "part of tier-1)")
+    config.addinivalue_line(
         "markers", "codec: native-codec parity fuzz (byte/field equality "
         "vs the Python contract module over a seeded corpus — "
         "scripts/check.sh runs it by marker after rebuilding "

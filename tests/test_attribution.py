@@ -338,15 +338,112 @@ def test_telemetry_ring_delta_rate_and_filtering():
                                "other": 1.0})
     assert len(ring) == 4  # bounded
     d = ring.delta("slo_good[q]", 2.0, now=5.0)
-    assert d == (20.0, 2.0)
+    # Delta is a (value, span_s, reset) NamedTuple — the old positional
+    # contract holds at [0]/[1], with the reset flag riding along.
+    assert (d.value, d.span_s, d.reset) == (20.0, 2.0, False)
+    assert (d[0], d[1]) == (20.0, 2.0)
     assert ring.rate("slo_good[q]", 2.0, now=5.0) == pytest.approx(10.0)
     # window longer than the ring falls back to the oldest retained
     d = ring.delta("slo_good[q]", 100.0, now=5.0)
-    assert d == (30.0, 3.0)
+    assert (d.value, d.span_s) == (30.0, 3.0)
     assert ring.delta("missing", 2.0) is None
     rows = ring.snapshot(limit=2, prefixes=("slo_good",))
     assert len(rows) == 2
     assert set(rows[-1]["values"]) == {"slo_good[q]"}
+
+
+def test_telemetry_ring_counter_reset_clamps_and_flags():
+    """ISSUE 13 satellite: an engine revive/breaker swap restarts the
+    monotone device counters at 0 — delta/rate must never go negative.
+    The reset-corrected increase sums positive increments, counting each
+    post-reset sample from 0 (Prometheus increase() semantics), and the
+    ``reset`` flag marks the window as spanning two engines."""
+    from matchmaking_tpu.utils.timeseries import Delta
+
+    ring = TelemetryRing(16)
+    # 10 → 30 busy-seconds, revive (restart at 2), then 2 → 8.
+    for t, v in [(0, 10.0), (1, 20.0), (2, 30.0), (3, 2.0), (4, 8.0)]:
+        ring.append(float(t), {"device_busy_s[q]": v})
+    d = ring.delta("device_busy_s[q]", 100.0, now=4.0)
+    assert isinstance(d, Delta)
+    assert d.reset is True
+    assert d.value == pytest.approx(28.0)  # 20 pre-revive + 8 post
+    assert d.value >= 0 and ring.rate("device_busy_s[q]", 100.0,
+                                      now=4.0) >= 0
+    # A reset hidden INSIDE an endpoint-increasing window is still caught
+    # (naive endpoint difference would undercount, not just go negative).
+    ring2 = TelemetryRing(16)
+    for t, v in [(0, 10.0), (1, 1.0), (2, 12.0)]:
+        ring2.append(float(t), {"c": v})
+    d2 = ring2.delta("c", 100.0, now=2.0)
+    assert d2.reset is True and d2.value == pytest.approx(12.0)
+    # Reset-free windows keep the exact endpoint difference.
+    ring3 = TelemetryRing(16)
+    for t, v in [(0, 5.0), (1, 6.0), (2, 9.0)]:
+        ring3.append(float(t), {"c": v})
+    d3 = ring3.delta("c", 100.0, now=2.0)
+    assert d3.reset is False and d3.value == 4.0
+
+
+async def test_telemetry_reset_survives_engine_revive_mid_soak(rng):
+    """Regression pin for the revive-mid-soak shape: a scripted chaos
+    step fault crashes the device engine mid-traffic, the revive installs
+    a fresh engine (busy/idle counters restart at 0), and every delta the
+    ring serves across that boundary stays non-negative with the reset
+    flag raised — the burn monitors and the autotuner read these."""
+    q = QueueConfig(name="mm.reset", rating_threshold=1.0,
+                    send_queued_ack=False)
+    cfg = Config(
+        queues=(q,),
+        engine=EngineConfig(backend="tpu", pool_capacity=512,
+                            pool_block=256, batch_buckets=(16, 64),
+                            pipeline_depth=1),
+        batcher=BatcherConfig(max_batch=32, max_wait_ms=2.0),
+        chaos=ChaosConfig(seed=7, queues=(q.name,), fail_steps=(2,)),
+        observability=ObservabilityConfig(snapshot_interval_s=0.0),
+    )
+    app = MatchmakingApp(cfg)
+    await app.start()
+    rt = app.runtime(q.name)
+    try:
+        crash_seen = False
+        for wave in range(6):
+            # Unmatchable ratings (unique, gaps >> threshold): every wave
+            # dispatches at least one real window and the pool only grows
+            # — so the scripted step-2 fault fires mid-soak, not at the
+            # teardown flush.
+            for j in range(8):
+                rating = 1000 + (wave * 8 + j) * 300
+                app.broker.publish(
+                    q.name,
+                    f'{{"id":"p{wave}_{j}","rating":{rating}}}'.encode(),
+                    Properties(reply_to="reset.replies",
+                               correlation_id=f"c{wave}_{j}"))
+            for _ in range(200):
+                await asyncio.sleep(0.02)
+                if (app.broker.queue_depth(q.name) == 0
+                        and rt.batcher.depth == 0 and rt._flushing == 0
+                        and rt.engine.inflight() == 0):
+                    break
+            app.sample_telemetry(now=float(wave + 1))
+            crash_seen = crash_seen or any(
+                e["kind"] == "engine_crash"
+                for e in app.events.snapshot())
+        assert crash_seen, "the scripted step fault never fired"
+        for name in (f"device_busy_s[{q.name}]",
+                     f"device_idle_s[{q.name}]"):
+            d = app.telemetry.delta(name, 100.0, now=6.0)
+            assert d is not None
+            assert d.value >= 0.0, (name, d)
+        # At least one of the device-counter series must have seen the
+        # restart (the revive rebuilt the engine).
+        flags = [app.telemetry.delta(f"device_busy_s[{q.name}]",
+                                     100.0, now=6.0).reset,
+                 app.telemetry.delta(f"device_idle_s[{q.name}]",
+                                     100.0, now=6.0).reset]
+        assert any(flags), flags
+    finally:
+        await app.stop()
 
 
 def test_slo_monitor_burn_transitions_emit_events():

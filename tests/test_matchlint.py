@@ -756,6 +756,104 @@ class Runtime:
     assert any(f.line in (3, 4) for f in leaks)
 
 
+def test_settlement_length_parallel_filter_refinement():
+    """ISSUE 13 satellite: two locals filtered by the SAME predicate —
+    a mask-vector ``take`` on the column plane and an ``if``-filtered
+    comprehension on the delivery plane — keep row-parallel residues, so
+    an emptiness test on one vacuously settles the other's group too (the
+    empty-residue shape that carried _flush_columnar's last inline
+    ignore). The refinement is value-flow-narrow: breaking the predicate
+    identity (planted bug below) keeps the window-leak finding."""
+    clean = analyze_source('''
+import numpy as np
+
+class Runtime:
+    # settles: *deliveries
+    def _handle_out(self, out, deliveries, now):
+        for d in deliveries:
+            self.app.broker.ack(self.tag, d.delivery_tag)
+
+    # settles: *deliveries
+    def _flush(self, cols, deliveries, keep, now):
+        drop = self._pay_debt(keep)
+        if drop:
+            mask = np.fromiter(
+                (pid not in drop for pid in cols.ids.tolist()),
+                bool, len(cols))
+            cols = cols.take(mask)
+            deliveries_in = [deliveries[s] for s, pid, _ in keep
+                             if pid not in drop]
+            if not len(cols):
+                return
+        out = self.engine.go(cols)
+        self._handle_out(out, deliveries, now)
+''', path="matchmaking_tpu/service/fixture.py")
+    assert [f for f in clean if f.rule == "settlement"] == [], clean
+    # Planted bug: the delivery-plane filter tests a DIFFERENT set
+    # (dropped vs drop) — the residues are no longer length-parallel, so
+    # `len(cols) == 0` proves nothing about the deliveries and the
+    # window-leak report at the early return must survive.
+    dirty = analyze_source('''
+import numpy as np
+
+class Runtime:
+    # settles: *deliveries
+    def _handle_out(self, out, deliveries, now):
+        for d in deliveries:
+            self.app.broker.ack(self.tag, d.delivery_tag)
+
+    # settles: *deliveries
+    def _flush(self, cols, deliveries, keep, now):
+        drop = self._pay_debt(keep)
+        dropped = self._other_set(keep)
+        if drop:
+            mask = np.fromiter(
+                (pid not in drop for pid in cols.ids.tolist()),
+                bool, len(cols))
+            cols = cols.take(mask)
+            deliveries_in = [deliveries[s] for s, pid, _ in keep
+                             if pid not in dropped]
+            if not len(cols):
+                return
+        out = self.engine.go(cols)
+        self._handle_out(out, deliveries, now)
+''', path="matchmaking_tpu/service/fixture.py")
+    leaks = [f for f in dirty if f.rule == "settlement"
+             and "window leak" in f.message]
+    assert leaks and leaks[0].line == 22, dirty
+    # Same-plane pairs never link: two plain comprehensions can share a
+    # predicate TEXT while filtering different base collections (lengths
+    # unrelated), so `not a` proves nothing about b — the leak report
+    # must survive.
+    same_plane = analyze_source('''
+class Runtime:
+    # settles: *deliveries
+    def _shed(self, deliveries, xs, ys, drop):
+        a = [pid for pid in xs if pid not in drop]
+        b = [deliveries[s] for s, pid, _ in ys if pid not in drop]
+        if not a:
+            return
+        self.publish_batch(b)
+''', path="matchmaking_tpu/service/fixture.py")
+    assert [f for f in same_plane if f.rule == "settlement"
+            and "window leak" in f.message], same_plane
+
+
+def test_settlement_flush_columnar_empty_residue_ignore_retired():
+    """The last matchlint inline ignore in service/app.py (the
+    empty-residue ``len(cols)``↔deliveries parallelism) is retired: the
+    tree carries NO ignore[settlement] comments and the settlement rule
+    is clean over the live file."""
+    import pathlib
+
+    src = pathlib.Path("matchmaking_tpu/service/app.py").read_text()
+    assert "ignore[settlement]" not in src
+    new, _accepted, _warnings = analyze_repo(
+        dynamic=False, rules={"settlement"}, use_cache=False)
+    assert [f for f in new if f.rule == "settlement"
+            and "app.py" in f.path] == [], new
+
+
 # ---- lock-pairing ----------------------------------------------------------
 
 def test_lock_pairing_flags_unbalanced_paths_and_accepts_try_finally():
