@@ -307,6 +307,29 @@ def roofline(engine, rng: np.random.Generator, *, window: int,
     }
 
 
+def _bucketed_geometry(capacity: int, pool_block: int,
+                       window: int) -> dict:
+    """EngineConfig extras for hierarchical bucketed formation (ISSUE 14):
+    band-per-block allocation + a span budget of ~33% of the blocks.
+
+    Sizing math (N(1500, 300) population, 100-ELO default threshold,
+    equal-mass bands): a central player's admissible candidates are
+    ~2·thr·φ(0)/σ ≈ 26.7% of the population mass — the irreducible
+    candidate-bucket fraction — plus the sorted chunk's own mass
+    (c/window of the window) and the f32 inflation. Chunks of
+    ~window/64 keep the chunk-mass term under ~2% of the blocks, so a
+    33% span budget leaves headroom over the ~29% requirement and every
+    feasible window reports formation_touched_frac ≈ 1/3 ≪ 1 (the
+    dense-fallback cond covers distribution drift)."""
+    n_blocks = max(1, capacity // pool_block)
+    return dict(
+        bucketed=True,
+        band_spec="gaussian:1500:300",
+        prune_window_blocks=max(2, -(-n_blocks * 33 // 100)),
+        prune_chunk=max(8, min(128, window // 64)),
+    )
+
+
 def bench_tpu(args) -> dict:
     from matchmaking_tpu.config import Config, EngineConfig, QueueConfig
     from matchmaking_tpu.engine.interface import make_engine
@@ -320,6 +343,9 @@ def bench_tpu(args) -> dict:
             batch_buckets=(16, 64, 256, args.window),
             top_k=8,
             readback_group=args.readback_group,
+            **(_bucketed_geometry(args.capacity, args.pool_block,
+                                  args.window)
+               if args.bucketed else {}),
         ),
     )
     engine = make_engine(cfg, cfg.queues[0])
@@ -381,6 +407,8 @@ def bench_tpu(args) -> dict:
             log(f"[tpu] roofline failed: {e!r}")
     runs.sort(key=lambda r: r["matches_per_sec"])
     median = runs[len(runs) // 2]
+    formation = (engine.formation_report()
+                 if hasattr(engine, "formation_report") else None)
     return {
         **median,
         "pool": args.pool,
@@ -389,6 +417,9 @@ def bench_tpu(args) -> dict:
         "hot_path_recompiles": recompiles,
         "spans": (engine.span_report()
                   if hasattr(engine, "span_report") else {}),
+        **({"formation_touched_frac":
+            formation.get("formation_touched_frac")}
+           if formation else {}),
         **roof,
     }
 
@@ -1206,6 +1237,104 @@ def bench_consume_ab(args) -> dict:
     return asyncio.run(run())
 
 
+def bench_pool_scale(args) -> list:
+    """Sub-O(P) formation sweep (ISSUE 14): a hierarchical rating-bucketed
+    engine at growing synthetic pool scales (default 100k/300k/1M), one
+    row per scale with throughput + ``formation_touched_frac`` — the pool
+    slots each window lane's formation actually scored over the flat
+    step's O(P). Geometry per scale: capacity ≈ 4/3 × pool rounded to the
+    block, one rating band per block, span budget ~33% of the blocks
+    (``_bucketed_geometry``). The pool is seeded by building the device
+    arrays straight from the columnar mirror (one vectorized pass + an
+    exact index rebuild) — admitting a million players through O(P)
+    device admits would measure the fill, not formation.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from matchmaking_tpu.config import Config, EngineConfig, QueueConfig
+    from matchmaking_tpu.core.pool import PlayerPool
+    from matchmaking_tpu.engine.interface import make_engine
+
+    scales = [int(s) for s in args.pool_scale.split(",") if s]
+    window = args.pool_scale_window
+    rows = []
+    for pool_target in scales:
+        # ~64+ blocks per scale: bucket resolution is what the span math
+        # converts into a small touched fraction.
+        pool_block = min(args.pool_block,
+                         max(1024, 1 << (pool_target // 96).bit_length()))
+        capacity = ((pool_target * 4 // 3 + pool_block - 1)
+                    // pool_block) * pool_block
+        geo = _bucketed_geometry(capacity, pool_block, window)
+        cfg = Config(
+            queues=(QueueConfig(rating_threshold=100.0),),
+            engine=EngineConfig(
+                backend="tpu", pool_capacity=capacity,
+                pool_block=pool_block,
+                batch_buckets=(16, 64, 256, window), top_k=8,
+                readback_group=1, **geo,
+            ),
+        )
+        engine = make_engine(cfg, cfg.queues[0])
+        rng = np.random.default_rng(14)
+        log(f"[pool-scale {pool_target}] capacity={capacity} "
+            f"blocks={capacity // pool_block} "
+            f"span_blocks={geo['prune_window_blocks']}")
+        # Vectorized fill: mirror first (banded slot placement), then the
+        # device columns in ONE device_put + one exact index rebuild.
+        t0 = engine._rel_base(0.0)
+        filled = 0
+        while filled < pool_target:
+            chunk = min(pool_target - filled, 65_536)
+            engine.pool.allocate_columns(
+                make_columns(rng, chunk, filled, 0.0))
+            filled += chunk
+        occ = engine.pool.waiting_slots()
+        arrays = PlayerPool.empty_device_arrays(capacity)
+        arrays["rating"][occ] = engine.pool.m_rating[occ]
+        arrays["rd"][occ] = engine.pool.m_rd[occ]
+        arrays["region"][occ] = engine.pool.m_region[occ]
+        arrays["mode"][occ] = engine.pool.m_mode[occ]
+        arrays["threshold"][occ] = engine.pool.m_threshold[occ]
+        arrays["enqueue_t"][occ] = (engine.pool.m_enqueued[occ]
+                                    - t0).astype(np.float32)
+        arrays["active"][occ] = True
+        arrays.update(engine.kernels.init_index_arrays())
+        engine._dev_pool = engine.kernels.index_rebuild(
+            {k: jnp.asarray(v) for k, v in arrays.items()})
+        jax.block_until_ready(engine._dev_pool)
+        log(f"[pool-scale {pool_target}] pool seeded "
+            f"({engine.pool_size()} waiting)")
+        # Steady-occupancy stream: no refill (pool_target=0 disables it) —
+        # a few windows drain only matched players, << pool.
+        mps, lats, total = run_engine_pipelined(
+            engine, rng, pool_target=0, window=window, warmup=2,
+            measured=args.pool_scale_windows, depth=2,
+            label=f"pool-scale {pool_target}",
+            # Fresh id space: the fill consumed p0..p<pool>, and duplicate
+            # ids would be dedup-dropped into empty windows.
+            gen=lambda r, n, sid, now: make_columns(
+                r, n, sid + pool_target, now))
+        rep = engine.formation_report() or {}
+        lat_ms = np.sort(np.asarray(lats)) * 1e3
+        rows.append({
+            "pool": pool_target,
+            "capacity": capacity,
+            "blocks": capacity // pool_block,
+            "span_blocks": geo["prune_window_blocks"],
+            "window": window,
+            "matches_per_sec": round(mps, 1),
+            "p99_ms": (float(np.percentile(lat_ms, 99))
+                       if lat_ms.size else None),
+            "total_matches": total,
+            "formation_touched_frac": rep.get("formation_touched_frac"),
+            "formation_windows": rep.get("windows"),
+        })
+        log(f"[pool-scale {pool_target}] {rows[-1]}")
+    return rows
+
+
 def bench_cpu_oracle(args) -> dict:
     """Reference-semantics oracle at the reference's ~2k-player scale."""
     from matchmaking_tpu.config import Config, QueueConfig
@@ -1637,6 +1766,23 @@ def main() -> None:
                         "backend_unavailable (the tunnel has outages)")
     p.add_argument("--init-delay", type=float, default=60.0,
                    help="seconds between backend-init attempts")
+    p.add_argument("--bucketed", action="store_true",
+                   help="hierarchical rating-bucketed formation (ISSUE "
+                        "14) for the engine phase: band-per-block "
+                        "allocation + index-driven span formation "
+                        "(bit-exact vs flat); the result row records "
+                        "formation_touched_frac")
+    p.add_argument("--pool-scale", default="",
+                   help="sub-O(P) formation sweep (ISSUE 14): comma list "
+                        "of synthetic pool sizes (e.g. "
+                        "100000,300000,1000000) — one bucketed engine "
+                        "per scale, rows under pool_scale with "
+                        "matches_per_sec + formation_touched_frac "
+                        "(bench_diff gates both per pool size)")
+    p.add_argument("--pool-scale-window", type=int, default=512,
+                   help="request window for the pool-scale sweep")
+    p.add_argument("--pool-scale-windows", type=int, default=8,
+                   help="measured windows per pool-scale cell")
     p.add_argument("--skip-roofline", action="store_true",
                    help="skip the chained device-step roofline phase")
     p.add_argument("--skip-e2e", action="store_true",
@@ -1933,6 +2079,12 @@ def main() -> None:
             mp = bench_multiproc(args)
         except Exception as e:
             log(f"[multiproc] failed: {e!r}")
+    pool_scale: list = []
+    if args.pool_scale:
+        try:
+            pool_scale = bench_pool_scale(args)
+        except Exception as e:
+            log(f"[pool-scale] failed: {e!r}")
     if args.skip_cpu:
         # None, not NaN: NaN is not valid RFC 8259 JSON and breaks strict
         # parsers on the driver side.
@@ -1957,6 +2109,18 @@ def main() -> None:
         "all_runs_mps": tpu.get("all_runs_mps", []),
         **e2e,
         **mp,
+        **({"pool_scale": pool_scale} if pool_scale else {}),
+        # The headline sub-O(P) number (ISSUE 14): the largest measured
+        # pool's touched fraction (max by pool, not CLI order — rounds
+        # must gate like against like), falling back to the engine
+        # phase's (present when --bucketed).
+        **({"formation_touched_frac":
+            (max(pool_scale,
+                 key=lambda r: r.get("pool", 0))
+             .get("formation_touched_frac")
+             if pool_scale else tpu.get("formation_touched_frac"))}
+           if (pool_scale or tpu.get("formation_touched_frac") is not None)
+           else {}),
         "hot_path_recompiles": tpu.get("hot_path_recompiles"),
         "device_step_ms": tpu.get("device_step_ms"),
         "hbm_bytes_per_s": tpu.get("hbm_bytes_per_s"),

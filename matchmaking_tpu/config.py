@@ -172,6 +172,38 @@ class EngineConfig:
     #: "gaussian:MEAN:STD" (equal-mass bands — keeps band occupancy even
     #: under a normal rating distribution). One band per pool block.
     band_spec: str = ""
+    #: Hierarchical rating-bucketed formation (ISSUE 14). Single-device 1v1
+    #: queues: the pool dict carries a device-resident bucket index (per-
+    #: block occupancy + conservative rating bounds, maintained
+    #: incrementally by every admit/match/evict; kernels.INDEX_FIELDS) and
+    #: window formation cuts candidate spans from the INDEX instead of
+    #: re-deriving block bounds with an O(P) per-window scan — sub-O(P)
+    #: formation, bit-exact vs the flat step (dense-fallback cond above
+    #: span overflow), with the touched-slot fraction reported per window.
+    #: Most effective with ``band_spec`` set (rating-coherent blocks);
+    #: without it the step stays correct but mostly falls back to dense.
+    #: Sharded 1v1 queues additionally need ``bucket_frontier_k``.
+    bucketed: bool = False
+    #: Per-bucket top-K frontier exchange for SHARDED 1v1 queues
+    #: (mesh_pool_axis > 1; engine/sharded.py ``bucket_step``): each shard
+    #: compacts every local pool block into its top-K active rows and only
+    #: those frontiers cross the shard boundary (ppermute ring) — ICI
+    #: traffic and formation work become occupancy-shaped (O(nb·K))
+    #: instead of capacity-shaped (O(P)). This value is the LADDER
+    #: CEILING: the engine sizes the actual K per window from the
+    #: mirror's observed per-bucket occupancy (powers of two up to here,
+    #: compiled lazily per K, moves audited in /debug/placement) and
+    #: falls back to the dense sharded step when any bucket overflows —
+    #: which is the bit-exactness gate. 0 = off.
+    bucket_frontier_k: int = 0
+    #: Consumer merge for ring-gathered team/role frontiers
+    #: (``teams.merge_frontiers``): "linear" concatenates all D·K rows in
+    #: canonical shard order (the PR 1 path); "tournament" merges the D
+    #: already-sorted K-row frontiers up a pairwise tree keeping top-K —
+    #: the formation buffer shrinks from O(K·D) to O(K) (working set
+    #: O(K·log D)), bit-exact under the ring path's existing occupancy
+    #: gate.
+    frontier_merge: str = "linear"
     #: Device-engine circuit breaker (service/breaker.py): after this many
     #: engine crashes within ``breaker_window_s`` the queue's breaker trips
     #: OPEN and the queue is demoted to the host-oracle engine — matches

@@ -94,9 +94,21 @@ class PlayerPool:
     """
 
     def __init__(self, capacity: int, default_threshold: float,
-                 band_edges: Sequence[float] | None = None):
+                 band_edges: Sequence[float] | None = None,
+                 segments: int = 0):
         self.capacity = int(capacity)
         self.default_threshold = float(default_threshold)
+        #: Incremental per-SEGMENT occupancy (ISSUE 14): the engine passes
+        #: ``segments`` = its device block count, and every allocate/release
+        #: keeps a per-block occupancy histogram by SLOT RANGE — the host
+        #: twin of the device bucket index's counts, and the O(segments)
+        #: gate the sharded bucket-frontier step checks per window (max
+        #: per-bucket occupancy must fit the frontier K). 0 = untracked.
+        self._segments = max(0, int(segments))
+        self._seg_size = (self.capacity // self._segments
+                          if self._segments else 0)
+        self._seg_n = (np.zeros(self._segments, np.int64)
+                       if self._segments else None)
         # Vectorized free list: pop from the END (head), so initial pops
         # yield slot 0, 1, 2, ... (kept for slot-order determinism in tests).
         self._free = np.arange(self.capacity - 1, -1, -1, dtype=np.int32)
@@ -203,6 +215,39 @@ class PlayerPool:
         """Waiting players with a stamped deadline (O(1); incremental)."""
         return self._deadline_n
 
+    def segment_counts(self) -> "np.ndarray | None":
+        """Per-segment (= device pool block / rating bucket) occupancy,
+        maintained incrementally by allocate/release — O(segments) read,
+        never a pool scan. None when segment tracking is off."""
+        return self._seg_n
+
+    def segment_max(self) -> int:
+        """Peak per-segment occupancy (the sharded bucket-frontier gate's
+        one number). 0 when untracked or empty."""
+        if self._seg_n is None:
+            return 0
+        return int(self._seg_n.max(initial=0))
+
+    def _seg_add(self, slots: np.ndarray, sign: int) -> None:
+        if self._seg_n is None or slots.size == 0:
+            return
+        seg = np.minimum(slots // self._seg_size, self._segments - 1)
+        np.add.at(self._seg_n, seg, sign)
+
+    def band_report(self) -> "dict | None":
+        """Host allocator state of the rating-banded free lists (ISSUE 14
+        'free-slot heads'): per-band free-slot head positions + band sizes.
+        None when banding is off."""
+        if self._band_edges is None:
+            return None
+        return {
+            "bands": len(self._band_free),
+            "free_heads": [int(h) for h in self._band_head],
+            "band_sizes": [int(self._band_start[b + 1] - self._band_start[b])
+                           for b in range(len(self._band_free))],
+            "edges": [float(e) for e in self._band_edges],
+        }
+
     def tier_counts(self, n_tiers: int) -> list[int]:
         """Waiting players per QoS tier (len ``n_tiers``; out-of-range
         tiers are clamped into the last bucket). O(n_tiers) — maintained
@@ -273,6 +318,7 @@ class PlayerPool:
             self.m_deadline[slots] = dl
             self._deadline_n += int((dl != 0.0).sum())
         self._slot_of.update(zip(ids, slots.tolist()))
+        self._seg_add(slots, 1)
         return slots
 
     def allocate(self, requests: Sequence[SearchRequest]) -> list[int]:
@@ -302,6 +348,7 @@ class PlayerPool:
             return
         for pid in ids[occupied].tolist():
             del self._slot_of[pid]
+        self._seg_add(arr, -1)
         # Per-tier/deadline occupancy bookkeeping BEFORE clearing slots.
         for t, c in zip(*np.unique(self.m_tier[arr], return_counts=True)):
             self._tier_n[int(t)] = self._tier_n.get(int(t), 0) - int(c)

@@ -52,6 +52,23 @@ _NEG_INF = jnp.float32(-jnp.inf)
 #: Numeric pool fields admitted from a batch (active is handled separately).
 _ADMIT_FIELDS = ("rating", "rd", "region", "mode", "threshold", "enqueue_t")
 
+#: Device-resident bucket-index columns (ISSUE 14) carried INSIDE the pool
+#: dict of a bucketed KernelSet, one element per pool block (= rating
+#: bucket under band_spec): live occupancy, conservative rating bounds,
+#: and the max rating deviation. Bounds only WIDEN incrementally
+#: (admission merges the window's stats in; eviction leaves them) — always
+#: a superset of the true live bounds, which is exactly what the span
+#: math needs for bit-exactness; ``index_rebuild`` re-tightens them with
+#: one O(P) scan off the hot path. Counts are exact (± per admitted /
+#: evicted slot); the span math reads only the bounds — counts are the
+#: index's occupancy half, kept current because (a) the one-hot
+#: membership sums maintaining them are O(nb·B), noise next to the
+#: window's O(B·W·blk) score work, (b) the counts==rebuild invariant is
+#: what the tests pin the whole incremental maintenance against, and
+#: (c) device-side per-bucket frontier-K sizing is the named follow-up
+#: consumer.
+INDEX_FIELDS = ("bidx_count", "bidx_min", "bidx_max", "bidx_rd")
+
 
 def unpack_batch(packed) -> dict[str, Any]:
     """f32[8, B] (see pool.PACKED_ROWS) → the batch dict the kernels use.
@@ -281,7 +298,7 @@ class KernelSet:
                  glicko2: bool, widen_per_sec: float, max_threshold: float,
                  evict_bucket: int = 64, pair_rounds: int = 8,
                  exact_block: bool = False, prune_window_blocks: int = 0,
-                 prune_chunk: int = 128):
+                 prune_chunk: int = 128, bucketed: bool = False):
         pool_block = effective_pool_block(capacity, pool_block, top_k,
                                           min_blocks=not exact_block)
         self.capacity = capacity
@@ -293,6 +310,18 @@ class KernelSet:
         self.max_threshold = max_threshold
         self.evict_bucket = evict_bucket
         self.pair_rounds = pair_rounds
+        # Hierarchical rating-bucketed formation (ISSUE 14): the pool dict
+        # carries a per-block bucket index (INDEX_FIELDS) maintained
+        # incrementally by every admit/evict/step, and window formation
+        # derives its candidate spans from the index instead of the O(P)
+        # per-window _live_stats scan — the span machinery (and its
+        # bit-exactness argument) is the pruned step's.
+        self.bucketed = bucketed
+        if bucketed and prune_window_blocks <= 0:
+            # Default span width: a quarter of the pool's blocks — wide
+            # enough for mid-distribution chunks at the default threshold
+            # under band_spec, still sub-O(P).
+            prune_window_blocks = max(2, self.n_blocks // 4)
         # Rating-banded candidate pruning (bit-exact — see
         # _search_step_pruned). 0 disables; values ≥ n_blocks degenerate to
         # scoring every block through the pruned plumbing.
@@ -300,9 +329,32 @@ class KernelSet:
                                        self.n_blocks)
         self.prune_chunk = max(1, prune_chunk)
 
-        step = (self._search_step_pruned if self.prune_window_blocks
-                else self._search_step)
+        if bucketed:
+            step = self._search_step_bucketed
+        elif self.prune_window_blocks:
+            step = self._search_step_pruned
+        else:
+            step = self._search_step
         self._step_impl = step
+        if bucketed:
+            self.admit = jax.jit(self._admit_indexed, donate_argnums=0)
+            self.evict = jax.jit(self._evict_indexed, donate_argnums=0)
+            self.search_step = jax.jit(
+                lambda pool, batch, now: self._search_step_bucketed(
+                    pool, batch, now)[:4], donate_argnums=0)
+            self.admit_packed = jax.jit(
+                lambda pool, packed: self._admit_indexed(
+                    pool, unpack_batch(packed)), donate_argnums=0)
+            self.search_step_packed = jax.jit(
+                self._search_step_packed_bucketed, donate_argnums=0)
+            self.search_step_packed_nofilter = jax.jit(
+                functools.partial(self._search_step_packed_bucketed,
+                                  skip_filters=True), donate_argnums=0)
+            self.search_step_packed_rescan = jax.jit(
+                self._rescan_step_packed_bucketed, donate_argnums=0)
+            self.index_rebuild = jax.jit(self._index_rebuild,
+                                         donate_argnums=0)
+            return
         self.admit = jax.jit(self._admit, donate_argnums=0)
         self.evict = jax.jit(self._evict, donate_argnums=0)
         self.search_step = jax.jit(step, donate_argnums=0)
@@ -740,7 +792,18 @@ class KernelSet:
         width = jnp.maximum(last - first + 1, 0)
         feasible = jnp.all(width <= w)
         dstart = jnp.clip(jnp.minimum(first, nb - w), 0, nb - w)
-        return dstart.astype(jnp.int32), feasible
+        # Chunks with NO admissible block (width 0 ⇔ no valid lane: a
+        # valid lane's own block always overlaps its chunk at reach ≥ 0)
+        # park on the first busy chunk's span instead of the clip
+        # fallback at the pool tail — their scan then re-reads slots a
+        # busy chunk already touched, so padding chunks never widen the
+        # touched-union (and never drag cold blocks into cache). Scoring
+        # is -inf for them wherever they point, so outputs are unchanged.
+        busy = width > 0
+        common = dstart[jnp.argmax(busy)]
+        dstart = jnp.where(busy, dstart,
+                           jnp.where(busy.any(), common, 0))
+        return dstart.astype(jnp.int32), feasible, width
 
     def _candidates_pruned(self, sb, q_thr_eff, pool, now, dstart,
                            skip_filters: bool = False):
@@ -793,7 +856,7 @@ class KernelSet:
         bmin = jnp.minimum(lmin, imin)
         bmax = jnp.maximum(lmax, imax)
         brd = jnp.maximum(lrd, ird)
-        dstart, feasible = self._chunk_windows(sb, qte, bmin, bmax, brd)
+        dstart, feasible, _ = self._chunk_windows(sb, qte, bmin, bmax, brd)
 
         def pruned_path():
             p = self._admit_chunked(pool, sb, dstart)
@@ -808,25 +871,304 @@ class KernelSet:
         pool, vals, idxs = lax.cond(feasible, pruned_path, dense_path)
         s_q, s_c, s_d = greedy_pair(vals, idxs, sb["slot"], self.capacity,
                                     self.pair_rounds, rid=oi)
+        out_q, out_c, out_d = self._unsort_matches(oi, s_q, s_c, s_d)
 
-        # Unsort to original lane order with an exact one-hot matmul (the
-        # scatter-free idiom; gathers/scatters of B irregular elements
-        # serialize on TPU). HIGHEST keeps the 0/1 × value products exact;
-        # +inf sentinels are encoded as -1 first (0·inf would poison rows
-        # with NaN), and dist ≥ 0 makes -1 unambiguous.
+        # Eviction uses the sorted-order outputs — same slot set.
+        pool = self._evict(pool, jnp.concatenate([s_q, s_c]))
+        return pool, out_q, out_c, out_d
+
+    def _unsort_matches(self, oi, s_q, s_c, s_d):
+        """Sorted-order match outputs → original lane order with an exact
+        one-hot matmul (the scatter-free idiom; gathers/scatters of B
+        irregular elements serialize on TPU). HIGHEST keeps the 0/1 ×
+        value products exact; +inf sentinels are encoded as -1 first
+        (0·inf would poison rows with NaN), and dist ≥ 0 makes -1
+        unambiguous. One definition for every sorted-window step (pruned,
+        bucketed, bucketed rescan) — the encoding is bit-exactness-
+        critical and must not diverge between copies."""
+        b = oi.shape[0]
         onehot = (oi[None, :] == jnp.arange(b, dtype=jnp.int32)[:, None]
                   ).astype(jnp.float32)
         enc_d = jnp.where(jnp.isinf(s_d), jnp.float32(-1.0), s_d)
         stacked = jnp.stack(
             [s_q.astype(jnp.float32), s_c.astype(jnp.float32), enc_d], axis=1)
         un = jnp.matmul(onehot, stacked, precision=lax.Precision.HIGHEST)
-        out_q = un[:, 0].astype(jnp.int32)
-        out_c = un[:, 1].astype(jnp.int32)
-        out_d = jnp.where(un[:, 2] < 0, jnp.inf, un[:, 2])
+        return (un[:, 0].astype(jnp.int32), un[:, 1].astype(jnp.int32),
+                jnp.where(un[:, 2] < 0, jnp.inf, un[:, 2]))
 
-        # Eviction uses the sorted-order outputs — same slot set.
-        pool = self._evict(pool, jnp.concatenate([s_q, s_c]))
-        return pool, out_q, out_c, out_d
+    # ---- hierarchical rating-bucketed formation (ISSUE 14) -----------------
+    #
+    # The pruned step above is bit-exact but still pays one O(P) pass per
+    # window: _live_stats re-derives every block's rating bounds from the
+    # full pool columns before any span can be cut. The bucketed step
+    # removes that last O(P) term by carrying the bounds as STATE — a
+    # device-resident bucket index (INDEX_FIELDS inside the pool dict, one
+    # row per pool block = rating bucket under band_spec) maintained
+    # incrementally:
+    #
+    #   admit      → counts += per-block window hits; bounds WIDEN by the
+    #                window's per-block stats (_incoming_stats)
+    #   match/evict→ counts -= per-block matched hits; bounds untouched
+    #   rebuild    → one exact O(P) scan (engine heartbeat / restore) that
+    #                re-tightens the monotone-widening bounds
+    #
+    # Bit-exactness vs the flat/dense step carries over unchanged from the
+    # pruned step's argument with one extra observation: the index bounds
+    # are always a SUPERSET of the true live bounds (widen-only between
+    # rebuilds), and a superset bound can only make spans wider — a block
+    # excluded by a superset bound is excluded by the exact bound, so it
+    # scores -inf in the dense scan too. Threshold widening composes the
+    # same way: _chunk_windows computes reach from the effective (aged)
+    # thresholds, so the candidate BUCKET SET expands as players age while
+    # the per-window work stays proportional to the spans, not the pool.
+    #
+    # Formation cost per window: O(B·W·blk) score/admit + O(B·W·blk)
+    # span-local eviction + O(nb) index update — no O(P) term anywhere on
+    # the feasible path ("sub-O(P) window formation"). The packed step
+    # reports the slots it actually touched (row 3), which bench surfaces
+    # as ``formation_touched_frac``.
+
+    def init_index_arrays(self) -> "dict[str, Any]":
+        """Fresh (empty-pool) bucket-index columns, host numpy — merged
+        into the device pool dict next to POOL_FIELDS by the engine."""
+        import numpy as np
+
+        nb = self.n_blocks
+        return {
+            "bidx_count": np.zeros(nb, np.int32),
+            "bidx_min": np.full(nb, np.inf, np.float32),
+            "bidx_max": np.full(nb, -np.inf, np.float32),
+            "bidx_rd": np.zeros(nb, np.float32),
+        }
+
+    def _index_rebuild(self, pool: dict[str, Any]) -> dict[str, Any]:
+        """Exact index from the live pool columns: one O(P) scan (the
+        _live_stats pass + an occupancy count). Off the hot path — engine
+        heartbeat and restore call it to re-tighten the widen-only bounds."""
+        core = {k: v for k, v in pool.items() if k not in INDEX_FIELDS}
+        blk = self.pool_block
+
+        def body(_, blk_i):
+            start = blk_i * blk
+            act = lax.dynamic_slice_in_dim(core["active"], start, blk)
+            return None, act.sum(dtype=jnp.int32)
+
+        _, counts = lax.scan(body, None,
+                             jnp.arange(self.n_blocks, dtype=jnp.int32))
+        minr, maxr, maxrd = self._live_stats(core)
+        return {**core, "bidx_count": counts, "bidx_min": minr,
+                "bidx_max": maxr, "bidx_rd": maxrd}
+
+    def _incoming_block_counts(self, batch: dict[str, Any]) -> jnp.ndarray:
+        """i32[n_blocks]: valid window lanes landing in each block (slot
+        sentinel ⇒ no block). Tiny dense one-hot sum, no scatters."""
+        nb = self.n_blocks
+        blk_of = batch["slot"] // self.pool_block
+        hit = (blk_of[None, :] == jnp.arange(nb, dtype=jnp.int32)[:, None]
+               ) & batch["valid"][None, :]
+        return hit.sum(axis=1, dtype=jnp.int32)
+
+    def _matched_block_counts(self, matched: jnp.ndarray) -> jnp.ndarray:
+        """i32[n_blocks]: matched slots (< capacity) leaving each block."""
+        nb = self.n_blocks
+        blk_of = matched // self.pool_block
+        hit = (blk_of[None, :] == jnp.arange(nb, dtype=jnp.int32)[:, None]
+               ) & (matched < self.capacity)[None, :]
+        return hit.sum(axis=1, dtype=jnp.int32)
+
+    def _admit_indexed(self, pool: dict[str, Any],
+                       batch: dict[str, Any]) -> dict[str, Any]:
+        """Standalone admit (restore path) that keeps the index current:
+        counts += per-block hits, bounds widen by the window's stats."""
+        idx = {k: pool[k] for k in INDEX_FIELDS}
+        core = self._admit({k: v for k, v in pool.items()
+                            if k not in INDEX_FIELDS}, batch)
+        imin, imax, ird = self._incoming_stats(batch)
+        return {
+            **core,
+            "bidx_count": idx["bidx_count"]
+            + self._incoming_block_counts(batch),
+            "bidx_min": jnp.minimum(idx["bidx_min"], imin),
+            "bidx_max": jnp.maximum(idx["bidx_max"], imax),
+            "bidx_rd": jnp.maximum(idx["bidx_rd"], ird),
+        }
+
+    def _evict_indexed(self, pool: dict[str, Any],
+                       slots: jnp.ndarray) -> dict[str, Any]:
+        """Standalone evict (remove/expire path), index-aware: counts drop
+        by the slots that were ACTIVE at call time (idempotent — a second
+        evict of the same slot finds it inactive and counts nothing)."""
+        was_act = jnp.take(pool["active"],
+                           jnp.clip(slots, 0, self.capacity - 1))
+        live = jnp.where(was_act & (slots < self.capacity), slots,
+                         self.capacity)
+        core = self._evict({k: v for k, v in pool.items()
+                            if k not in INDEX_FIELDS}, slots)
+        return {
+            **core,
+            "bidx_count": pool["bidx_count"]
+            - self._matched_block_counts(live),
+            "bidx_min": pool["bidx_min"],
+            "bidx_max": pool["bidx_max"],
+            "bidx_rd": pool["bidx_rd"],
+        }
+
+    def _evict_spans(self, core: dict[str, Any], dstart, n_chunks: int,
+                     matched: jnp.ndarray) -> dict[str, Any]:
+        """Span-local eviction: clear ``matched`` only within the chunks'
+        W-block spans — every matched slot provably lies in one (a window
+        player's own block is inside its chunk's span by the admission
+        argument; a matched candidate came from its chunk's span). Spans
+        may overlap; clearing is monotone, so the sequential carry makes
+        repeats harmless."""
+        blk, w = self.pool_block, self.prune_window_blocks
+
+        def body(pool, j):
+            ds = dstart[j] * blk
+            a = lax.dynamic_slice_in_dim(pool["active"], ds, w * blk)
+            a = _mask_members(a, ds, w * blk, matched)
+            return dict(pool, active=lax.dynamic_update_slice_in_dim(
+                pool["active"], a, ds, axis=0)), None
+
+        core, _ = lax.scan(body, core,
+                           jnp.arange(n_chunks, dtype=jnp.int32))
+        return core
+
+    def _search_step_bucketed(self, pool: dict[str, Any],
+                              batch: dict[str, Any], now,
+                              skip_filters: bool = False):
+        """Index-driven window step: bit-exact vs flat (see the section
+        comment), plus a 5th return — the pool slots formation touched."""
+        b = batch["rating"].shape[0]
+        n_chunks = b // self._chunk_size(b)
+        idx = {k: pool[k] for k in INDEX_FIELDS}
+        core = {k: v for k, v in pool.items() if k not in INDEX_FIELDS}
+        q_thr_eff = _effective_threshold(
+            batch["threshold"], batch["enqueue_t"], now,
+            self.widen_per_sec, self.max_threshold,
+        )
+        sb, qte, oi = self._sort_batch(batch, q_thr_eff)
+        imin, imax, ird = self._incoming_stats(sb)
+        bmin = jnp.minimum(idx["bidx_min"], imin)
+        bmax = jnp.maximum(idx["bidx_max"], imax)
+        brd = jnp.maximum(idx["bidx_rd"], ird)
+        dstart, feasible, _ = self._chunk_windows(sb, qte, bmin, bmax, brd)
+
+        def pruned_path():
+            p = self._admit_chunked(core, sb, dstart)
+            v, i = self._candidates_pruned(sb, qte, p, now, dstart,
+                                           skip_filters)
+            return p, v, i
+
+        def dense_path():
+            return self._candidates_admitting(core, sb, qte, now,
+                                              skip_filters)
+
+        core, vals, idxs = lax.cond(feasible, pruned_path, dense_path)
+        touched = self._touched_slots(feasible)
+        s_q, s_c, s_d = greedy_pair(vals, idxs, sb["slot"], self.capacity,
+                                    self.pair_rounds, rid=oi)
+        out_q, out_c, out_d = self._unsort_matches(oi, s_q, s_c, s_d)
+
+        matched = jnp.concatenate([s_q, s_c])
+        core = lax.cond(
+            feasible,
+            lambda: self._evict_spans(core, dstart, n_chunks, matched),
+            lambda: self._evict(core, matched))
+        pool = {
+            **core,
+            "bidx_count": idx["bidx_count"]
+            + self._incoming_block_counts(sb)
+            - self._matched_block_counts(matched),
+            "bidx_min": bmin, "bidx_max": bmax, "bidx_rd": brd,
+        }
+        return pool, out_q, out_c, out_d, touched
+
+    def _touched_slots(self, feasible) -> jnp.ndarray:
+        """Pool slots EACH WINDOW LANE's formation scored (f32 scalar,
+        f32-exact: counts ≪ 2^24): W·blk on the feasible path — every lane
+        scores only its chunk's span — vs the whole pool on the dense
+        fallback, where every lane scores all P slots. The bench's
+        ``formation_touched_frac`` is this over capacity: the per-lane
+        candidate-restriction win (the union of spans across a
+        rating-diverse window legitimately covers most buckets — every
+        bucket is a candidate for SOMEONE — so per-lane, not union, is
+        the number that shows sub-O(P) formation; the sharded frontier
+        path reports its nb·K analog through the same row)."""
+        per_lane = min(self.prune_window_blocks * self.pool_block,
+                       self.capacity)
+        return jnp.where(feasible, jnp.float32(per_lane),
+                         jnp.float32(self.capacity))
+
+    def _rescan_step_bucketed(self, pool: dict[str, Any],
+                              batch: dict[str, Any], now):
+        """No-admission bucketed rescan: validity is gated by the
+        device-side active flag (same overlap-safety contract as
+        _rescan_step), spans come from the index alone (no incoming —
+        every lane is already pool-resident, so index bounds cover it),
+        and only matched counts leave the index."""
+        b = batch["rating"].shape[0]
+        n_chunks = b // self._chunk_size(b)
+        idx = {k: pool[k] for k in INDEX_FIELDS}
+        core = {k: v for k, v in pool.items() if k not in INDEX_FIELDS}
+        lane_act = jnp.take(core["active"],
+                            jnp.clip(batch["slot"], 0, self.capacity - 1))
+        batch = dict(batch, valid=batch["valid"] & lane_act)
+        q_thr_eff = _effective_threshold(
+            batch["threshold"], batch["enqueue_t"], now,
+            self.widen_per_sec, self.max_threshold,
+        )
+        sb, qte, oi = self._sort_batch(batch, q_thr_eff)
+        dstart, feasible, _ = self._chunk_windows(
+            sb, qte, idx["bidx_min"], idx["bidx_max"], idx["bidx_rd"])
+        touched = self._touched_slots(feasible)
+
+        core, vals, idxs = lax.cond(
+            feasible,
+            lambda: (core,) + self._candidates_pruned(sb, qte, core, now,
+                                                      dstart),
+            lambda: (core,) + self._candidates(sb, qte, core, now))
+        s_q, s_c, s_d = greedy_pair(vals, idxs, sb["slot"], self.capacity,
+                                    self.pair_rounds, rid=oi)
+        out_q, out_c, out_d = self._unsort_matches(oi, s_q, s_c, s_d)
+
+        matched = jnp.concatenate([s_q, s_c])
+        core = lax.cond(
+            feasible,
+            lambda: self._evict_spans(core, dstart, n_chunks, matched),
+            lambda: self._evict(core, matched))
+        pool = {
+            **core,
+            "bidx_count": idx["bidx_count"]
+            - self._matched_block_counts(matched),
+            "bidx_min": idx["bidx_min"], "bidx_max": idx["bidx_max"],
+            "bidx_rd": idx["bidx_rd"],
+        }
+        return pool, out_q, out_c, out_d, touched
+
+    def _pack_bucketed_out(self, out_q, out_c, out_d, touched):
+        """(q, c, dist) + the touched-slots scalar → f32[4, B]: rows 0-2
+        are the flat packed layout byte for byte; row 3 broadcasts the
+        per-window touched count (read at [3, 0] on host)."""
+        b = out_q.shape[0]
+        return jnp.concatenate([
+            jnp.stack([out_q.astype(jnp.float32),
+                       out_c.astype(jnp.float32), out_d]),
+            jnp.broadcast_to(touched, (1, b))])
+
+    def _search_step_packed_bucketed(self, pool, packed,
+                                     skip_filters: bool = False):
+        batch = unpack_batch(packed)
+        now = packed[8, 0]
+        pool, q, c, d, touched = self._search_step_bucketed(
+            pool, batch, now, skip_filters)
+        return pool, self._pack_bucketed_out(q, c, d, touched)
+
+    def _rescan_step_packed_bucketed(self, pool, packed):
+        batch = unpack_batch(packed)
+        now = packed[8, 0]
+        pool, q, c, d, touched = self._rescan_step_bucketed(pool, batch, now)
+        return pool, self._pack_bucketed_out(q, c, d, touched)
 
 
 class QualityAccumKernel:
@@ -944,11 +1286,11 @@ class QualityAccumKernel:
 def kernel_set(capacity: int, top_k: int, pool_block: int, glicko2: bool,
                widen_per_sec: float, max_threshold: float,
                pair_rounds: int = 8, prune_window_blocks: int = 0,
-               prune_chunk: int = 128) -> KernelSet:
+               prune_chunk: int = 128, bucketed: bool = False) -> KernelSet:
     """Cached KernelSet per static config (compile once per queue shape)."""
     return KernelSet(
         capacity=capacity, top_k=top_k, pool_block=pool_block, glicko2=glicko2,
         widen_per_sec=widen_per_sec, max_threshold=max_threshold,
         pair_rounds=pair_rounds, prune_window_blocks=prune_window_blocks,
-        prune_chunk=prune_chunk,
+        prune_chunk=prune_chunk, bucketed=bucketed,
     )

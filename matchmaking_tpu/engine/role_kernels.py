@@ -281,7 +281,7 @@ class ShardedRoleKernelSet:
                  role_slots: tuple[str, ...], widen_per_sec: float,
                  max_threshold: float, mesh, max_matches: int = 1024,
                  rounds: int = 16, evict_bucket: int = 64,
-                 frontier_k: int = 0):
+                 frontier_k: int = 0, frontier_merge: str = "linear"):
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from matchmaking_tpu.engine.sharded import AXIS, _shard_map
@@ -316,6 +316,13 @@ class ShardedRoleKernelSet:
         self.frontier_k = (min(max(frontier_k, self.need),
                                self.local_capacity)
                            if frontier_k > 0 else 0)
+        #: Frontier consumer merge: "linear" or "tournament" (see
+        #: teams.merge_frontiers — same gate, same exactness argument).
+        if frontier_merge not in ("linear", "tournament"):
+            raise ValueError(
+                f"unknown frontier_merge {frontier_merge!r} "
+                "(expected 'linear' or 'tournament')")
+        self.frontier_merge = frontier_merge
 
         pool_spec = {k: P(AXIS) for k in
                      ("rating", "rd", "region", "mode", "threshold",
@@ -327,8 +334,11 @@ class ShardedRoleKernelSet:
                        out_specs=(pool_spec, rep), check_vma=False),
             donate_argnums=0)
         if self.frontier_k:
+            form_rows = (self.frontier_k
+                         if frontier_merge == "tournament"
+                         else self.n_shards * self.frontier_k)
             self._ring_form = RoleKernelSet(
-                capacity=self.n_shards * self.frontier_k,
+                capacity=form_rows,
                 team_size=team_size, role_slots=role_slots,
                 widen_per_sec=widen_per_sec, max_threshold=max_threshold,
                 max_matches=self.max_matches, rounds=rounds)
@@ -398,9 +408,9 @@ class ShardedRoleKernelSet:
         bit-identical to ``_step_shard``."""
         from matchmaking_tpu.engine.sharded import ring_all_gather
         from matchmaking_tpu.engine.teams import (
+            merge_frontiers,
             pack_frontier,
             pad_match_columns,
-            unpack_frontier,
         )
 
         batch, now = RoleKernelSet._unpack(packed)
@@ -410,7 +420,8 @@ class ShardedRoleKernelSet:
         frontier = pack_frontier(pool, self._GATHER, self.frontier_k,
                                  self.local_capacity, self.capacity)
         (buf,) = ring_all_gather((frontier,), self.n_shards)
-        full, gslot = unpack_frontier(buf, self._GATHER)
+        full, gslot = merge_frontiers(buf, self._GATHER, self.n_shards,
+                                      self.frontier_merge)
         g = self._ring_form
         order, group = g._sorted_order(full)
         valid, spread, win_thr, split = g._windows_roles(full, order, group,
@@ -451,13 +462,14 @@ def sharded_role_kernel_set(capacity: int, team_size: int,
                             role_slots: tuple[str, ...],
                             widen_per_sec: float, max_threshold: float,
                             n_shards: int, max_matches: int = 1024,
-                            rounds: int = 16,
-                            frontier_k: int = 0) -> ShardedRoleKernelSet:
+                            rounds: int = 16, frontier_k: int = 0,
+                            frontier_merge: str = "linear",
+                            ) -> ShardedRoleKernelSet:
     from matchmaking_tpu.engine.sharded import pool_mesh
 
     return ShardedRoleKernelSet(
         capacity=capacity, team_size=team_size, role_slots=role_slots,
         widen_per_sec=widen_per_sec, max_threshold=max_threshold,
         mesh=pool_mesh(n_shards), max_matches=max_matches, rounds=rounds,
-        frontier_k=frontier_k,
+        frontier_k=frontier_k, frontier_merge=frontier_merge,
     )

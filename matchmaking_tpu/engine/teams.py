@@ -134,6 +134,46 @@ def unpack_frontier(buf, fields: tuple[str, ...]):
     return cols, flat[len(fields)].astype(jnp.int32)
 
 
+def merge_frontiers(buf, fields: tuple[str, ...], n_shards: int,
+                    merge: str):
+    """Ring-gathered frontier buffers → formation columns, by either
+    consumer merge (ISSUE 14 satellite — the PR 1 follow-up):
+
+    - ``"linear"``: today's path — concatenate all D·K rows in canonical
+      shard order (``unpack_frontier``); the formation instance then sorts
+      and forms windows over the O(K·D) buffer.
+    - ``"tournament"``: pairwise tournament-tree top-K merge of the D
+      already-sorted K-row frontiers (``sharded.tournament_merge_topk``) —
+      the formation buffer shrinks to K rows and the merge working set is
+      O(K·log D). Bit-exact vs linear under exactly the ring path's host
+      gate (global active population ≤ K — every active row then survives
+      every top-K truncation, in concat-sort order).
+
+    Returns ``(columns dict, gslot i32)`` with length D·K (linear) or K
+    (tournament)."""
+    if merge != "tournament" or n_shards <= 1:
+        return unpack_frontier(buf, fields)
+    from matchmaking_tpu.engine.sharded import tournament_merge_topk
+
+    ridx = fields.index("region")
+    midx = fields.index("mode")
+    aidx = fields.index("active")
+    slot_row = len(fields)
+
+    def key_fn(fb):
+        act = fb[aidx] > 0.5
+        group = jnp.where(
+            act,
+            fb[ridx].astype(jnp.int32) * jnp.int32(1 << 15)
+            + fb[midx].astype(jnp.int32),
+            _BIG_I32)
+        return group, fb[0], fb[slot_row].astype(jnp.int32)
+
+    merged = tournament_merge_topk([buf[i] for i in range(n_shards)],
+                                   key_fn)
+    return unpack_frontier(merged[None], fields)
+
+
 def shard_localize(batch, local_capacity: int):
     """Global batch slot ids → this shard's local frame (non-local ids map
     to the local sentinel). Must run inside shard_map."""
@@ -341,7 +381,8 @@ class ShardedTeamKernelSet:
     def __init__(self, *, capacity: int, team_size: int,
                  widen_per_sec: float, max_threshold: float, mesh,
                  max_matches: int = 1024, rounds: int = 16,
-                 evict_bucket: int = 64, frontier_k: int = 0):
+                 evict_bucket: int = 64, frontier_k: int = 0,
+                 frontier_merge: str = "linear"):
         import numpy as np
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -381,6 +422,15 @@ class ShardedTeamKernelSet:
         self.frontier_k = (min(max(frontier_k, self.need),
                                self.local_capacity)
                            if frontier_k > 0 else 0)
+        #: Consumer merge for the ring-gathered frontiers: "linear"
+        #: (concat all D·K rows) or "tournament" (tree top-K merge — the
+        #: formation buffer shrinks to K rows; bit-exact under the same
+        #: host gate). See ``merge_frontiers``.
+        if frontier_merge not in ("linear", "tournament"):
+            raise ValueError(
+                f"unknown frontier_merge {frontier_merge!r} "
+                "(expected 'linear' or 'tournament')")
+        self.frontier_merge = frontier_merge
 
         pool_spec = {k: P(AXIS) for k in
                      ("rating", "rd", "region", "mode", "threshold",
@@ -392,12 +442,16 @@ class ShardedTeamKernelSet:
                        out_specs=(pool_spec, rep), check_vma=False),
             donate_argnums=0)
         if self.frontier_k:
-            # Formation instance over the merged D·K-row frontier buffer;
-            # max_matches mirrors the fallback's so both steps share one
-            # output shape (disjoint windows over D·K rows can never
-            # exceed D·K // need, so the clamp loses no matches).
+            # Formation instance over the merged frontier buffer: D·K rows
+            # on the linear merge, K on the tournament merge; max_matches
+            # mirrors the fallback's so both steps share one output shape
+            # (disjoint windows over the buffer can never exceed
+            # rows // need, so the clamp loses no matches).
+            form_rows = (self.frontier_k
+                         if frontier_merge == "tournament"
+                         else self.n_shards * self.frontier_k)
             self._ring_form = TeamKernelSet(
-                capacity=self.n_shards * self.frontier_k,
+                capacity=form_rows,
                 team_size=team_size, widen_per_sec=widen_per_sec,
                 max_threshold=max_threshold, max_matches=self.max_matches,
                 rounds=rounds)
@@ -474,7 +528,8 @@ class ShardedTeamKernelSet:
         frontier = pack_frontier(pool, self._GATHER, self.frontier_k,
                                  self.local_capacity, self.capacity)
         (buf,) = ring_all_gather((frontier,), self.n_shards)
-        full, gslot = unpack_frontier(buf, self._GATHER)
+        full, gslot = merge_frontiers(buf, self._GATHER, self.n_shards,
+                                      self.frontier_merge)
         g = self._ring_form
         order, group = g._sorted_order(full)
         valid, spread, win_thr = g._windows(full, order, group, now)
@@ -557,12 +612,14 @@ def pad_match_columns(out, pad: int, need: int, capacity: int,
 def sharded_team_kernel_set(capacity: int, team_size: int,
                             widen_per_sec: float, max_threshold: float,
                             n_shards: int, max_matches: int = 1024,
-                            rounds: int = 16,
-                            frontier_k: int = 0) -> ShardedTeamKernelSet:
+                            rounds: int = 16, frontier_k: int = 0,
+                            frontier_merge: str = "linear",
+                            ) -> ShardedTeamKernelSet:
     from matchmaking_tpu.engine.sharded import pool_mesh
 
     return ShardedTeamKernelSet(
         capacity=capacity, team_size=team_size, widen_per_sec=widen_per_sec,
         max_threshold=max_threshold, mesh=pool_mesh(n_shards),
         max_matches=max_matches, rounds=rounds, frontier_k=frontier_k,
+        frontier_merge=frontier_merge,
     )

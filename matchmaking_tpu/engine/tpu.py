@@ -170,7 +170,7 @@ class _Pending:
 # under the GIL, no mirror mutation) the service uses off-lock: admission
 # occupancy, backpressure polling, /metrics scrapes.
 # externally-serialized-by: _engine_lock
-# lock-free: pool_size, inflight, pool_tier_counts, deadline_count, util_report, span_report, quality_report
+# lock-free: pool_size, inflight, pool_tier_counts, deadline_count, util_report, span_report, quality_report, formation_report
 class TpuEngine(Engine):
     def __init__(self, cfg: Config, queue: QueueConfig,
                  devices: "tuple[int, ...] | None" = None):
@@ -215,6 +215,7 @@ class TpuEngine(Engine):
                 max_matches=ec.team_max_matches,
                 rounds=ec.team_rounds,
                 frontier_k=ec.team_ring_k,
+                frontier_merge=ec.frontier_merge,
             )
         elif self._role_device:
             from matchmaking_tpu.engine.role_kernels import role_kernel_set
@@ -240,6 +241,7 @@ class TpuEngine(Engine):
                 max_matches=ec.team_max_matches,
                 rounds=ec.team_rounds,
                 frontier_k=ec.team_ring_k,
+                frontier_merge=ec.frontier_merge,
             )
         elif self._team_device:
             from matchmaking_tpu.engine.teams import team_kernel_set
@@ -268,6 +270,10 @@ class TpuEngine(Engine):
                 ring=ec.ring_merge,
                 pair_rounds=ec.pair_rounds,
                 device_ids=self.devices,
+                # bucketed alone implies frontier exchange at the default
+                # ladder ceiling; an explicit bucket_frontier_k wins.
+                bucket_frontier_k=(ec.bucket_frontier_k
+                                   or (128 if ec.bucketed else 0)),
             )
         else:
             self.kernels = kernel_set(
@@ -280,17 +286,70 @@ class TpuEngine(Engine):
                 pair_rounds=ec.pair_rounds,
                 prune_window_blocks=ec.prune_window_blocks,
                 prune_chunk=ec.prune_chunk,
+                bucketed=ec.bucketed,
             )
         self._dev_pool = self._fresh_device_pool()
         # Capacity may have been rounded up (sharding divisibility).
         # Rating-banded slot allocation (one band per pool block) keeps
         # block rating bounds tight for the pruned kernel; harmless (and
         # unused) for non-pruning paths, so it keys off band_spec alone.
-        edges = band_edges_from_spec(
-            ec.band_spec, getattr(self.kernels, "n_blocks", 0))
+        n_seg = getattr(self.kernels, "global_blocks",
+                        getattr(self.kernels, "n_blocks", 0))
+        band_blocks = getattr(self.kernels, "n_blocks", 0)
+        if not band_blocks and (ec.bucketed or ec.bucket_frontier_k):
+            # Sharded bucket frontier: one band per GLOBAL block keeps the
+            # mirror's buckets rating-coherent. Plain sharded queues keep
+            # the pre-ISSUE-14 behavior (band_spec inert — no silent
+            # allocator switch on upgrade).
+            band_blocks = getattr(self.kernels, "global_blocks", 0)
+        edges = band_edges_from_spec(ec.band_spec, band_blocks)
         self._band_edges = edges
+        #: Bucketed-formation host state (ISSUE 14): the mirror tracks
+        #: per-segment (= device block / rating bucket) occupancy whenever
+        #: a bucketed step family can consume it — the sharded frontier
+        #: gate and /debug/placement read it O(segments), never a scan.
+        self._formation_segments = (
+            n_seg if (getattr(self.kernels, "bucketed", False)
+                      or getattr(self.kernels, "bucket_frontier_k", 0))
+            else 0)
         self.pool = PlayerPool(self.kernels.capacity, queue.rating_threshold,
-                               band_edges=edges)
+                               band_edges=edges,
+                               segments=self._formation_segments)
+        #: Adaptive frontier-K ladder (ISSUE 14 satellite, PR 1 follow-up):
+        #: powers of two up to the configured ceiling; the per-window pick
+        #: is the smallest rung holding the observed peak per-bucket
+        #: occupancy, and every change lands in the bounded move ring
+        #: surfaced at /debug/placement.
+        bfk = getattr(self.kernels, "bucket_frontier_k", 0)
+        self._frontier_ladder: tuple[int, ...] = ()
+        if bfk:
+            rungs = [bfk]
+            k = bfk // 2
+            while k >= 8:
+                rungs.append(k)
+                k //= 2
+            self._frontier_ladder = tuple(sorted(set(rungs)))
+        self._frontier_k_active = 0
+        #: Bounded move audit, a plain LIST (not a deque): /debug/placement
+        #: copies it off the engine lock, and copying a list concurrently
+        #: with the engine thread's append is a single GIL-held C op,
+        #: where iterating a mutating deque raises.
+        self.frontier_moves: list[dict] = []
+        #: Formation-touch accounting (monotone; formation_report reads it
+        #: lock-free): slots the bucketed steps actually read vs the flat
+        #: O(P) equivalent, accumulated at finalize from result row 3.
+        self.formation = {"touched_slots": 0.0, "total_slots": 0.0,
+                          "windows": 0}
+        #: Whether the most recent _step_fn pick was a bucketed variant —
+        #: names the window's device mark (formation_bucketed vs
+        #: device_step) for the attribution taxonomy.
+        self._last_step_bucketed = False
+        #: Tells the service health timer this engine has idle
+        #: housekeeping beyond delegation (the bucketed index re-tighten)
+        #: — app._health_loop otherwise skips heartbeat() entirely for
+        #: non-delegated queues.
+        self.heartbeat_housekeeping = bool(
+            getattr(self.kernels, "bucketed", False))
         self.buckets = tuple(sorted(ec.batch_buckets))
         # Wall-clock rebase: device times are float32 (128 s spacing at epoch
         # magnitude), so all device-visible times are relative to the first
@@ -863,7 +922,9 @@ class TpuEngine(Engine):
             self._dev_pool, packed_dev
         )
         self.spans["jit_s"] += time.perf_counter() - _t
-        pending.marks.append(("device_step", time.time()))
+        pending.marks.append(("formation_bucketed"
+                              if self._last_step_bucketed
+                              else "device_step", time.time()))
         self._quality_accum_dispatch(out, now)
         self.util["lanes_valid"] += len(cols)
         self.util["lanes_padded"] += bucket
@@ -909,6 +970,56 @@ class TpuEngine(Engine):
             "effective_occupancy": round(
                 lanes_valid / max(1, lanes_padded), 6),
         }
+
+    # ---- hierarchical formation accounting (ISSUE 14) ---------------------
+
+    def _formation_observe(self, packed_out: np.ndarray) -> None:
+        """Fold one collected window's touched-slot row (bucketed result
+        row 3; absent on flat 3-row results) into the monotone counters."""
+        if packed_out.ndim < 2 or packed_out.shape[0] <= 3:
+            return
+        self.formation["touched_slots"] += float(packed_out[3, 0])
+        self.formation["total_slots"] += float(self.kernels.capacity)
+        self.formation["windows"] += 1
+
+    def formation_report(self) -> "dict | None":
+        """Hierarchical-formation state (ISSUE 14), served at
+        /debug/placement: mode, per-bucket occupancy (the mirror's
+        incremental segment counts), the touched-slot fraction over every
+        collected bucketed window, and — under sharding — the adaptive
+        frontier-K ladder, the currently chosen K, and the bounded move
+        ring. None when no bucketed step family is configured. Lock-free:
+        host ints/floats read under the GIL, like util_report()."""
+        bucketed = getattr(self.kernels, "bucketed", False)
+        if not bucketed and not self._frontier_ladder:
+            return None
+        total = self.formation["total_slots"]
+        rep: dict = {
+            "mode": "bucketed" if bucketed else "bucket_frontier",
+            "buckets": self._formation_segments,
+            "windows": self.formation["windows"],
+            "touched_slots": self.formation["touched_slots"],
+            "total_slots": total,
+            "formation_touched_frac": (
+                round(self.formation["touched_slots"] / total, 6)
+                if total else None),
+        }
+        seg = self.pool.segment_counts()
+        if seg is not None:
+            rep["bucket_occupancy"] = seg.tolist()
+            rep["peak_bucket_occupancy"] = self.pool.segment_max()
+        if self._frontier_ladder:
+            rep["frontier_ladder"] = list(self._frontier_ladder)
+            rep["frontier_k"] = self._frontier_k_active
+            rep["frontier_moves"] = list(self.frontier_moves)
+            rep["frontier_steps"] = self.counters.get(
+                "bucket_frontier_steps", 0)
+            rep["frontier_fallbacks"] = self.counters.get(
+                "bucket_frontier_fallback", 0)
+        band = self.pool.band_report()
+        if band is not None:
+            rep["bands"] = band
+        return rep
 
     # ---- match-quality & fairness accumulation (ISSUE 8) ------------------
 
@@ -1246,6 +1357,11 @@ class TpuEngine(Engine):
         for name, dt in getattr(self.kernels, "extra_pool_fields",
                                 {}).items():
             init[name] = np.zeros(self.kernels.capacity, dt)
+        if getattr(self.kernels, "bucketed", False):
+            # Bucketed 1v1 sets carry the device bucket index INSIDE the
+            # pool dict (kernels.INDEX_FIELDS) — empty-pool init here;
+            # every admit/step/evict maintains it incrementally.
+            init.update(self.kernels.init_index_arrays())
         place = getattr(self.kernels, "place_pool", None)
         if place is not None:
             return place(init)
@@ -1360,6 +1476,10 @@ class TpuEngine(Engine):
             fn = getattr(self.kernels, name, None)
             if fn is not None:
                 variants.append(fn)
+        # Adaptive frontier ladder (ISSUE 14): every rung the per-window
+        # pick can reach is a distinct executable.
+        for k in self._frontier_ladder:
+            variants.append(self.kernels.bucket_step(k))
         for bucket in self.buckets:
             batch = self.pool.batch_arrays([], [], bucket)
             packed = jnp.asarray(self._pack(batch, 0.0))
@@ -1403,12 +1523,27 @@ class TpuEngine(Engine):
         """Health-timer tick: the idle re-promotion path for a
         wildcard-delegated team/role queue (ADVICE round-5 #3 — with
         ``rescan_interval_s=0`` and no expiry sweep, nothing else notices
-        the wildcards draining under zero traffic)."""
+        the wildcards draining under zero traffic). Bucketed 1v1 engines
+        (``heartbeat_housekeeping``) additionally re-tighten the device
+        bucket index here (one O(P) jitted scan, async dispatch):
+        incremental bounds only WIDEN between rebuilds, so without this
+        tick a drifting rating distribution degrades every window to the
+        dense fallback with no recovery. Safe with windows in flight:
+        ``_dev_pool`` holds the newest post-dispatch handles — nothing
+        but the next step consumes them — so donating them to the
+        rebuild just chains it behind the in-flight steps on device."""
         if self._team_delegate is not None:
             return self._maybe_repromote_team(now)
+        if (self._dev_pool is not None
+                and getattr(self.kernels, "bucketed", False)):
+            self._dev_pool = self.kernels.index_rebuild(self._dev_pool)
         return False
 
     def _step_fn(self, batch):
+        self._last_step_bucketed = getattr(self.kernels, "bucketed", False)
+        return self._step_fn_pick(batch)
+
+    def _step_fn_pick(self, batch):
         """Pick the compiled step variant for this window: the all-ANY
         variant (region/mode mask math compiled out — bit-exact when no
         window lane carries a filter, see kernels._score_block) or the full
@@ -1432,6 +1567,30 @@ class TpuEngine(Engine):
                 return ring
             self.counters["team_ring_fallback"] = (
                 self.counters.get("team_ring_fallback", 0) + 1)
+        if self._frontier_ladder:
+            # Sharded per-bucket frontier (ISSUE 14): pick the smallest
+            # ladder K holding the observed peak per-bucket occupancy —
+            # the mirror's segment counts are a conservative superset of
+            # device-active (slots release only at finalize), which is
+            # exactly the no-overflow precondition for bit-exactness.
+            # Above the ceiling, fall back to the dense sharded step
+            # (counted, never silent).
+            occ = self.pool.segment_max()
+            k = next((r for r in self._frontier_ladder if r >= occ), None)
+            if k is not None:
+                if k != self._frontier_k_active:
+                    self.frontier_moves.append({
+                        "t": time.time(), "from": self._frontier_k_active,
+                        "to": k, "peak_bucket_occupancy": occ})
+                    if len(self.frontier_moves) > 64:
+                        del self.frontier_moves[:-64]
+                    self._frontier_k_active = k
+                self.counters["bucket_frontier_steps"] = (
+                    self.counters.get("bucket_frontier_steps", 0) + 1)
+                self._last_step_bucketed = True
+                return self.kernels.bucket_step(k)
+            self.counters["bucket_frontier_fallback"] = (
+                self.counters.get("bucket_frontier_fallback", 0) + 1)
         nf = getattr(self.kernels, "search_step_packed_nofilter", None)
         if nf is not None and not batch.region.any() and not batch.mode.any():
             return nf
@@ -1472,7 +1631,9 @@ class TpuEngine(Engine):
         self._dev_pool, out = self._step_fn(batch)(
             self._dev_pool, packed_dev
         )
-        pending.marks.append(("device_step", time.time()))
+        pending.marks.append(("formation_bucketed"
+                              if self._last_step_bucketed
+                              else "device_step", time.time()))
         self._quality_accum_dispatch(out, now)
         self.util["lanes_valid"] += len(window)
         self.util["lanes_padded"] += bucket
@@ -1545,6 +1706,7 @@ class TpuEngine(Engine):
             [] if self._quality is None else None)
         for (window, _, now), (packed_out,) in zip(
                 pending.chunks, pending.raw or ()):
+            self._formation_observe(packed_out)
             q_slot = packed_out[0].astype(np.int32)
             c_slot = packed_out[1].astype(np.int32)
             dist = packed_out[2]
@@ -1606,6 +1768,7 @@ class TpuEngine(Engine):
         for (payload, _, now), (packed_out,) in zip(
                 pending.chunks, pending.raw or ()):
             cols, slots = payload
+            self._formation_observe(packed_out)
             q_slot = packed_out[0].astype(np.int32)
             c_slot = packed_out[1].astype(np.int32)
             dist = packed_out[2]

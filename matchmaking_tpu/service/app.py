@@ -2453,10 +2453,13 @@ class _QueueRuntime:
             try:
                 changed = False
                 # Skip the lock + thread hop unless the tick can actually do
-                # something: heartbeat() only acts on a delegated queue, and
-                # a re-promotion does real device work (fresh pool build +
-                # restore) that must run off the event loop.
-                if getattr(self.engine, "_team_delegate", None) is not None:
+                # something: heartbeat() acts on a delegated queue (idle
+                # re-promotion — real device work that must run off the
+                # event loop) or an engine declaring idle housekeeping
+                # (ISSUE 14: the bucketed index re-tighten).
+                if (getattr(self.engine, "_team_delegate", None) is not None
+                        or getattr(self.engine, "heartbeat_housekeeping",
+                                   False)):
                     async with self._engine_lock:
                         changed = await asyncio.to_thread(
                             self.engine.heartbeat, now)

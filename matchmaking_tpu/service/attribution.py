@@ -89,6 +89,11 @@ _BY_TARGET: dict[str, tuple[str, str]] = {
     "dispatch": ("pipeline_slot_wait", WAIT),
     "h2d": ("pack_h2d", WORK),
     "device_step": ("device_step", WORK),
+    # Hierarchical bucketed formation (ISSUE 14): the engine names the
+    # device-step mark after the step family actually dispatched, so the
+    # sub-O(P) formation work is attributable separately from flat
+    # device_step windows (bench gates its share direction-aware).
+    "formation_bucketed": ("formation_bucketed", WORK),
     "oracle_step": ("oracle_step", WORK),
     "readback_seal": ("readback_group_wait", WAIT),
     "collect": ("readback_transfer", WAIT),

@@ -483,7 +483,20 @@ class ObservabilityServer:
         stats, and the cross-queue dispatch arbiter's engagement state.
         ``?n=`` caps the decision history (default: the full ring)."""
         ctrl = getattr(self.app, "placement", None)
+        # Hierarchical-formation state (ISSUE 14): per-queue bucket
+        # occupancy, the adaptive frontier-K choice + move ring, and the
+        # touched-slot fraction — placement-adjacent capacity data, so it
+        # rides this surface whether or not the controller is enabled.
+        formation = {
+            name: rep
+            for name, rt in self.app._runtimes.items()
+            if (rep := (rt.engine.formation_report()
+                        if hasattr(rt.engine, "formation_report")
+                        else None)) is not None
+        }
         if ctrl is None:
+            if formation:
+                return web.json_response({"formation": formation})
             return web.json_response(
                 {"error": "placement control plane disabled "
                           "(set placement.interval_s)"}, status=404)
@@ -491,7 +504,10 @@ class ObservabilityServer:
             history = max(0, int(request.query.get("n", "0")))
         except ValueError:
             history = 0
-        return web.json_response(ctrl.snapshot(history=history))
+        body = ctrl.snapshot(history=history)
+        if formation:
+            body["formation"] = formation
+        return web.json_response(body)
 
     async def _debug_autotune(self, request) -> "web.Response":
         """Online autotuner (ISSUE 13): the steering target, declared safe

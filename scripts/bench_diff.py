@@ -31,7 +31,10 @@ Frontier rows (``e2e_frontier``, ISSUE 8) are matched by threshold.
 Scenario-matrix cells (``scenario_matrix``, ISSUE 13) are matched by
 scenario name — slo_attainment / quality up, admitted_p99_ms / expired
 down — and cells carrying an ``abort_reason`` are skipped on either side,
-exactly like aborted rounds.
+exactly like aborted rounds. Pool-scale rows (``pool_scale``, ISSUE 14)
+are matched by synthetic pool size — matches_per_sec up, p99_ms and
+``formation_touched_frac`` down (the sub-O(P) formation headline; a
+rising fraction means formation is sliding back toward the flat scan).
 """
 
 from __future__ import annotations
@@ -63,6 +66,20 @@ TOP_LEVEL_METRICS: dict[str, bool] = {
     "placement_blackout_ms_mean": False,
     "placement_lost": False,
     "placement_dup": False,
+    # Hierarchical bucketed formation (ISSUE 14): the fraction of the
+    # pool each window lane's formation scored — the sub-O(P) headline.
+    # Direction-aware DOWN: a rising fraction means formation is sliding
+    # back toward the flat O(P) scan (spans too narrow for the live
+    # distribution → dense fallbacks).
+    "formation_touched_frac": False,
+}
+
+#: Pool-scale sweep rows (ISSUE 14, ``bench.py --pool-scale``), matched
+#: by synthetic pool size.
+POOL_SCALE_METRICS: dict[str, bool] = {
+    "matches_per_sec": True,
+    "p99_ms": False,
+    "formation_touched_frac": False,
 }
 
 FRONTIER_METRICS: dict[str, bool] = {
@@ -202,6 +219,21 @@ def diff(baseline: dict, fresh: dict,
         for name, higher in FRONTIER_METRICS.items():
             row = _compare_one(
                 f"e2e_frontier[thr={fr.get('threshold'):g}].{name}",
+                br.get(name), fr.get(name), higher, threshold)
+            if row is not None:
+                rows.append(row)
+    # Pool-scale rows matched by synthetic pool size (ISSUE 14).
+    base_scale = {r.get("pool"): r for r in baseline.get("pool_scale", [])
+                  if isinstance(r, dict)}
+    for fr in fresh.get("pool_scale", []):
+        if not isinstance(fr, dict):
+            continue
+        br = base_scale.get(fr.get("pool"))
+        if br is None:
+            continue
+        for name, higher in POOL_SCALE_METRICS.items():
+            row = _compare_one(
+                f"pool_scale[{fr.get('pool')}].{name}",
                 br.get(name), fr.get(name), higher, threshold)
             if row is not None:
                 rows.append(row)

@@ -78,6 +78,17 @@ echo "== quality =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'quality and not slow' \
     --continue-on-collection-errors -p no:cacheprovider
 
+echo "== bucketed formation =="
+# ISSUE 14 gate: hierarchical rating-bucketed formation. The equivalence
+# suite runs by marker: bucketed↔flat bit-exactness at the kernel seam
+# (traffic + rescan, banded/unbanded/hot-bucket/widening), the sharded
+# per-bucket frontier vs the single-device dense kernels at D=2/4, the
+# tournament-tree frontier merge vs the linear merge at D=2/4/8, the
+# adaptive frontier-K ladder + audit ring, and the quality observatory's
+# disparity-no-regression check under hierarchical formation.
+JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'bucketed and not slow' \
+    --continue-on-collection-errors -p no:cacheprovider
+
 echo "== scenario observatory =="
 # ISSUE 13 gate: population-model scenario determinism (bit-identical
 # arrival transcripts, steady ≡ legacy loadgen byte for byte), the

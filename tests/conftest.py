@@ -82,6 +82,12 @@ def pytest_configure(config):
         "vs the Python contract module over a seeded corpus — "
         "scripts/check.sh runs it by marker after rebuilding "
         "libmmcodec.so from source; part of tier-1)")
+    config.addinivalue_line(
+        "markers", "bucketed: hierarchical rating-bucketed formation "
+        "suite (ISSUE 14: bucketed↔flat bit-exactness at D=1/2/4, "
+        "occupancy skew, widening boundary, tournament-vs-linear frontier "
+        "merge, adaptive frontier-K — scripts/check.sh runs it by marker; "
+        "part of tier-1)")
 
 
 @pytest.fixture

@@ -774,3 +774,38 @@ def test_quality_checkpoint_restore_units():
     assert e1.quality_report()["samples"] == 2
     e2.quality_restore(None)  # tolerated no-op
     assert e2.quality_report()["samples"] == 3
+
+
+@pytest.mark.bucketed
+def test_disparity_no_regression_under_hierarchical_formation():
+    """ISSUE 14 fairness gate: hierarchical (bucketed) formation must not
+    move the per-rating-bucket quality/wait accounting — the bucketed
+    engine's matches are bit-exact vs flat, so its quality report
+    (conditional means, disparity gaps, per-bucket counts) must be
+    IDENTICAL, not merely within an envelope."""
+    from matchmaking_tpu.service.contract import SearchRequest
+
+    def run(bucketed: bool) -> dict:
+        ec = EngineConfig(backend="tpu", pool_capacity=4096, pool_block=256,
+                          batch_buckets=(16, 64, 256),
+                          band_spec="gaussian:1500:300",
+                          bucketed=bucketed,
+                          prune_window_blocks=8 if bucketed else 0)
+        cfg = Config(engine=ec,
+                     queues=(QueueConfig(rating_threshold=100.0,
+                                         widen_per_sec=2.0,
+                                         max_threshold=200.0),))
+        engine = make_engine(cfg, cfg.queues[0])
+        local = np.random.default_rng(21)
+        for w in range(5):
+            reqs = [SearchRequest(id=f"w{w}_{i}",
+                                  rating=float(local.normal(1500, 300)),
+                                  enqueued_at=100.0 + w)
+                    for i in range(150)]
+            engine.search(reqs, now=100.0 + w)
+        return engine.quality_report()
+
+    flat, hier = run(False), run(True)
+    assert hier["samples"] == flat["samples"] > 100
+    assert hier["disparity"] == flat["disparity"]
+    assert hier["buckets"] == flat["buckets"]
