@@ -1504,6 +1504,329 @@ def bench_placement_soak(args) -> dict:
     return asyncio.run(run())
 
 
+def bench_crash_soak(args) -> dict:
+    """Crash-restart soak (ISSUE 15, ``--crash-soak``): seeded load through
+    N kill/recover cycles — each cycle boots a fresh app on the SAME
+    journal directory, recovers the predecessor's hard-crash state,
+    absorbs an at-least-once redelivery storm of every previous request,
+    runs fresh deterministic load (designed pairs that match + singles
+    that wait), and hard-crashes (``MatchmakingApp.crash()``: no drain, no
+    clean marker, uncommitted buffers dropped). One cycle is a
+    DEVICE-LOST cycle: a scripted ``ChaosConfig.device_lost`` fault mid-
+    load demotes the D=2 sharded queue to its surviving device (measured
+    blackout in the failover audit) before that cycle's crash.
+
+    Emits ``crash_lost`` (waiting players missing after recovery),
+    ``crash_dup`` (players seeing two distinct matches across the whole
+    soak), ``crash_rto_ms_max/mean``, journal write amplification, the
+    steady-state journal append overhead (fsync=window vs durability off
+    at the same offered load), and — run twice — whether the recovery
+    transcripts are bit-identical across runs. scripts/bench_diff.py
+    gates the crash_* metrics direction-aware (lower is better)."""
+    import asyncio
+    import shutil
+    import tempfile
+
+    from matchmaking_tpu.config import (
+        BatcherConfig,
+        ChaosConfig,
+        Config,
+        DurabilityConfig,
+        EngineConfig,
+        QueueConfig,
+    )
+    from matchmaking_tpu.service.app import MatchmakingApp
+    from matchmaking_tpu.service.broker import Properties
+
+    q = "crash.soak"
+    pairs = int(args.crash_pairs)
+    singles = int(args.crash_singles)
+    n_cycles = max(1, int(args.crash_cycles))
+    dl_cycle = n_cycles - 1  # the last cycle loses a device mid-load
+
+    def cfg_for(cycle: int | None, durable: bool = True,
+                overhead: bool = False) -> Config:
+        chaos = (ChaosConfig(seed=int(args.crash_seed), queues=(q,),
+                             device_lost_steps=(1,))
+                 if cycle == dl_cycle and cycle is not None
+                 else ChaosConfig())
+        return Config(
+            queues=(QueueConfig(name=q, rating_threshold=50.0,
+                                dedup_ttl_s=3600.0,
+                                send_queued_ack=False),),
+            engine=EngineConfig(backend="tpu", pool_capacity=4096,
+                                pool_block=512, batch_buckets=(16, 64),
+                                top_k=8, mesh_pool_axis=2,
+                                # Pre-compile every bucket at app start:
+                                # first-of-a-shape XLA compiles otherwise
+                                # land inside whichever phase runs first
+                                # (once mismeasured as ~95% "journal
+                                # overhead") and inside the recovery span
+                                # (the RTO must measure replay, not
+                                # compilation).
+                                warm_start=True),
+            batcher=BatcherConfig(max_batch=64, max_wait_ms=2.0),
+            # The soak cycles compact aggressively ON PURPOSE (snapshot
+            # rotation is part of what each cycle must survive); the
+            # OVERHEAD phase keeps the production budget so it measures
+            # the steady-state append cost, not compaction churn.
+            durability=(DurabilityConfig(
+                journal_dir=args._crash_jdir, fsync="window",
+                compact_records=(DurabilityConfig.compact_records
+                                 if overhead
+                                 else int(args.crash_compact_records)),
+                compact_interval_s=0.1) if durable
+                else DurabilityConfig()),
+            chaos=chaos,
+        )
+
+    def cycle_load(cycle: int) -> "list[tuple[str, float]]":
+        """Deterministic designed load: pairs at adjacent ratings (they
+        MUST match, whatever the window composition) + far-apart singles
+        (they can never match anything, this cycle or later) — the
+        matched/waiting SETS are pure functions of the script, which is
+        what makes the recovery transcript two-run bit-identical."""
+        rows: list[tuple[str, float]] = []
+        for i in range(pairs):
+            base = 1000.0 + i * 200.0
+            rows.append((f"c{cycle}p{2 * i}", base))
+            rows.append((f"c{cycle}p{2 * i + 1}", base + 1.0))
+        for i in range(singles):
+            rows.append((f"c{cycle}s{i}", 50_000.0 + cycle * 10_000.0
+                         + i * 1_000.0))
+        # The contract rejects |rating| >= 1e5 at the middleware — a
+        # single pushed past it would be silently dropped instead of
+        # waiting, corrupting the lost/dup accounting. Refuse loudly.
+        worst = max(r for _, r in rows)
+        if worst >= 1e5:
+            raise ValueError(
+                f"--crash-cycles/--crash-singles too large: cycle {cycle} "
+                f"would publish rating {worst} >= the contract bound 1e5 "
+                f"(singles climb 10k per cycle from 50k)")
+        # Seeded publish-order shuffle: the soak is order-insensitive by
+        # design, and the shuffle proves it stays that way.
+        rng = np.random.default_rng(int(args.crash_seed) + cycle)
+        rng.shuffle(rows)
+        return rows
+
+    async def quiesce(app, rt, matched_at_least: int) -> bool:
+        # 5 ms poll: the overhead phase's measured span ends here, and a
+        # coarser tick would quantize the rate it feeds (the soak cycles
+        # share the helper and are insensitive to it).
+        from matchmaking_tpu.testing.drain import fully_drained
+        for _ in range(6000):
+            await asyncio.sleep(0.005)
+            if fully_drained(app, rt, q, matched_at_least):
+                return True
+        return False
+
+    async def one_run(run_idx: int) -> dict:
+        jdir = tempfile.mkdtemp(prefix=f"mm_crash_soak_r{run_idx}_")
+        args._crash_jdir = jdir
+        lost = 0
+        rtos: list[float] = []
+        transcripts: list[dict] = []
+        match_of: dict[str, set[str]] = {}
+        pre_waiting: set[str] = set()
+        prev_rows: list[tuple[str, float]] = []
+        write_amp = None
+        failovers = 0
+        failover_blackout_ms = None
+        try:
+            for cycle in range(n_cycles):
+                app = MatchmakingApp(cfg_for(cycle))
+                await app.start()
+                rt = app.runtime(q)
+                # Recovery accounting vs the pre-crash truth.
+                recovered = {r.id for r in rt.engine.waiting()}
+                lost += len(pre_waiting - recovered)
+                if cycle > 0:
+                    rto = app.metrics.gauges.get(f"crash_rto_ms[{q}]")
+                    if rto is not None:
+                        rtos.append(float(rto))
+                    if rt.last_recovery is not None:
+                        transcripts.append(
+                            rt.last_recovery["transcript"])
+                reply_q = f"crash.replies.{cycle}"
+                app.broker.declare_queue(reply_q)
+
+                async def on_reply(delivery) -> None:
+                    d = json.loads(delivery.body)
+                    if d.get("status") == "matched":
+                        pid = str(d.get("player_id", ""))
+                        mid = (d.get("match") or {}).get("match_id")
+                        if pid and mid:
+                            match_of.setdefault(pid, set()).add(mid)
+
+                app.broker.basic_consume(reply_q, on_reply,
+                                         prefetch=1_000_000)
+                # At-least-once redelivery storm: EVERY previous-cycle
+                # request again. Matched players must replay their cached
+                # match (same id → no dup); waiting singles re-enter as
+                # duplicate-enqueue no-ops.
+                for pid, rating in prev_rows:
+                    app.broker.publish(
+                        q, f'{{"id":"{pid}","rating":{rating}}}'.encode(),
+                        Properties(reply_to=reply_q, correlation_id=pid))
+                # Fresh seeded load, paced so the batcher cuts several
+                # windows (the device-lost cycle needs step index 1 to
+                # exist mid-load, not after it).
+                rows = cycle_load(cycle)
+                gap = 1.0 / max(1.0, float(args.crash_rate))
+                for k, (pid, rating) in enumerate(rows):
+                    app.broker.publish(
+                        q, f'{{"id":"{pid}","rating":{rating}}}'.encode(),
+                        Properties(reply_to=reply_q, correlation_id=pid))
+                    if k % 4 == 3:
+                        await asyncio.sleep(gap * 4)
+                ok = await quiesce(app, rt, matched_at_least=2 * pairs)
+                if not ok:
+                    log(f"[crash-soak r{run_idx} c{cycle}] WARNING: "
+                        f"quiesce timed out")
+                if cycle == dl_cycle:
+                    failovers += int(
+                        app.metrics.counters.get("device_failovers"))
+                    if rt.failover_log:
+                        failover_blackout_ms = (
+                            rt.failover_log[-1]["blackout_ms"])
+                if rt.journal is not None and rt.journal.payload_bytes:
+                    write_amp = round(rt.journal.bytes_written
+                                      / rt.journal.payload_bytes, 3)
+                pre_waiting = {r.id for r in rt.engine.waiting()}
+                prev_rows = rows
+                log(f"[crash-soak r{run_idx} c{cycle}] matched="
+                    f"{app.metrics.counters.get('players_matched')} "
+                    f"waiting={len(pre_waiting)} "
+                    f"replays="
+                    f"{app.metrics.counters.get('deduped_replays')}")
+                await app.crash()
+            # Final recovery check: one more boot proves the LAST crash
+            # recovers too, then stops cleanly.
+            app = MatchmakingApp(cfg_for(None))
+            await app.start()
+            rt = app.runtime(q)
+            recovered = {r.id for r in rt.engine.waiting()}
+            lost += len(pre_waiting - recovered)
+            rto = app.metrics.gauges.get(f"crash_rto_ms[{q}]")
+            if rto is not None:
+                rtos.append(float(rto))
+            if rt.last_recovery is not None:
+                transcripts.append(rt.last_recovery["transcript"])
+            await app.stop()
+        finally:
+            if not args.crash_keep_dirs:
+                shutil.rmtree(jdir, ignore_errors=True)
+        dup = sum(1 for ids in match_of.values() if len(ids) > 1)
+        return {
+            "lost": lost,
+            "dup": dup,
+            "rtos": rtos,
+            "transcripts": transcripts,
+            "matched_players": len(match_of),
+            "write_amplification": write_amp,
+            "failovers": failovers,
+            "failover_blackout_ms": failover_blackout_ms,
+        }
+
+    async def rate_phase(durable: bool) -> "tuple[float, bool]":
+        """Steady-state append-overhead measurement: the same designed
+        paired load through a durability-on (fsync=window) vs -off app;
+        the ratio of matched-players rates is the overhead. Returns
+        ``(rate, drained)`` — a quiesce that times out folds up to 30 s
+        of idle polling into the measured span, so the caller must treat
+        the rate (and the overhead fraction built from it) as garbage
+        rather than gate on it."""
+        n = int(args.crash_overhead_pairs)
+        args._crash_jdir = tempfile.mkdtemp(prefix="mm_crash_ovh_")
+        try:
+            app = MatchmakingApp(cfg_for(None, durable=durable,
+                                         overhead=True))
+            await app.start()
+            rt = app.runtime(q)
+            # Warm EVERY batch bucket outside the measured span: the
+            # first cut of each window SHAPE pays its XLA compile, and
+            # the compile cache is process-wide — the 2-player warmup
+            # alone left the 64-bucket compile inside whichever phase ran
+            # FIRST, which once mismeasured as ~95% "journal overhead".
+            # A full-burst publish of max_batch pairs cuts one max-size
+            # window and the remainder buckets; pairs at far-apart bases
+            # all match and leave the pool before t0.
+            # Base 80k: far from the measured load (≤ ~4.6k) but INSIDE
+            # the contract's rating bound (|r| < 1e5 — a 100k base was
+            # silently rejected_by_middleware wholesale, which unwarmed
+            # the phase and left the compiles in the measured span).
+            warm_pairs = 64
+            for i in range(warm_pairs):
+                base = 80_000.0 + i * 200.0
+                for jj, r in enumerate((base, base + 1.0)):
+                    app.broker.publish(
+                        q,
+                        f'{{"id":"w{2 * i + jj}","rating":{r}}}'.encode(),
+                        Properties(reply_to="", correlation_id=""))
+            warm_ok = await quiesce(app, rt, matched_at_least=2 * warm_pairs)
+            matched0 = app.metrics.counters.get("players_matched")
+            t0 = time.perf_counter()
+            # Burst-published: the broker drains full bursts, so windows
+            # fill to max_batch and the journal pays its one buffered
+            # append + one fsync PER WINDOW — the steady-state shape.
+            for i in range(n):
+                base = 1000.0 + (i % 512) * 7.0
+                for j, r in enumerate((base, base + 1.0)):
+                    app.broker.publish(
+                        q,
+                        f'{{"id":"o{2 * i + j}","rating":{r}}}'.encode(),
+                        Properties(reply_to="", correlation_id=""))
+            ok = await quiesce(app, rt, matched_at_least=matched0 + 2 * n)
+            span = time.perf_counter() - t0
+            matched = app.metrics.counters.get("players_matched") - matched0
+            await app.stop()
+            if not (warm_ok and ok):
+                log(f"[crash-soak overhead durable={durable}] WARNING: "
+                    f"quiesce timed out (warm={warm_ok}, measured={ok}) — "
+                    f"the span includes idle drain polling, overhead "
+                    f"fraction withheld")
+            return (matched / span if span > 0 else 0.0, warm_ok and ok)
+        finally:
+            shutil.rmtree(args._crash_jdir, ignore_errors=True)
+
+    runs = [asyncio.run(one_run(i))
+            for i in range(max(1, int(args.crash_runs)))]
+    rate_on, on_ok = asyncio.run(rate_phase(True))
+    rate_off, off_ok = asyncio.run(rate_phase(False))
+    # A timed-out quiesce poisons the rate it measured: report the rates
+    # (flagged in the log) but withhold the gated overhead fraction —
+    # bench_diff skips None rather than flagging a phantom regression.
+    overhead = (max(0.0, 1.0 - rate_on / rate_off)
+                if rate_off > 0 and on_ok and off_ok else None)
+    first = runs[0]
+    identical = None
+    if len(runs) >= 2:
+        identical = all(
+            json.dumps(r["transcripts"], sort_keys=True)
+            == json.dumps(first["transcripts"], sort_keys=True)
+            for r in runs[1:])
+    rtos = [x for r in runs for x in r["rtos"]]
+    return {
+        "crash_cycles": n_cycles,
+        "crash_runs": len(runs),
+        "crash_lost": sum(r["lost"] for r in runs),
+        "crash_dup": sum(r["dup"] for r in runs),
+        "crash_rto_ms_max": round(max(rtos), 3) if rtos else None,
+        "crash_rto_ms_mean": (round(sum(rtos) / len(rtos), 3)
+                              if rtos else None),
+        "crash_recoveries": len(rtos),
+        "crash_matched_players": first["matched_players"],
+        "crash_transcript_identical": identical,
+        "crash_device_failovers": sum(r["failovers"] for r in runs),
+        "crash_failover_blackout_ms": first["failover_blackout_ms"],
+        "journal_write_amplification": first["write_amplification"],
+        "crash_e2e_rate_on": round(rate_on, 1),
+        "crash_e2e_rate_off": round(rate_off, 1),
+        "crash_journal_overhead_frac": (round(overhead, 4)
+                                        if overhead is not None else None),
+    }
+
+
 async def _scenario_cell(args, scn) -> dict:
     """One matrix cell: a fresh single-queue app driven by one scenario's
     seeded population load, with the autotuner closing the loop (unless
@@ -1899,6 +2222,44 @@ def main() -> None:
     p.add_argument("--placement-window", type=int, default=256,
                    help="soak batcher window / top batch bucket")
     p.add_argument("--placement-seed", type=int, default=17)
+    p.add_argument("--crash-soak", action="store_true",
+                   help="crash-restart soak (ISSUE 15): seeded load "
+                        "through N kill/recover cycles (in-process hard "
+                        "crash: no drain, no clean journal marker, "
+                        "uncommitted buffers dropped) incl. one "
+                        "device-lost D=2→1 demotion cycle; emits "
+                        "crash_lost / crash_dup / crash_rto_ms_* / "
+                        "journal write amplification / steady-state "
+                        "append overhead (bench_diff gates them, lower "
+                        "is better). Standalone mode: skips every other "
+                        "phase; forces a host mesh so the sharded leg is "
+                        "real on a CPU box")
+    p.add_argument("--crash-cycles", type=int, default=3,
+                   help="kill/recover cycles per run (last one is the "
+                        "device-lost cycle)")
+    p.add_argument("--crash-runs", type=int, default=2,
+                   help="full soak repetitions; >= 2 additionally pins "
+                        "the recovery transcripts bit-identical across "
+                        "runs")
+    p.add_argument("--crash-pairs", type=int, default=6,
+                   help="matching pairs per cycle (deterministic designed "
+                        "load)")
+    p.add_argument("--crash-singles", type=int, default=3,
+                   help="never-matching singles per cycle (the waiting "
+                        "pool recovery must carry)")
+    p.add_argument("--crash-rate", type=float, default=800.0,
+                   help="publish pacing for the cycle load (req/s)")
+    p.add_argument("--crash-seed", type=int, default=23)
+    p.add_argument("--crash-compact-records", type=int, default=64,
+                   help="live-segment record budget before compaction — "
+                        "small by default so the soak exercises snapshot "
+                        "rotation every cycle")
+    p.add_argument("--crash-overhead-pairs", type=int, default=600,
+                   help="pairs for the steady-state append-overhead "
+                        "phase (fsync=window vs durability off)")
+    p.add_argument("--crash-keep-dirs", action="store_true",
+                   help="keep the per-run journal directories for "
+                        "inspection")
     p.add_argument("--scenario-matrix", default="",
                    help="scenario observatory (ISSUE 13): run the named "
                         "population-model scenarios (comma list, or 'all' "
@@ -1936,6 +2297,18 @@ def main() -> None:
                         "<dir>/<scenario>.json (the configs/tuned/ "
                         "capacity artifacts)")
     args = p.parse_args()
+    if args.crash_soak:
+        # Standalone like --placement-soak: the device-lost cycle needs a
+        # D=2 mesh, so force >= 2 host devices before any jax import (a
+        # no-op on a real TPU backend — the flag only affects the CPU
+        # platform).
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=2"
+            ).strip()
+        print(json.dumps(bench_crash_soak(args)), flush=True)
+        return
     if args.scenario_matrix:
         # Standalone like --placement-soak: the matrix is its own
         # artifact. Cells run on whatever backend jax initializes (the

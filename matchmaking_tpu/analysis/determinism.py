@@ -35,6 +35,14 @@ silently reintroduce the flake class PR 2 eliminated:
   makes window COMPOSITION depend on scheduler jitter, so keys must be
   pure functions of the message (the stamped ``x-deadline`` header via
   ``overload.deadline_of`` + the admission-cached ``delivery.tier``).
+  The crash-durability journal (utils/journal.py, ISSUE 15) added the
+  newest surface — journal-SEQUENCE arithmetic (``journal_seq`` /
+  ``record_seq`` / ``snapshot_seq`` / ``anchor_seq`` tokens): recovery
+  replays records in seq order and the crash-soak pins a bit-identical
+  recovery transcript, so a seq or compaction anchor derived from
+  ``time.time()`` would make replay order a function of wall-clock
+  jitter. Seqs are plain counters; fsync-interval pacing uses
+  ``time.monotonic()``.
 """
 
 from __future__ import annotations
@@ -92,7 +100,17 @@ def _contains_time_time(node: ast.AST) -> ast.Call | None:
 _CLOCKLIKE_TOKENS = ("deadline", "next_snapshot", "snapshot_due",
                      "next_sample", "sample_due", "next_scrape",
                      "scrape_due", "edf_key", "edf", "cut_key", "sort_key",
-                     "tier_key", "tier_rank")
+                     "tier_key", "tier_rank",
+                     # Journal-sequence arithmetic (ISSUE 15): the
+                     # write-ahead journal's replay order is its monotone
+                     # record seq — a seq/anchor born from time.time()
+                     # would make recovery replay order (and the
+                     # crash-soak's bit-identical transcript) a function
+                     # of wall-clock jitter. Seqs are counters; the one
+                     # sanctioned clock in the journal is the fsync
+                     # INTERVAL check, which already uses monotonic.
+                     "journal_seq", "record_seq", "snapshot_seq",
+                     "anchor_seq")
 
 
 def _clocklike(text: str) -> bool:

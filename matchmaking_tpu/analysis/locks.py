@@ -85,8 +85,15 @@ ALLOWED_AWAIT_CALLS = ("asyncio.to_thread", "_shielded_to_thread")
 #: whose wait is the strictly innermost resource by design: holders never
 #: acquire a lock under it, and the held engine lock guards state nothing
 #: else can touch while this queue waits its turn).
+#: ``_collect_ready_locked``/``_finish_token`` joined the set with the
+#: crash-durability async settle (ISSUE 15): their await chain
+#: (_finish_token → _handle_columnar_out → asyncio.to_thread(journal.
+#: commit)) bottoms out in to_thread only — the lock stays held across
+#: the journal's policy fsync, which is exactly the commit-exclusion the
+#: write-ahead discipline needs.
 ALLOWED_AWAIT_METHODS = ("_drain_engine", "_pay_debt_locked",
-                         "_arbiter_slot", "_arbiter_turn")
+                         "_arbiter_slot", "_arbiter_turn",
+                         "_collect_ready_locked", "_finish_token")
 
 #: Container/set/dict methods that mutate their receiver.
 MUTATORS = frozenset({

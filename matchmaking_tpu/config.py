@@ -350,12 +350,28 @@ class ChaosConfig:
     #: fail_steps, so probe outcomes are scriptable independently of how
     #: many traffic steps the storm consumed).
     fail_probes: int = 0
+    #: Device-loss fault (ISSUE 15): device SEARCH-step indices at which
+    #: the engine raises ``ChaosDeviceLostError`` — modeling a mesh
+    #: participant dying mid-serve (the XLA "device lost / transfer
+    #: failed" error class, which a revive-from-mirror cannot fix because
+    #: the rebuilt engine would bind the same dead chip). Shares the
+    #: per-queue step counter with ``fail_steps``. The queue runtime
+    #: routes it through the breaker's crash accounting into the failover
+    #: path: an elastic-shardable sharded queue demotes to its SURVIVING
+    #: devices (D → D-1, journal/mirror as the pool source) instead of
+    #: revive-looping the dead mesh; the demotion is audited at
+    #: /debug/placement with the measured blackout.
+    device_lost_steps: tuple[int, ...] = ()
+    #: Which logical device of the queue's binding "died" (-1 = the last
+    #: device — the default models losing the highest shard).
+    device_lost_device: int = -1
 
     def enabled(self) -> bool:
         return bool(
             self.drop_prob > 0 or self.dup_prob > 0 or self.drop_seqs
             or self.dup_seqs or self.partitions or self.fail_steps
             or self.fail_step_ranges or self.fail_probes
+            or self.device_lost_steps
         )
 
     def consume_faults(self) -> bool:
@@ -481,6 +497,57 @@ class OverloadConfig:
                     or self.default_deadline_ms > 0 or self.adaptive
                     or self.drain_checkpoint_dir or self.tiers > 1
                     or self.deadline_sweep_ms > 0)
+
+
+@dataclass(frozen=True)
+class DurabilityConfig:
+    """Crash durability (ISSUE 15; utils/journal.py): a per-queue
+    write-ahead pool journal + periodic compaction snapshots, so a HARD
+    crash (OOM, host loss, ``kill -9``) recovers the waiting pool, the
+    at-least-once dedup/replay cache and the admission decision state —
+    the graceful drain→checkpoint→restore round trip (OverloadConfig.
+    drain_checkpoint_dir) only fires on SIGTERM.
+
+    Mechanics: admit/match/evict/expire mutations append as CRC-framed,
+    version-stamped records, batched per cut window (the hot columnar
+    path pays ONE buffered append per window, not per player) and
+    committed before the corresponding response/ack leaves (write-ahead:
+    a matched response is never visible before its terminal record is).
+    The live segment periodically compacts into a pool snapshot
+    (utils/checkpoint format) + a fresh segment; boot detects an unclean
+    shutdown (no clean-shutdown marker) and replays newest-valid
+    snapshot + journal tail into the engine — recovery time recorded as
+    the ``crash_rto_ms`` gauge and a ``crash_recovered`` EventLog event.
+    """
+
+    #: Directory for per-queue journal segments + compaction snapshots
+    #: ("" = durability off: zero hot-path work, no files).
+    journal_dir: str = ""
+    #: Commit durability: ``"none"`` buffers through the OS page cache
+    #: (cheapest; a HOST loss can drop the tail, a process crash cannot),
+    #: ``"interval"`` fsyncs at most every ``fsync_interval_s`` seconds,
+    #: ``"window"`` fsyncs every commit (= every cut window — the
+    #: bounded-loss setting the crash-soak acceptance measures).
+    fsync: str = "none"
+    #: Max seconds between fsyncs under the ``"interval"`` policy.
+    fsync_interval_s: float = 0.05
+    #: Compact (snapshot + segment rotation) once the live segment holds
+    #: this many records…
+    compact_records: int = 50_000
+    #: …or this many bytes, whichever first. Compaction runs off the hot
+    #: path (the app's durability timer), under the engine lock with the
+    #: pipeline drained, so the snapshot is exactly consistent with the
+    #: journal sequence it anchors.
+    compact_bytes: int = 8 << 20
+    #: Compaction-check cadence for the durability timer (seconds).
+    compact_interval_s: float = 1.0
+    #: Snapshot generations retained per queue (newest + fallbacks): a
+    #: truncated/corrupt newest snapshot falls back to the previous good
+    #: one at recovery instead of crashing the boot.
+    keep_snapshots: int = 2
+
+    def enabled(self) -> bool:
+        return bool(self.journal_dir)
 
 
 @dataclass(frozen=True)
@@ -728,6 +795,9 @@ class Config:
     #: Admission control / load shedding / deadline propagation / graceful
     #: drain (off by default — see OverloadConfig.enabled()).
     overload: OverloadConfig = field(default_factory=OverloadConfig)
+    #: Crash durability: write-ahead pool journal + hard-crash recovery
+    #: (off by default — see DurabilityConfig.enabled()).
+    durability: DurabilityConfig = field(default_factory=DurabilityConfig)
     #: Flight recorder / debug endpoints (tracing on by default).
     observability: ObservabilityConfig = field(
         default_factory=ObservabilityConfig)
@@ -768,6 +838,7 @@ class Config:
             ("auth", AuthConfig),
             ("chaos", ChaosConfig),
             ("overload", OverloadConfig),
+            ("durability", DurabilityConfig),
             ("observability", ObservabilityConfig),
             ("placement", PlacementConfig),
             ("autotune", AutotuneConfig),

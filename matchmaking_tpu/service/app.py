@@ -177,6 +177,32 @@ class _QueueRuntime:
         #: publish_batch broker call per window of responses.
         self._batch_publish = (app.cfg.broker.batch_publish
                                and hasattr(app.broker, "publish_batch"))
+        #: Write-ahead pool journal (ISSUE 15, utils/journal.py; None =
+        #: durability off). Construction ATTACHES to whatever segments a
+        #: crashed predecessor left — the app's recovery step reads
+        #: ``journal.recovered`` before any consumer runs.
+        self.journal = None
+        dur = app.cfg.durability
+        if dur.enabled():
+            from matchmaking_tpu.utils.journal import PoolJournal
+
+            self.journal = PoolJournal(
+                dur.journal_dir, queue_cfg.name, fsync=dur.fsync,
+                fsync_interval_s=dur.fsync_interval_s,
+                compact_records=dur.compact_records,
+                compact_bytes=dur.compact_bytes,
+                keep_snapshots=dur.keep_snapshots)
+        #: Device-loss failover (ISSUE 15): the logical device a
+        #: ChaosDeviceLostError (or a real XLA device-loss) named, consumed
+        #: by the next ``_revive_engine`` to demote a sharded queue to its
+        #: surviving devices; plus the bounded audit of past demotions
+        #: (served at /debug/placement next to the controller's ring).
+        self._lost_device: int | None = None
+        self.failover_log: list[dict] = []
+        #: The last hard-crash recovery this runtime applied (None = clean
+        #: boot): rto_ms + the journal's deterministic transcript — what
+        #: bench.py --crash-soak pins bit-identical across two runs.
+        self.last_recovery: "dict | None" = None
         self._bind_engine(self._make_engine())
         # At-least-once dedup: player id → (encoded terminal response BODY,
         # expiry). Bytes, not SearchResponse: the body is built exactly once
@@ -252,6 +278,16 @@ class _QueueRuntime:
             # breaker to probe and no delegate to re-promote, so the timer
             # would just contend on the engine lock every tick for nothing.
             self._health = asyncio.create_task(self._health_loop())
+        #: Journal compaction timer (ISSUE 15): checks wants_compact() on
+        #: its cadence and runs snapshot + segment rotation off the hot
+        #: path, under the engine lock with the pipeline drained. NOT
+        #: started here: app.start() arms it via
+        #: ``start_durability_timer`` only AFTER recover_from_journal has
+        #: applied the predecessor's state — a re-attached segment can
+        #: already exceed the compaction budget, and compacting the
+        #: not-yet-recovered (empty) engine would anchor an empty
+        #: snapshot at the recovered seq and GC the one recovery needs.
+        self._durability: asyncio.Task | None = None
         # Online invariant checking (SURVEY.md §5 "Race detection").
         self._invariants = None
         if app.cfg.debug_invariants:
@@ -513,12 +549,264 @@ class _QueueRuntime:
 
     # ---- settle + admission (overload control) ----------------------------
 
+    # ---- write-ahead journal (ISSUE 15, utils/journal.py) -----------------
+
+    def _journal_commit(self) -> None:
+        """Flush buffered journal records before an externally visible
+        effect (response publish / delivery ack) — the write-ahead points.
+        One buffered os.write per window; fsync per the configured policy.
+        No-op (one attr read + one bool) with durability off or a clean
+        buffer."""
+        j = self.journal
+        if j is not None and j.needs_commit:
+            j.commit()
+
+    # holds-lock: _engine_lock
+    def _journal_admit_cols(self, cols) -> None:
+        """ADMIT record for one dispatched columnar window: called inside
+        the dispatch closures, under the engine lock, AFTER the stale/
+        expired/debt drops — the journal records exactly what entered the
+        pool, so recovery can never resurrect a terminal-replayed player
+        as waiting. One buffered append per window, not per player.
+        Region/mode by NAME (interner codes are process-local, the
+        utils/checkpoint portability rule)."""
+        j = self.journal
+        if j is None or not len(cols):
+            return
+        rname = self.engine.pool.regions.name
+        mname = self.engine.pool.modes.name
+        k = len(cols)
+        tiers = (cols.tier.tolist() if cols.tier is not None else [0] * k)
+        dls = (cols.deadline.tolist() if cols.deadline is not None
+               else [0.0] * k)
+        rows = [
+            [pid, float(rating), float(rd), rname(int(rc)), mname(int(mc)),
+             (None if thr != thr else float(thr)), float(enq), rep, corr,
+             int(tier), float(dl)]
+            for pid, rating, rd, rc, mc, thr, enq, rep, corr, tier, dl
+            in zip(cols.ids.tolist(), cols.rating.tolist(), cols.rd.tolist(),
+                   cols.region.tolist(), cols.mode.tolist(),
+                   cols.threshold.tolist(), cols.enqueued_at.tolist(),
+                   cols.reply_to.tolist(), cols.correlation_id.tolist(),
+                   tiers, dls)
+        ]
+        j.append_admits(rows)
+        # Write out at dispatch (one os.write, NO fsync — a process crash
+        # cannot lose written bytes): a crash mid-window then recovers the
+        # window's players as WAITING from the journal alone — not
+        # matched, never lost. The policy fsync runs at the response/ack
+        # commit points, once per window.
+        j.flush_buffer()
+
+    # holds-lock: _engine_lock
+    def _journal_admit_reqs(self, requests: "list[SearchRequest]") -> None:
+        """Object-path twin of ``_journal_admit_cols`` (device team queues
+        and the demoted-oracle flush)."""
+        j = self.journal
+        if j is None or not requests:
+            return
+        j.append_admits([
+            [r.id, float(r.rating), float(r.rating_deviation), r.region,
+             r.game_mode,
+             (None if r.rating_threshold is None
+              else float(r.rating_threshold)),
+             float(r.enqueued_at), r.reply_to, r.correlation_id,
+             int(r.tier), float(r.deadline_at)]
+            for r in requests
+        ])
+        j.flush_buffer()
+
+    def start_durability_timer(self) -> None:
+        """Arm the compaction timer — called by app.start() AFTER
+        ``recover_from_journal`` so the first compaction can only ever
+        snapshot a recovered (or genuinely fresh) pool."""
+        if (self.journal is not None and self._durability is None
+                and self.app.cfg.durability.compact_interval_s > 0):
+            self._durability = asyncio.create_task(self._durability_loop())
+
+    async def _durability_loop(self) -> None:
+        """Compaction timer: snapshot + segment rotation once the live
+        segment crosses its record/byte budget. Supervised like the
+        collector — one failed compaction (disk full, transient device
+        error in the drain) must not end durability for the process."""
+        interval = self.app.cfg.durability.compact_interval_s
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                j = self.journal
+                if j is None or not j.wants_compact():
+                    continue
+                await self.compact_journal()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("journal compaction failed; retrying")
+                self.app.metrics.counters.inc("journal_compact_errors")
+
+    async def compact_journal(self) -> "dict[str, Any]":
+        """One compaction: under the engine lock with the pipeline
+        drained, capture the anchor seq, snapshot the pool (utils/
+        checkpoint format, atomic), rotate the segment, and carry the
+        live dedup entries + admission checkpoint into the successor —
+        the snapshot is exactly consistent with the journal sequence it
+        anchors because nothing can mutate the pool between the capture
+        and the write."""
+        from matchmaking_tpu.utils.checkpoint import save_pool
+
+        j = self.journal
+        assert j is not None
+        async with self._engine_lock:
+            now = time.time()
+            await self._drain_engine(now)
+            anchor, snap_path = j.compact_begin()
+
+            def rotate() -> int:
+                n = save_pool(self.engine, snap_path,
+                              queue_name=self.queue_cfg.name)
+                carry = [(pid, body, exp)
+                         for pid, (body, exp) in self._recent.items()
+                         if exp > now]
+                adm = (self.admission.checkpoint()
+                       if self.admission is not None else None)
+                j.compact_finish(anchor, snap_path, carry, adm)
+                return n
+
+            # shield + ensure_future (the migrate() pattern): the rotate
+            # THREAD cannot be interrupted and mutates on-disk journal
+            # state (snapshot write, segment rotation, carry records). If
+            # the durability task is cancelled mid-compaction — close()
+            # cancels it without awaiting — a bare await would release
+            # the engine lock while the thread keeps running, letting
+            # shutdown's drain → mark_clean() → journal.close() race
+            # compact_finish: the rotation would strand the CLEAN marker
+            # in the retired segment and append carry records PAST it, so
+            # the next boot would "recover" from a clean shutdown. Hold
+            # the lock until the thread actually finishes, then let the
+            # cancellation propagate.
+            rotate_task = asyncio.ensure_future(asyncio.to_thread(rotate))
+            try:
+                count = await _shielded_to_thread(rotate_task)
+            except asyncio.CancelledError:
+                while not rotate_task.done():
+                    try:
+                        await _shielded_to_thread(rotate_task)
+                    except asyncio.CancelledError:
+                        continue
+                    except Exception:
+                        break
+                raise
+        self.app.metrics.counters.inc("journal_compactions")
+        self.app.events.append(
+            "journal_compacted", self.queue_cfg.name,
+            f"anchor seq {anchor}, {count} waiting players snapshotted")
+        return {"anchor": anchor, "snapshot": snap_path, "count": count}
+
+    async def recover_from_journal(self) -> "dict | None":
+        """Hard-crash recovery (app.start() calls this before traffic):
+        apply the journal's recovered state — newest-valid snapshot +
+        journal tail into the engine (index_rebuild via the heartbeat
+        seam so the bucketed index is exact), the ``_recent`` dedup/replay
+        cache so broker redeliveries of already-terminal players replay
+        instead of re-entering, and the admission decision checkpoint.
+        The whole span is the measured RTO (``crash_rto_ms`` gauge +
+        ``crash_recovered`` EventLog event). Returns the recovery record
+        (also kept as ``self.last_recovery``), or None on a clean boot."""
+        from matchmaking_tpu.utils.checkpoint import load_pool
+        from matchmaking_tpu.utils.journal import row_to_request
+
+        j = self.journal
+        if j is None or j.recovered is None:
+            return None
+        rec = j.recovered
+        q = self.queue_cfg.name
+        for note in rec.corrupt:
+            # Speakable, non-fatal: a truncated newest snapshot fell back
+            # to the previous good generation instead of crashing the boot.
+            self.app.events.append("journal_corrupt", q, note)
+            log.warning("queue %r: %s", q, note)
+        if rec.clean:
+            return None
+        t0 = time.perf_counter()
+        now = time.time()
+        async with self._engine_lock:
+
+            def apply() -> tuple[int, int]:
+                n_snap = 0
+                if rec.snapshot:
+                    n_snap = load_pool(self.engine, rec.snapshot, now)
+                for pid in sorted(rec.removed):
+                    # Terminal after the snapshot anchor: the player is no
+                    # longer waiting (remove is a no-op when absent).
+                    self.engine.remove(pid)
+                tail = [row_to_request(rec.waiting[pid])
+                        for pid in sorted(rec.waiting)]
+                if tail:
+                    self.engine.restore(tail, now)
+                if hasattr(self.engine, "heartbeat"):
+                    # Bucketed engines re-tighten the device index with a
+                    # full index_rebuild here: incremental admits during
+                    # restore only WIDEN bounds, and recovery must hand
+                    # traffic an index as exact as the pre-crash one.
+                    self.engine.heartbeat(now)
+                return n_snap, len(tail)
+
+            n_snap, n_tail = await asyncio.to_thread(apply)
+            for pid, (body, exp) in rec.recent.items():
+                if exp > now:
+                    self._recent.set(pid, (body, exp))
+            if rec.admission is not None and self.admission is not None:
+                self.admission.restore_state(rec.admission)
+        # Anchor a fresh snapshot immediately: the recovered tail must not
+        # replay again on the next crash, and the successor segment starts
+        # from the exact recovered state.
+        await self.compact_journal()
+        rto_ms = (time.perf_counter() - t0) * 1e3
+        self.app.metrics.set_gauge(f"crash_rto_ms[{q}]", round(rto_ms, 3))
+        self.app.metrics.counters.inc("crash_recoveries")
+        self.app.events.append(
+            "crash_recovered", q,
+            f"unclean shutdown: {n_snap} snapshot + {n_tail} journal-tail "
+            f"players restored, {len(rec.recent)} dedup entries, "
+            f"rto {rto_ms:.1f} ms"
+            + (" (snapshot fallback)" if rec.fallback else ""))
+        log.warning(
+            "queue %r: recovered from unclean shutdown — %d snapshot + %d "
+            "journal-tail players, %d dedup entries, rto %.1f ms",
+            q, n_snap, n_tail, len(rec.recent), rto_ms)
+        self.last_recovery = {
+            "rto_ms": round(rto_ms, 3),
+            "snapshot_players": n_snap,
+            "tail_players": n_tail,
+            "dedup_entries": len(rec.recent),
+            "fallback": rec.fallback,
+            "corrupt": list(rec.corrupt),
+            "transcript": rec.transcript(),
+        }
+        return self.last_recovery
+
+    def _note_failure(self, err: BaseException) -> None:
+        """Classify an engine failure before the revive: a device-LOSS
+        error (chaos-scripted or a real XLA device loss) names the dead
+        mesh participant — the next ``_revive_engine`` demotes an
+        elastic-shardable queue to its surviving devices instead of
+        revive-looping an engine bound to the dead chip."""
+        from matchmaking_tpu.utils.chaos import ChaosDeviceLostError
+
+        if isinstance(err, ChaosDeviceLostError):
+            self._lost_device = err.device
+            self.app.events.append(
+                "device_lost", self.queue_cfg.name,
+                f"logical device {err.device}")
+
     # settles: delivery
     def _ack(self, delivery: Delivery) -> None:
         """Ack + release the delivery's admission credit. EVERY runtime
         settle path comes through here (or _nack): the credit limiter's
         inflight count is exactly the deliveries admitted but unsettled,
-        and a leaked credit would tighten admission forever."""
+        and a leaked credit would tighten admission forever. Journal
+        commit FIRST (write-ahead): with fsync="window" an acked delivery
+        implies its window's journaled mutations are durable."""
+        self._journal_commit()
         self.app.broker.ack(self.consumer_tag, delivery.delivery_tag)
         if self.admission is not None:
             self.admission.release(delivery.delivery_tag)
@@ -528,6 +816,7 @@ class _QueueRuntime:
         """Nack twin of _ack. The credit is released even on requeue: the
         redelivery re-enters through admission and takes a fresh credit
         (or a shed/expired response, if the queue tightened meanwhile)."""
+        self._journal_commit()
         self.app.broker.nack(self.consumer_tag, delivery.delivery_tag,
                              requeue=requeue)
         if self.admission is not None:
@@ -1031,6 +1320,7 @@ class _QueueRuntime:
                         if drop else requests)
                 # matchlint: ignore[guarded-by] closure runs under _engine_lock inside _dispatch_pipelined (via to_thread)
                 tok, _ = self.engine.search_async(reqs, now)
+                self._journal_admit_reqs(reqs)  # matchlint: ignore[guarded-by] same lock-held closure
                 return tok
 
             await self._dispatch_pipelined(
@@ -1075,10 +1365,20 @@ class _QueueRuntime:
                 # (dispatch == device step for engines without the
                 # pipelined API; the device serializes them anyway).
                 async with self._arbiter_slot(deliveries_in):
-                    outcome = await asyncio.to_thread(
-                        self.engine.search, requests, now)
-        except Exception:
+
+                    def run_search():
+                        # Journal the admits at dispatch (write-ahead): the
+                        # sync step admits AND matches in one call, so the
+                        # replay order is admit-then-terminal either way.
+                        # (Lexically inside the lock body — matchlint sees
+                        # the dominance directly, no ignores needed.)
+                        self._journal_admit_reqs(requests)
+                        return self.engine.search(requests, now)
+
+                    outcome = await asyncio.to_thread(run_search)
+        except Exception as e:
             log.exception("engine step crashed; reviving engine from mirror")
+            self._note_failure(e)
             self._record_engine_crash(now)
             # Sync crash path: the raise released the lock, and no await
             # separates detection from rebuild, so nothing can interleave.
@@ -1419,7 +1719,8 @@ class _QueueRuntime:
                 # and auth RPC deadlines.
                 # matchlint: ignore[guarded-by] closure runs under _engine_lock below (via to_thread)
                 self.engine.search_columns_async(cols, now)
-                return self.engine.flush()  # matchlint: ignore[guarded-by] same lock-held closure
+                self._journal_admit_cols(cols)  # matchlint: ignore[guarded-by] same lock-held closure
+                return self.engine.flush()
 
             try:
                 async with self._engine_lock:
@@ -1462,8 +1763,9 @@ class _QueueRuntime:
                         raise err
                     for tok, _out in outs:
                         self.engine.failed_tokens.discard(tok)
-            except Exception:
+            except Exception as e:
                 log.exception("engine step crashed; reviving engine from mirror")
+                self._note_failure(e)
                 self._record_engine_crash(now)
                 # Sync crash path — see the object-path twin above.
                 # matchlint: ignore[guarded-by] revive sequence is await-free; the lock guards cross-await atomicity only
@@ -1478,7 +1780,8 @@ class _QueueRuntime:
             # refinement), retiring the two inline ignores that sat here.
             for tok, out in outs:
                 self._merge_window_marks(tok, deliveries_in)
-                self._handle_columnar_out(out, by_id, deliveries_in, now)
+                await self._handle_columnar_out(out, by_id, deliveries_in,
+                                                now)
             return
 
         # Pipelined path: dispatch without waiting; outcomes (publish + ack)
@@ -1490,7 +1793,9 @@ class _QueueRuntime:
                                    bool, len(c))
                 c = c.take(mask)
             # matchlint: ignore[guarded-by] closure runs under _engine_lock inside _dispatch_pipelined (via to_thread)
-            return self.engine.search_columns_async(c, now)
+            tok = self.engine.search_columns_async(c, now)
+            self._journal_admit_cols(c)  # matchlint: ignore[guarded-by] same lock-held closure
+            return tok
 
         await self._dispatch_pipelined(
             dispatch, [(pid, deliveries[s]) for s, pid, _ in keep], now)
@@ -1673,7 +1978,7 @@ class _QueueRuntime:
                 # delegated-oracle window's outcome is already complete at
                 # dispatch, and collecting it here moves its matched players
                 # into _recent where _settle_terminal_locked can see them.
-                self._collect_ready_locked(time.time())
+                await self._collect_ready_locked(time.time())
                 if self._needs_revive:
                     # A collected window failed on device: the device pool
                     # diverged from the mirror (its step may have matched
@@ -1734,9 +2039,10 @@ class _QueueRuntime:
                     tok = await asyncio.to_thread(dispatch, stale)
                 self._inflight_meta[tok] = (dict(pairs), deliveries_in)
                 recorded = True
-                self._collect_ready_locked(time.time())
-        except Exception:
+                await self._collect_ready_locked(time.time())
+        except Exception as e:
             log.exception("engine dispatch crashed; reviving engine from mirror")
+            self._note_failure(e)
             self._record_engine_crash(now)
             # Once meta is recorded the revive path settles this window
             # exactly once (salvage-ack or stale-meta nack) — passing
@@ -1760,19 +2066,22 @@ class _QueueRuntime:
                and self.engine.inflight() >= depth):
             await asyncio.sleep(0.001)
             async with self._engine_lock:
-                self._collect_ready_locked(time.time())
+                await self._collect_ready_locked(time.time())
 
-    def _collect_ready_locked(self, now: float) -> None:
-        """Collect + handle every landed window. Caller holds _engine_lock.
-        Cheap on the event loop: results were D2H-copied asynchronously at
-        dispatch, so this is numpy slicing + publish/ack bookkeeping."""
+    async def _collect_ready_locked(self, now: float) -> None:
+        """Collect + handle every landed window. Caller holds _engine_lock
+        (held across the awaits — the async settle's journal commit relies
+        on that to exclude concurrent appends). Cheap on the event loop:
+        results were D2H-copied asynchronously at dispatch, so this is
+        numpy slicing + publish/ack bookkeeping, plus the off-loop policy
+        fsync when durability is on."""
         if not hasattr(self.engine, "collect_ready"):
             return
         for tok, out in self.engine.collect_ready():
-            self._finish_token(tok, out, now)
+            await self._finish_token(tok, out, now)
 
     # holds-lock: _engine_lock
-    def _finish_token(self, tok: int, out, now: float) -> None:
+    async def _finish_token(self, tok: int, out, now: float) -> None:
         meta = self._inflight_meta.pop(tok, None)
         if meta is None:
             # Not a delivery-backed window (rescan tick / already-settled):
@@ -1816,7 +2125,7 @@ class _QueueRuntime:
             return
         try:
             if hasattr(out, "m_id_a"):
-                self._handle_columnar_out(out, by_id, deliveries, now)
+                await self._handle_columnar_out(out, by_id, deliveries, now)
             else:
                 self._handle_object_out(out, deliveries, now)
         except Exception:
@@ -1849,18 +2158,34 @@ class _QueueRuntime:
                 if d.trace is not None and d.trace.player_id}
 
     # settles: *deliveries
-    def _handle_columnar_out(self, out, by_id: dict[str, Delivery],
-                             deliveries: list[Delivery], now: float) -> None:
-        """Publish one collected window's outcome and ack its deliveries."""
+    async def _handle_columnar_out(self, out, by_id: dict[str, Delivery],
+                                   deliveries: list[Delivery],
+                                   now: float) -> None:
+        """Publish one collected window's outcome and ack its deliveries.
+
+        Async settle (ISSUE 15): the rows are BUILT first — terminal
+        memory and the window's journal records land with them — then the
+        journal's policy commit runs in a worker thread, so the fsync
+        overlaps device compute on already-dispatched windows instead of
+        stalling the event loop (measured at ~2 ms/window of pure loop
+        overhead when it ran inline), and only then do the window's
+        responses and acks go out. Write-ahead is preserved: the commit
+        covers every record the publishes below make visible. The
+        pipelined callers hold _engine_lock across the await, so no new
+        records interleave; the non-pipelined fallback settles post-lock
+        — a concurrent append between the commit and the publish only
+        makes the publish-time commit non-empty, never unsafe (it covers
+        strictly MORE records than write-ahead requires)."""
         m = self.app.metrics
         trace_ids = self._trace_id_map(deliveries)
         traces = self._trace_map(deliveries)
-        self._publish_columnar_matches(out, now, trace_ids=trace_ids,
-                                       traces=traces)
+        rows = self._build_columnar_rows(out, now, trace_ids=trace_ids,
+                                         traces=traces)
         if self.queue_cfg.send_queued_ack and len(out.q_ids):
             # Queued acks ride the batch path too (ISSUE 9): one native
-            # encode + one publish_batch per window instead of an
-            # encode_response + publish per newly pooled player.
+            # encode per window instead of an encode_response + publish
+            # per newly pooled player — and they share the matches'
+            # publish_batch call below.
             import numpy as np
 
             from matchmaking_tpu.native import codec
@@ -1876,7 +2201,6 @@ class _QueueRuntime:
                         [pid for pid, _ in metas],
                         np.zeros(nq, np.float64), None,
                         [trace_ids.get(pid, "") for pid, _ in metas], None)
-                rows: list[tuple[str, str, bytes, Any]] = []
                 for j, (pid, d) in enumerate(metas):
                     body = bodies_q[j] if bodies_q is not None else None
                     if body is None:  # codec off or NEEDS_PYTHON row
@@ -1888,7 +2212,11 @@ class _QueueRuntime:
                     rows.append((d.properties.reply_to,
                                  d.properties.correlation_id, body,
                                  d.trace))
-                self._publish_batch(rows)
+        jnl = self.journal
+        if jnl is not None and jnl.needs_commit:
+            await asyncio.to_thread(jnl.commit)
+        if rows:
+            self._publish_batch(rows)
         for pid, code in out.rejected:
             m.counters.inc("rejected_by_engine")
             d = by_id.get(pid)
@@ -1937,7 +2265,7 @@ class _QueueRuntime:
         if self.engine.inflight() > 0:
             outs = await asyncio.to_thread(self.engine.flush)
             for tok, out in outs:
-                self._finish_token(tok, out, now)
+                await self._finish_token(tok, out, now)
         if self._needs_revive:
             self._revive_locked(now)
 
@@ -1962,7 +2290,7 @@ class _QueueRuntime:
                 log.exception("flush during revive failed; all in-flight nacked")
                 outs = []
             for tok, out in outs:
-                self._finish_token(tok, out, now)
+                await self._finish_token(tok, out, now)
             for d in extra_nack or ():
                 self._nack(d)
             # _revive_engine nacks + clears whatever meta the salvage flush
@@ -1980,7 +2308,7 @@ class _QueueRuntime:
                 if self.engine.inflight() > 0 or self._needs_revive:
                     now = time.time()
                     async with self._engine_lock:
-                        self._collect_ready_locked(now)
+                        await self._collect_ready_locked(now)
                         if self._needs_revive and self.engine.inflight() == 0:
                             self._revive_locked(now)
                     await asyncio.sleep(0.001)
@@ -1997,8 +2325,22 @@ class _QueueRuntime:
                                   trace_ids: dict[str, str] | None = None,
                                   traces: "dict[str, Any] | None" = None,
                                   ) -> None:
-        """Matched responses for one ColumnarOutcome (window flush AND
-        rescan both come through here). Bodies are built by the native
+        """Matched responses for one ColumnarOutcome — build + publish in
+        one sync call (rescan outcomes and other non-deferring callers;
+        the async window settle uses ``_build_columnar_rows`` directly so
+        the journal's policy commit can run off the event loop between
+        building and publishing)."""
+        rows = self._build_columnar_rows(out, now, trace_ids=trace_ids,
+                                         traces=traces)
+        if rows:
+            self._publish_batch(rows)
+
+    def _build_columnar_rows(self, out, now: float,
+                             trace_ids: dict[str, str] | None = None,
+                             traces: "dict[str, Any] | None" = None,
+                             ) -> "list[tuple[str, str, bytes, Any]]":
+        """Row-building half of the columnar match publish (window flush
+        AND rescan both come through here). Bodies are built by the native
         batch encoder when available — one C call per window with
         trace_id/waited_ms INCLUDED, byte-identical to
         contract.encode_response (pinned by tests/test_codec_fuzz.py; the
@@ -2007,7 +2349,10 @@ class _QueueRuntime:
         publish callbacks to O(windows). The Python path is the fallback
         and the semantic source of truth; rows the C encoder flags
         NEEDS_PYTHON (non-ASCII ids, non-finite floats) re-encode through
-        it individually."""
+        it individually. Terminal memory (dedup cache + journal records)
+        lands HERE, with the rows — callers publish the returned rows
+        only after the journal's write-ahead commit. Returns [] when the
+        codec-off per-player fallback already published."""
         import numpy as np
 
         from matchmaking_tpu.native import codec
@@ -2017,7 +2362,7 @@ class _QueueRuntime:
             self._invariants.observe_outcome(out)
         n = out.n_matches
         if n == 0:
-            return
+            return []
         # Quality ledger (ISSUE 8): one vectorized observe per window —
         # both sides' quality/wait/tier samples, regardless of which
         # encoder builds the bodies below.
@@ -2057,7 +2402,7 @@ class _QueueRuntime:
                                       waited_ms=(float(out.m_wait_b[j]) * 1e3
                                                  if have_wait else None),
                                       record_quality=not have_wait)
-            return
+            return []
         lat_a = np.where(out.m_enq_a != 0.0, (now - out.m_enq_a) * 1e3, 0.0)
         lat_b = np.where(out.m_enq_b != 0.0, (now - out.m_enq_b) * 1e3, 0.0)
         # waited_ms parity with the Python encoder: the engine-observed
@@ -2090,6 +2435,7 @@ class _QueueRuntime:
         lat_al, lat_bl = lat_a.tolist(), lat_b.tolist()
         qual_l = qual.tolist()
         rows: list[tuple[str, str, bytes, Any]] = []
+        terminals: list[tuple[str, bytes]] = []
         for j in range(n):
             body_a, body_b = bodies[2 * j], bodies[2 * j + 1]
             if body_a is None or body_b is None:
@@ -2117,11 +2463,12 @@ class _QueueRuntime:
                 tr_jb.quality = qual_l[j]
                 tr_jb.waited_s = wb_l[j] / 1e3
                 tr_jb.mark("encode")
-            self._remember(ids_a[j], body_a, now)
-            self._remember(ids_b[j], body_b, now)
+            terminals.append((ids_a[j], body_a))
+            terminals.append((ids_b[j], body_b))
             rows.append((reply_a[j], corr_a[j], body_a, tr_ja))
             rows.append((reply_b[j], corr_b[j], body_b, tr_jb))
-        self._publish_batch(rows)
+        self._remember_window(terminals, now)
+        return rows
 
     def _publish_matched(self, pid: str, reply_to: str, correlation_id: str,
                          enqueued_at: float, result, now: float,
@@ -2174,6 +2521,10 @@ class _QueueRuntime:
         (respond→publish) in the attribution taxonomy (PR 6 carry-over)."""
         if not reply_to:
             return
+        # Write-ahead: a terminal response must never be visible before
+        # its journal record is durable (fsync per policy) — the invariant
+        # that makes recovery yield zero double matches.
+        self._journal_commit()
         if trace is not None:
             trace.mark("respond")
         self.app.broker.publish(reply_to, body,
@@ -2186,6 +2537,10 @@ class _QueueRuntime:
         "respond" mark as the batch publish starts — publish_lag keeps its
         queueing semantics (…→respond WAIT) and the publish itself is the
         respond→publish WORK gap, now amortized over the window."""
+        # Write-ahead twin of _publish_body: ONE commit (and fsync, per
+        # policy) covers the whole window's terminal records before any
+        # of its responses become visible.
+        self._journal_commit()
         items = []
         for reply_to, corr, body, trace in rows:
             if not reply_to:
@@ -2210,7 +2565,36 @@ class _QueueRuntime:
         the revive (flush, sweeper drain, rescan drain, collector): the old
         engine's windows are gone, and the fresh engine reissues tokens from
         0 — stale entries would strand their deliveries unacked AND collide
-        with the new engine's token numbering."""
+        with the new engine's token numbering.
+
+        Device-loss failover (ISSUE 15): when the crash named a dead mesh
+        participant (``_note_failure`` set ``_lost_device``), an
+        elastic-shardable D>=2 queue DEMOTES to its surviving devices
+        before the rebuild — a plain revive would bind the same dead chip
+        and revive-loop at traffic rate. The whole lock-held rebuild is
+        the measured blackout, audited in ``failover_log``
+        (/debug/placement)."""
+        t0 = time.perf_counter()
+        lost, self._lost_device = self._lost_device, None
+        demoted: "tuple[tuple[int, ...], tuple[int, ...], int] | None" = None
+        if lost is not None:
+            binding = (self.placement if self.placement is not None
+                       else tuple(range(self.app.cfg.engine.mesh_pool_axis)))
+            if self.elastic_shardable() and len(binding) > 1:
+                idx = lost if 0 <= lost < len(binding) else len(binding) - 1
+                survivors = tuple(d for i, d in enumerate(binding)
+                                  if i != idx)
+                # The binding sticks for EVERY later rebuild (probe,
+                # migration, further revives) — _engine_cfg follows it, so
+                # the mesh axis shrinks to the survivor count (D -> D-1).
+                self.placement = survivors
+                demoted = (binding, survivors, idx)
+            else:
+                log.error(
+                    "queue %r: device %d lost but no demotion possible "
+                    "(D=1 or non-elastic) — plain revive; a persistent "
+                    "loss trips the breaker into the host oracle",
+                    self.queue_cfg.name, lost)
         for tok, (_by_id, deliveries) in list(self._inflight_meta.items()):
             for d in deliveries:
                 self._nack(d)
@@ -2238,6 +2622,33 @@ class _QueueRuntime:
         self.engine.quality_restore(q_snapshot)
         self.app.events.append("engine_revive", self.queue_cfg.name,
                                f"{len(snapshot)} players restored from mirror")
+        if demoted is not None:
+            was, survivors, idx = demoted
+            blackout_ms = round((time.perf_counter() - t0) * 1e3, 3)
+            entry = {
+                "queue": self.queue_cfg.name,
+                "at": now,
+                "from_devices": list(was),
+                "to_devices": list(survivors),
+                "lost_device": idx,
+                "blackout_ms": blackout_ms,
+                "restored": len(snapshot),
+            }
+            self.failover_log.append(entry)
+            del self.failover_log[:-64]  # bounded audit ring
+            self.app.metrics.counters.inc("device_failovers")
+            self.app.metrics.set_gauge(
+                f"failover_blackout_ms[{self.queue_cfg.name}]", blackout_ms)
+            self.app.events.append(
+                "device_failover", self.queue_cfg.name,
+                f"D={len(was)} -> D={len(survivors)} after losing device "
+                f"{idx}: {len(snapshot)} players, {blackout_ms:.1f} ms "
+                f"blackout")
+            log.error(
+                "queue %r: DEVICE-LOSS FAILOVER — demoted %s -> %s "
+                "(lost logical device %d), %d players restored, %.1f ms "
+                "blackout", self.queue_cfg.name, list(was), list(survivors),
+                idx, len(snapshot), blackout_ms)
 
     # ---- egress -----------------------------------------------------------
 
@@ -2279,7 +2690,31 @@ class _QueueRuntime:
                                trace=trs.get(req.id))
 
     def _remember(self, player_id: str, body: bytes, now: float) -> None:
-        self._recent.set(player_id, (body, now + self.queue_cfg.dedup_ttl_s))
+        """THE terminal-memory seam: every terminal state (matched /
+        timeout / shed-evicted / pool expiry) comes through here or
+        through ``_remember_window``, so the journal's TERMINAL record
+        rides the same call — exactly what the ``_recent`` replay cache
+        holds, which is what recovery rebuilds."""
+        expiry = now + self.queue_cfg.dedup_ttl_s
+        self._recent.set(player_id, (body, expiry))
+        if self.journal is not None:
+            self.journal.append_terminal(player_id, body, expiry)
+
+    def _remember_window(self, pairs: "list[tuple[str, bytes]]",
+                         now: float) -> None:
+        """Windowed twin of ``_remember`` (the columnar settle hot path):
+        the whole window's terminals land in the dedup cache AND as ONE
+        journal record — per-player appends cost json+crc+lock each, and
+        on the event loop that was a measurable slice of the journal's
+        steady-state overhead."""
+        if not pairs:
+            return
+        expiry = now + self.queue_cfg.dedup_ttl_s
+        for pid, body in pairs:
+            self._recent.set(pid, (body, expiry))
+        if self.journal is not None:
+            self.journal.append_terminals(
+                [(pid, body, expiry) for pid, body in pairs])
 
     def dedup_cache_size(self) -> int:
         """Public dedup-cache occupancy for observability (/metrics reads
@@ -2357,8 +2792,9 @@ class _QueueRuntime:
                             self.engine.rescan, window, now)
                         self._publish_rescan_outcome(out, now)
                         continue
-            except Exception:
+            except Exception as e:
                 log.exception("rescan failed; reviving engine from mirror")
+                self._note_failure(e)
                 self._record_engine_crash(now)
                 async with self._engine_lock:
                     # _revive_locked, not a bare _revive_engine: the failure
@@ -2379,7 +2815,7 @@ class _QueueRuntime:
             try:
                 while time.monotonic() < deadline:
                     async with self._engine_lock:
-                        self._collect_ready_locked(time.time())
+                        await self._collect_ready_locked(time.time())
                         done = tok not in self.engine.rescan_tokens
                         if self.engine.device_error is not None:
                             err = self.engine.device_error
@@ -2402,8 +2838,9 @@ class _QueueRuntime:
                     self.app.events.append("rescan_overrun",
                                            self.queue_cfg.name,
                                            f"token {tok}")
-            except Exception:
+            except Exception as e:
                 log.exception("rescan failed; reviving engine from mirror")
+                self._note_failure(e)
                 self._record_engine_crash(now)
                 async with self._engine_lock:
                     self._revive_locked(now)
@@ -2791,6 +3228,8 @@ class _QueueRuntime:
             self._rescanner.cancel()
         if self._health is not None:
             self._health.cancel()
+        if self._durability is not None:
+            self._durability.cancel()
         # Drain the batcher BEFORE cancelling the consumer so the final
         # windows can still ack their deliveries; then collect any windows
         # the final flush left in flight.
@@ -2800,6 +3239,31 @@ class _QueueRuntime:
         async with self._engine_lock:
             await self._drain_engine(time.time())
         self.app.broker.basic_cancel(self.consumer_tag)
+        if self.journal is not None:
+            # Clean-shutdown marker, durable: the next boot sees it and
+            # skips crash recovery (its ABSENCE is the crash detector).
+            self.journal.mark_clean()
+            self.journal.close()
+
+    def abandon(self) -> None:
+        """Crash-fidelity teardown (bench --crash-soak / durability
+        tests): cancel the timers and drop the journal WITHOUT a clean
+        marker, drain, or final commit — the on-disk journal state is
+        exactly what a ``kill -9`` would leave. The engine is still
+        closed (device buffers are process resources a soak would
+        otherwise leak across cycles); a real crash frees them with the
+        process."""
+        for task in (self._sweeper, self._rescanner, self._health,
+                     self._durability, self._collector,
+                     self.batcher._task):
+            if task is not None:
+                task.cancel()
+        if self.journal is not None:
+            self.journal.abandon()
+        try:
+            self.engine.close()  # matchlint: ignore[guarded-by] simulated kill -9: every consumer/timer task was just cancelled, nothing else drives this engine again
+        except Exception:
+            log.exception("engine close during simulated crash failed")
 
 
 class MatchmakingApp:
@@ -2895,6 +3359,15 @@ class MatchmakingApp:
             self._runtimes[queue_cfg.name] = rt
             if self.cfg.engine.warm_start:
                 rt.engine.warmup()
+        if self.cfg.durability.enabled():
+            # Hard-crash recovery (ISSUE 15), BEFORE any control plane or
+            # traffic: an unclean predecessor's snapshot + journal tail
+            # replays into each engine, the dedup/replay cache is
+            # restored so broker redeliveries reconcile instead of
+            # double-matching, and the span is recorded as crash_rto_ms.
+            for rt in self._runtimes.values():
+                await rt.recover_from_journal()
+                rt.start_durability_timer()
         if self.placement is not None:
             self.placement.bind_boot_placements()
             self.placement.start()
@@ -2979,6 +3452,28 @@ class MatchmakingApp:
             # than the axis is a config error PlacementState reports.
             return tuple(range(axis))
         return (index % n,)
+
+    async def crash(self) -> None:
+        """Simulated HARD crash (bench --crash-soak / durability tests):
+        tear the process state down with NO drain, NO checkpoints, and NO
+        clean-shutdown journal markers — in-flight windows are dropped,
+        uncommitted journal buffers are lost, consumers die with the
+        broker. What remains on disk is exactly what ``kill -9`` leaves;
+        a successor app pointed at the same journal_dir must recover it."""
+        if not self._started:
+            return
+        if self.placement is not None:
+            await self.placement.stop()
+        if self.autotune is not None:
+            await self.autotune.stop()
+        self._stop_telemetry()
+        if self._observability is not None:
+            await self._observability.stop()
+            self._observability = None
+        for rt in self._runtimes.values():
+            rt.abandon()
+        self.broker.close()
+        self._started = False
 
     async def stop(self) -> None:
         if not self._started:
@@ -3222,7 +3717,18 @@ class MatchmakingApp:
                 continue
             async with rt._engine_lock:
                 await rt._drain_engine(now if now is not None else time.time())
-                counts[name] = load_pool(rt.engine, path, now)
+                try:
+                    counts[name] = load_pool(rt.engine, path, now)
+                except Exception as e:
+                    # A truncated/corrupt pool checkpoint must not crash
+                    # the boot: the queue starts empty (the broker's
+                    # at-least-once redelivery is the backstop) and the
+                    # corruption is speakable in the event timeline.
+                    self.events.append(
+                        "checkpoint_corrupt", name,
+                        f"{os.path.basename(path)}: {e} — starting empty")
+                    log.warning("pool checkpoint %s unreadable (%s); "
+                                "queue %r starts empty", path, e, name)
         # Admission-state sidecar (ISSUE 11 satellite): restore the
         # adaptive credit fraction + shed/expired accounting so the
         # successor's first admission ladder walk is IDENTICAL to what
@@ -3232,7 +3738,18 @@ class MatchmakingApp:
         if os.path.exists(adm_path):
             from matchmaking_tpu.utils.checkpoint import load_admission
 
-            for qname, state in load_admission(adm_path).items():
+            try:
+                restored_adm = load_admission(adm_path)
+            except Exception as e:
+                # CRC/version mismatch (ISSUE 15 satellite): a truncated
+                # sidecar loses only the adaptive admission state, never
+                # the boot.
+                restored_adm = {}
+                self.events.append("checkpoint_corrupt", "",
+                                   f"_admission.json: {e} — skipped")
+                log.warning("admission sidecar %s unreadable: %s",
+                            adm_path, e)
+            for qname, state in restored_adm.items():
                 rt = self._runtimes.get(qname)
                 if rt is not None and rt.admission is not None:
                     rt.admission.restore_state(state)
@@ -3244,7 +3761,14 @@ class MatchmakingApp:
         if os.path.exists(backlog_path):
             from matchmaking_tpu.utils.checkpoint import load_backlog
 
-            per_queue = load_backlog(backlog_path)
+            try:
+                per_queue = load_backlog(backlog_path)
+            except Exception as e:
+                per_queue = {}
+                self.events.append("checkpoint_corrupt", "",
+                                   f"_backlog.json: {e} — skipped")
+                log.warning("backlog sidecar %s unreadable: %s",
+                            backlog_path, e)
             republished = 0
             for qname, rows in per_queue.items():
                 for row in rows:

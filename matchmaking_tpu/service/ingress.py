@@ -96,6 +96,15 @@ class ShardedRecent:
             return len(self._shards[0])
         return sum(len(d) for d in self._shards)
 
+    def items(self):
+        """Every (pid, (body, expiry)) across the shards — the journal
+        compaction's carry walk (ISSUE 15): live dedup entries are
+        re-appended into the fresh segment so the at-least-once horizon
+        survives the truncation. Shard-major order (deterministic: the
+        shard split is a pure hash of the id)."""
+        for d in self._shards:
+            yield from d.items()
+
     def prune(self, now: float) -> None:
         """Drop expired entries (the time-throttled flush-side prune)."""
         for i, d in enumerate(self._shards):

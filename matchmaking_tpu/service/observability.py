@@ -100,6 +100,30 @@ def build_report(app) -> dict[str, Any]:
     }
     if util:
         report["device_util"] = util
+    # Crash durability (ISSUE 15): per-queue journal accounting (live
+    # seq, segment growth, lifetime write amplification) + the last
+    # hard-crash recovery record — the RTO story must be readable from
+    # /metrics alone.
+    durability = {
+        name: {
+            "seq": rt.journal.seq,
+            "synced_seq": rt.journal.synced_seq,
+            "fsync": rt.journal.fsync,
+            "segment_records": rt.journal.segment_records,
+            "segment_bytes": rt.journal.segment_bytes,
+            "bytes_written": rt.journal.bytes_written,
+            "payload_bytes": rt.journal.payload_bytes,
+            "write_amplification": (
+                round(rt.journal.bytes_written
+                      / rt.journal.payload_bytes, 3)
+                if rt.journal.payload_bytes else None),
+            "last_recovery": rt.last_recovery,
+        }
+        for name, rt in app._runtimes.items()
+        if getattr(rt, "journal", None) is not None
+    }
+    if durability:
+        report["durability"] = durability
     # Critical-path attribution + SLO burn state (ISSUE 6).
     attribution = getattr(app, "attribution", None)
     if attribution is not None:
@@ -494,9 +518,25 @@ class ObservabilityServer:
                         if hasattr(rt.engine, "formation_report")
                         else None)) is not None
         }
+        # Device-loss failover audit (ISSUE 15): D -> D-1 demotions with
+        # the measured blackout, plus each queue's LIVE binding — a
+        # failover re-binds behind the controller's back, so the audited
+        # truth lives here whether or not the control plane is enabled.
+        failover = {
+            name: {"binding": (list(rt.placement)
+                               if rt.placement is not None else None),
+                   "demotions": list(rt.failover_log)}
+            for name, rt in self.app._runtimes.items()
+            if rt.failover_log
+        }
         if ctrl is None:
-            if formation:
-                return web.json_response({"formation": formation})
+            if formation or failover:
+                body = {}
+                if formation:
+                    body["formation"] = formation
+                if failover:
+                    body["failover"] = failover
+                return web.json_response(body)
             return web.json_response(
                 {"error": "placement control plane disabled "
                           "(set placement.interval_s)"}, status=404)
@@ -507,6 +547,8 @@ class ObservabilityServer:
         body = ctrl.snapshot(history=history)
         if formation:
             body["formation"] = formation
+        if failover:
+            body["failover"] = failover
         return web.json_response(body)
 
     async def _debug_autotune(self, request) -> "web.Response":

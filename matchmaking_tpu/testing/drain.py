@@ -1,0 +1,33 @@
+"""The deterministic settle predicate shared by soak/bench drains.
+
+The PR 2 soak pattern: instead of a wall-clock sleep racing the pipeline
+(timing-flaky on a loaded 1-core box), poll until every published request
+has FULLY settled — the match-count target reached AND nothing buffered at
+any stage between the broker and the device. The conjunction must name
+every buffering stage the runtime has; when a new stage is added (as the
+journal PR added commit buffering), extend it HERE so every caller —
+``bench.py``'s crash-soak ``quiesce`` and the duplicate-delivery e2e test
+alike — stays drain-exact together.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+__all__ = ["fully_drained"]
+
+
+def fully_drained(app: Any, rt: Any, queue: str,
+                  matched_at_least: int) -> bool:
+    """True once ``matched_at_least`` players have matched AND the whole
+    request path is empty: broker queue drained, delivery handlers idle,
+    batcher backlog cut, no flush in progress, no windows in flight on the
+    device. At that point every duplicate/redelivery has been consumed and
+    its replay response published — the state e2e assertions may read."""
+    return (app.metrics.counters.get("players_matched") >= matched_at_least
+            and app.broker.queue_depth(queue) == 0
+            and app.broker.handlers_idle()
+            and rt.batcher.depth == 0
+            and rt._flushing == 0
+            and (not hasattr(rt.engine, "inflight")
+                 or rt.engine.inflight() == 0))

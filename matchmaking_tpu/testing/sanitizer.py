@@ -41,6 +41,15 @@ service's own ``asyncio.Lock()`` calls come back instrumented:
   directly, so at-least-once redelivery never trips the check — only the
   app's settle seam is audited, which is exactly the static rule's scope,
   measured instead of proved.
+- **journal twin** (ISSUE 15) — the write-ahead pool journal
+  (utils/journal.py) comes back instrumented.  A delivery **acked while
+  its queue's journal holds uncommitted records** (fsync policy ≠
+  ``none``) violates the acked-after-append discipline — the client could
+  see an effect whose journal record a crash would lose; an **identical
+  record appended twice** within one segment is a double-append (replay
+  would apply the mutation twice); an **append after the clean-shutdown
+  marker** voids the crash detector.  All three report with both sites
+  quoted (the first append/marker site and the violating site).
 
 Usage (the ``sanitizer`` fixture in tests/conftest.py wraps this):
 
@@ -214,6 +223,18 @@ class AsyncSanitizer:
         #: the delivery was (re)registered (tags are globally unique —
         #: the in-proc broker draws them from one counter).
         self._settles: dict[int, tuple[str, str]] = {}
+        # ---- journal twin state (ISSUE 15) --------------------------------
+        #: Strong refs to every PoolJournal created while installed
+        #: (id()-key stability — same argument as ``_locks``).
+        self._journal_refs: list[Any] = []
+        #: id(journal) → {(rtype, payload crc32): first append site} for
+        #: the LIVE segment (reset at rotation: compaction legitimately
+        #: carries terminals into the fresh segment).
+        self._journal_seen: dict[int, dict[tuple[int, int], str]] = {}
+        #: id(journal) → site of the clean-shutdown marker append.
+        self._journal_clean: dict[int, str] = {}
+        #: id(journal) → site of the newest still-uncommitted append.
+        self._journal_dirty_site: dict[int, str] = {}
 
     # ---- installation ------------------------------------------------------
 
@@ -223,11 +244,14 @@ class AsyncSanitizer:
         broker's app-facing ack/nack (the settlement twin) — every lock
         and every settle the code under test performs reports here."""
         import contextlib
+        import zlib as _zlib
 
         from matchmaking_tpu.service import broker as _broker_mod
         from matchmaking_tpu.service import overload as _overload_mod
+        from matchmaking_tpu.utils import journal as _journal_mod
 
         san = self
+        _site = lambda: _caller_site(__name__.replace(".", "/"))  # noqa: E731
 
         class _Factory(asyncio.Lock):
             def __new__(cls, *a: Any, **k: Any):
@@ -269,12 +293,80 @@ class AsyncSanitizer:
                 san._settles.pop(delivery.delivery_tag, None)
             orig_requeue(broker, queue, delivery)
 
+        # ---- journal twin (ISSUE 15) --------------------------------------
+        pj = _journal_mod.PoolJournal
+        orig_jinit = pj.__init__
+        orig_jappend = pj._append
+        orig_jcommit = pj.commit
+        orig_jclean = pj.mark_clean
+        orig_jcompact = pj.compact_finish
+
+        def jinit(j, *a: Any, **k: Any) -> None:
+            orig_jinit(j, *a, **k)
+            san._journal_refs.append(j)
+
+        def jappend(j, rtype: int, payload: bytes, logical: int,
+                    writeout: bool = False) -> int:
+            site = _site()
+            clean_site = san._journal_clean.get(id(j))
+            if clean_site is not None:
+                san._report(
+                    "journal-append-after-clean",
+                    ("jclean", j.queue, site),
+                    f"journal for queue {j.queue!r} appended to at {site} "
+                    f"AFTER its clean-shutdown marker was written at "
+                    f"{clean_site} — the marker must be the final record "
+                    f"(boot trusts its presence to skip crash recovery)")
+            if rtype in (_journal_mod.RT_ADMIT, _journal_mod.RT_TERMINAL,
+                         _journal_mod.RT_TERMINALS):
+                key = (rtype, _zlib.crc32(payload))
+                seen = san._journal_seen.setdefault(id(j), {})
+                prev = seen.get(key)
+                if prev is not None:
+                    san._report(
+                        "journal-double-append",
+                        ("jdouble", j.queue, prev, site),
+                        f"identical journal record (type {rtype}) appended "
+                        f"twice in one segment for queue {j.queue!r}: "
+                        f"first at {prev}, again at {site} — replay would "
+                        f"apply the mutation twice")
+                else:
+                    seen[key] = site
+            # writeout appends are never observably buffered (the frame is
+            # os.write'n inside the same lock hold), so they leave no
+            # dirty site — dropping the flag here would both false-flag
+            # concurrent settles and change the on-disk crash shape the
+            # instrumented tests exercise.
+            if not writeout:
+                san._journal_dirty_site[id(j)] = site
+            return orig_jappend(j, rtype, payload, logical, writeout)
+
+        def jcommit(j, force_sync: bool = False) -> None:
+            san._journal_dirty_site.pop(id(j), None)
+            orig_jcommit(j, force_sync)
+
+        def jclean(j) -> None:
+            orig_jclean(j)
+            san._journal_clean[id(j)] = _site()
+            san._journal_dirty_site.pop(id(j), None)
+
+        def jcompact(j, *a: Any, **k: Any) -> None:
+            orig_jcompact(j, *a, **k)
+            # Fresh segment: the dedup key space resets with it (the
+            # rotation wrote the carried terminals directly, not via
+            # _append, so they never collide here).
+            san._journal_seen.pop(id(j), None)
+            san._journal_dirty_site.pop(id(j), None)
+
         @contextlib.contextmanager
         def _cm():
             self._orig_lock = asyncio.Lock
             asyncio.Lock = _Factory  # type: ignore[misc]
             ac.admit, ac.release = admit, release
             br.ack, br.nack, br._requeue = ack, nack, _requeue
+            pj.__init__, pj._append = jinit, jappend
+            pj.commit, pj.mark_clean = jcommit, jclean
+            pj.compact_finish = jcompact
             try:
                 yield self
             finally:
@@ -282,6 +374,9 @@ class AsyncSanitizer:
                 ac.admit, ac.release = orig_admit, orig_release
                 br.ack, br.nack = orig_ack, orig_nack
                 br._requeue = orig_requeue
+                pj.__init__, pj._append = orig_jinit, orig_jappend
+                pj.commit, pj.mark_clean = orig_jcommit, orig_jclean
+                pj.compact_finish = orig_jcompact
 
         return _cm()
 
@@ -293,6 +388,25 @@ class AsyncSanitizer:
         if consumer is None:
             return  # late settle after basic_cancel: documented no-op
         site = _caller_site(__name__.replace(".", "/"))
+        # Journal twin (ISSUE 15): the write-ahead discipline — every
+        # journaled mutation must be COMMITTED (acked-after-append) before
+        # its delivery settles when the fsync policy promises durability.
+        # Runs for every settle, including the first of a tag.
+        qname = getattr(getattr(consumer, "queue", None), "name", None)
+        if qname is not None:
+            for j in self._journal_refs:
+                if j.queue == qname and j.fsync != "none" and j.dirty:
+                    append_site = self._journal_dirty_site.get(
+                        id(j), "<unknown>")
+                    self._report(
+                        "journal-unflushed-settle",
+                        ("jflush", qname, site),
+                        f"delivery tag {delivery_tag} {kind}ed at {site} "
+                        f"while queue {qname!r}'s journal holds "
+                        f"uncommitted record(s) (newest appended at "
+                        f"{append_site}) — the write-ahead discipline "
+                        f"requires commit before settle when "
+                        f"fsync={j.fsync!r}")
         if delivery_tag in consumer.unacked:
             self._settles[delivery_tag] = (kind, site)
             return

@@ -34,6 +34,22 @@ class ChaosInjectedError(RuntimeError):
     """Raised at a scripted chaos fault point (device step / probe)."""
 
 
+class ChaosDeviceLostError(ChaosInjectedError):
+    """A scripted DEVICE-LOSS fault (ISSUE 15): models a mesh participant
+    dying mid-serve — the XLA "device lost / data transfer failed" error
+    class, which a plain revive-from-mirror cannot fix because the rebuilt
+    engine would bind the same dead chip. The queue runtime routes it
+    through the breaker's crash accounting into the failover path (demote
+    a sharded queue to its surviving devices) instead of revive-looping.
+
+    ``device`` is the LOGICAL index within the queue's binding that died
+    (-1 = the last device, the schedule default)."""
+
+    def __init__(self, message: str, device: int = -1):
+        super().__init__(message)
+        self.device = device
+
+
 def _mix(h: int) -> int:
     """splitmix64 finalizer — full-avalanche 64-bit mix."""
     h = (h ^ (h >> 30)) * 0xBF58476D1CE4E5B9 & _MASK
@@ -60,7 +76,7 @@ class EngineChaosHook:
     an engine means no chaos."""
 
     __slots__ = ("cfg", "queue", "events", "steps", "probes", "_fail",
-                 "_ranges")
+                 "_ranges", "_lost")
 
     def __init__(self, cfg: ChaosConfig, queue: str = "", events=None):
         self.cfg = cfg
@@ -73,6 +89,7 @@ class EngineChaosHook:
         self.probes = 0
         self._fail = frozenset(cfg.fail_steps)
         self._ranges = tuple(cfg.fail_step_ranges)
+        self._lost = frozenset(cfg.device_lost_steps)
 
     def on_step(self) -> None:
         """One device SEARCH-step dispatch is about to run. Raises
@@ -80,6 +97,16 @@ class EngineChaosHook:
         BEFORE mutating any state for the chunk."""
         idx = self.steps
         self.steps += 1
+        if idx in self._lost:
+            # Device loss BEFORE the plain step faults: a schedule naming
+            # the same index means the stronger fault (the one the
+            # failover path must absorb) wins.
+            if self.events is not None:
+                self.events.append("chaos_device_lost", self.queue,
+                                   f"step {idx}")
+            raise ChaosDeviceLostError(
+                f"chaos: scripted device loss at step index {idx}",
+                device=self.cfg.device_lost_device)
         if idx in self._fail or any(a <= idx < b for a, b in self._ranges):
             if self.events is not None:
                 self.events.append("chaos_step_fault", self.queue,

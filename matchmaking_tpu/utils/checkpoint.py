@@ -17,6 +17,7 @@ import json
 import os
 import tempfile
 import time
+import zlib
 
 import numpy as np
 
@@ -26,6 +27,32 @@ FORMAT_VERSION = 1
 
 #: Broker-backlog sidecar format (drain handoff of unconsumed deliveries).
 BACKLOG_VERSION = 1
+
+
+def _stamp_crc(payload: dict) -> dict:
+    """Version-stamp + CRC a JSON sidecar payload (ISSUE 15 satellite):
+    ``crc32`` covers the canonical dump of everything else, so a
+    truncated or bit-flipped sidecar is detected at load instead of
+    restoring half a backlog silently."""
+    body = json.dumps({k: v for k, v in payload.items() if k != "crc32"},
+                      sort_keys=True, separators=(",", ":"))
+    payload["crc32"] = zlib.crc32(body.encode("utf-8"))
+    return payload
+
+
+def _check_crc(payload: dict, path: str) -> None:
+    """Verify a sidecar's CRC when present (pre-ISSUE-15 files carry
+    none and load as before)."""
+    crc = payload.get("crc32")
+    if crc is None:
+        return
+    body = json.dumps({k: v for k, v in payload.items() if k != "crc32"},
+                      sort_keys=True, separators=(",", ":"))
+    want = zlib.crc32(body.encode("utf-8"))
+    if want != crc:
+        raise ValueError(
+            f"{path}: sidecar CRC mismatch (stored {crc}, computed {want}) "
+            f"— the file is truncated or corrupt")
 
 
 def save_backlog(path: str, per_queue: "dict[str, list]") -> int:
@@ -56,8 +83,9 @@ def save_backlog(path: str, per_queue: "dict[str, list]") -> int:
         for queue, deliveries in per_queue.items()
     }
     n = sum(len(v) for v in rows.values())
-    payload = {"version": BACKLOG_VERSION, "saved_at": time.time(),
-               "count": n, "queues": rows}
+    payload = _stamp_crc({"version": BACKLOG_VERSION,
+                          "saved_at": time.time(),
+                          "count": n, "queues": rows})
     directory = os.path.dirname(os.path.abspath(path)) or "."
     fd, tmp = tempfile.mkstemp(dir=directory, suffix=".json.tmp")
     try:
@@ -81,6 +109,7 @@ def load_backlog(path: str) -> "dict[str, list[dict]]":
     if payload.get("version") != BACKLOG_VERSION:
         raise ValueError(
             f"unsupported backlog version: {payload.get('version')}")
+    _check_crc(payload, path)
     out: dict[str, list[dict]] = {}
     for queue, rows in payload.get("queues", {}).items():
         out[queue] = [
@@ -106,8 +135,8 @@ def save_admission(path: str, per_queue: "dict[str, dict]") -> int:
     """Serialize per-queue AdmissionController checkpoints (queue →
     controller.checkpoint()) next to the pool checkpoints.  Atomic like
     save_pool.  Returns the number of queues saved."""
-    payload = {"version": ADMISSION_VERSION, "saved_at": time.time(),
-               "queues": per_queue}
+    payload = _stamp_crc({"version": ADMISSION_VERSION,
+                          "saved_at": time.time(), "queues": per_queue})
     directory = os.path.dirname(os.path.abspath(path)) or "."
     fd, tmp = tempfile.mkstemp(dir=directory, suffix=".json.tmp")
     try:
@@ -130,6 +159,7 @@ def load_admission(path: str) -> "dict[str, dict]":
         raise ValueError(
             f"unsupported admission checkpoint version: "
             f"{payload.get('version')}")
+    _check_crc(payload, path)
     return {q: dict(v) for q, v in payload.get("queues", {}).items()}
 
 

@@ -24,9 +24,13 @@ Compared metrics (direction-aware):
                        moves), e2e_matched_per_s, e2e_knee_req_s,
                        e2e_slo_attainment, frontier quality_mean
     lower is better:   p99_ms, e2e_p99_ms, frontier wait_at_match_ms_p99,
-                       frontier quality_disparity, and the placement-soak
+                       frontier quality_disparity, the placement-soak
                        rows (ISSUE 11): placement_blackout_ms_max/mean,
-                       placement_lost, placement_dup
+                       placement_lost, placement_dup, and the crash-soak
+                       rows (ISSUE 15): crash_lost, crash_dup,
+                       crash_rto_ms_max/mean, crash_failover_blackout_ms,
+                       journal_write_amplification,
+                       crash_journal_overhead_frac
 Frontier rows (``e2e_frontier``, ISSUE 8) are matched by threshold.
 Scenario-matrix cells (``scenario_matrix``, ISSUE 13) are matched by
 scenario name — slo_attainment / quality up, admitted_p99_ms / expired
@@ -72,6 +76,19 @@ TOP_LEVEL_METRICS: dict[str, bool] = {
     # back toward the flat O(P) scan (spans too narrow for the live
     # distribution → dense fallbacks).
     "formation_touched_frac": False,
+    # Crash-restart soak (ISSUE 15, bench.py --crash-soak): recovery
+    # accounting regresses downward only. lost/dup have a zero baseline
+    # on a healthy soak, so ANY nonzero fresh value beyond the threshold
+    # regresses (the base==0 rule); the RTO, failover blackout, journal
+    # write amplification, and the fsync=window steady-state append
+    # overhead are all lower-is-better latencies/costs.
+    "crash_lost": False,
+    "crash_dup": False,
+    "crash_rto_ms_max": False,
+    "crash_rto_ms_mean": False,
+    "crash_failover_blackout_ms": False,
+    "journal_write_amplification": False,
+    "crash_journal_overhead_frac": False,
 }
 
 #: Pool-scale sweep rows (ISSUE 14, ``bench.py --pool-scale``), matched

@@ -89,6 +89,39 @@ echo "== bucketed formation =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'bucketed and not slow' \
     --continue-on-collection-errors -p no:cacheprovider
 
+echo "== durability =="
+# ISSUE 15 gate: crash durability. The suite runs by marker first —
+# journal framing/replay (CRC frames, torn tails, clean-marker
+# detection), byte-level corruption fixtures (sidecar CRC, snapshot
+# fallback, compaction crash points), the service hard-crash round trip
+# (zero lost waiting players, redeliveries replay the SAME match), the
+# two-run bit-identical recovery transcript under seeded chaos, the
+# D=2→1 device-loss failover, and the sanitizer's journal twin.
+JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'durability and not slow' \
+    --continue-on-collection-errors -p no:cacheprovider
+# Then a 2-cycle in-process crash-soak smoke through the REAL
+# bench.py --crash-soak path (one run, small load): zero lost, zero dup,
+# and a bounded RTO — the acceptance invariants, seconds-scale.
+python - <<'EOF'
+import json, subprocess, sys
+proc = subprocess.run(
+    [sys.executable, "bench.py", "--crash-soak", "--crash-cycles", "2",
+     "--crash-runs", "1", "--crash-pairs", "3", "--crash-singles", "2",
+     "--crash-overhead-pairs", "60"],
+    capture_output=True, text=True, timeout=600)
+sys.stderr.write(proc.stderr)
+if proc.returncode != 0:
+    sys.exit(f"crash-soak smoke exited {proc.returncode}")
+out = json.loads(proc.stdout.splitlines()[-1])
+print("crash-soak smoke:", json.dumps(out))
+assert out["crash_lost"] == 0, f"lost waiting players: {out['crash_lost']}"
+assert out["crash_dup"] == 0, f"double matches: {out['crash_dup']}"
+assert out["crash_recoveries"] >= 2, out["crash_recoveries"]
+assert out["crash_rto_ms_max"] is not None and \
+    out["crash_rto_ms_max"] < 30_000, f"RTO unbounded: {out['crash_rto_ms_max']}"
+print("crash-soak smoke: OK")
+EOF
+
 echo "== scenario observatory =="
 # ISSUE 13 gate: population-model scenario determinism (bit-identical
 # arrival transcripts, steady ≡ legacy loadgen byte for byte), the

@@ -88,6 +88,14 @@ def pytest_configure(config):
         "occupancy skew, widening boundary, tournament-vs-linear frontier "
         "merge, adaptive frontier-K — scripts/check.sh runs it by marker; "
         "part of tier-1)")
+    config.addinivalue_line(
+        "markers", "durability: crash-durability suite (ISSUE 15: "
+        "write-ahead journal framing/replay, hard-crash recovery edges "
+        "incl. corruption fixtures + compaction crash points, the "
+        "two-run bit-identical recovery transcript, device-loss "
+        "failover, and the sanitizer's journal twin — scripts/check.sh "
+        "runs it by marker plus a 2-cycle crash-soak smoke; part of "
+        "tier-1)")
 
 
 @pytest.fixture
