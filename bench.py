@@ -802,7 +802,7 @@ def bench_quality_frontier(args) -> dict:
     # complete on the CPU-mesh fallback.
     capacity = min(args.capacity, 8192)
 
-    async def point(threshold: float) -> dict:
+    async def point(threshold: float, spec: bool = False) -> dict:
         cfg = Config(
             queues=(QueueConfig(rating_threshold=threshold,
                                 widen_per_sec=widen,
@@ -812,7 +812,11 @@ def bench_quality_frontier(args) -> dict:
                                 pool_block=min(args.pool_block, capacity),
                                 batch_buckets=(16, 64, 256), top_k=8,
                                 pipeline_depth=args.depth,
-                                warm_start=True),
+                                warm_start=True,
+                                # Speculation axis (ISSUE 16): the same
+                                # point with gap-cycle speculation on.
+                                spec_formation=spec,
+                                spec_interval_ms=10.0),
             batcher=BatcherConfig(max_batch=256, max_wait_ms=3.0),
             broker=BrokerConfig(prefetch=8192),
             observability=ObservabilityConfig(snapshot_interval_s=0.0,
@@ -831,10 +835,14 @@ def bench_quality_frontier(args) -> dict:
             await asyncio.to_thread(rt.engine.flush)
         rep = (rt.engine.quality_report()
                if hasattr(rt.engine, "quality_report") else None) or {}
+        sr = (rt.engine.spec_report()
+              if spec and hasattr(rt.engine, "spec_report") else None) or {}
         await app.stop()
         qs = res.get("quality", {})
         return {
             "threshold": threshold,
+            "spec_formation": spec,
+            "spec_hit_rate": sr.get("spec_hit_rate"),
             "widen_per_sec": widen,
             "offered_req_s": rate,
             "matched": qs.get("matched", 0),
@@ -860,6 +868,38 @@ def bench_quality_frontier(args) -> dict:
         log(f"[e2e-quality thr={thr:g}] {row}")
         rows.append(row)
     out: dict = {"e2e_frontier": rows}
+    if args.e2e_quality_spec:
+        # Speculation axis (ISSUE 16 satellite): the SAME sweep with
+        # gap-cycle speculation on, kept in a separate row list so
+        # bench_diff matches spec-on points against spec-on baselines.
+        # The in-run gate: fairness must not pay for the overlap —
+        # per-rating-bucket quality disparity at each threshold with
+        # speculation on must stay within 10% (plus a small absolute
+        # slack for near-zero gaps) of the spec-off point.
+        spec_rows = []
+        for thr in thresholds:
+            row = asyncio.run(point(thr, spec=True))
+            log(f"[e2e-quality thr={thr:g} spec=on] {row}")
+            spec_rows.append(row)
+        out["e2e_frontier_spec"] = spec_rows
+        off_by_thr = {r["threshold"]: r for r in rows}
+        gate: bool | None = None
+        for sr_row in spec_rows:
+            base = off_by_thr.get(sr_row["threshold"])
+            if base is None:
+                continue
+            d_off = base.get("quality_disparity")
+            d_on = sr_row.get("quality_disparity")
+            if d_off is None or d_on is None:
+                continue
+            ok = d_on <= d_off + max(0.10 * d_off, 0.02)
+            gate = ok if gate is None else (gate and ok)
+            if not ok:
+                log(f"[e2e-quality thr={sr_row['threshold']:g}] "
+                    f"disparity regressed with speculation on: "
+                    f"{d_off:.4f} -> {d_on:.4f}")
+        if gate is not None:
+            out["e2e_frontier_spec_disparity_ok"] = gate
     # Monotone-tradeoff flags between the sweep extremes (sorted by
     # threshold): stricter matching must buy a smaller mean rating
     # distance and cost a longer wait, or the frontier didn't trade.
@@ -1161,6 +1201,14 @@ def run_cpu_fallback(args) -> None:
             out.update(bench_quality_frontier(args))
         except Exception as e:
             log(f"[fallback] e2e-quality phase failed: {e!r}")
+    if args.spec_ab:
+        # Turnaround deltas, not absolute throughput — the spec A/B is
+        # meaningful on the CPU mesh too. A failure leaves the spec_*
+        # columns absent; bench_diff skips one-sided metrics.
+        try:
+            out.update(bench_spec_ab(args))
+        except Exception as e:
+            log(f"[fallback] spec-ab phase failed: {e!r}")
     print(json.dumps(out), flush=True)
 
 
@@ -1233,6 +1281,101 @@ def bench_consume_ab(args) -> dict:
             "rate_req_s": float(args.e2e_ab_rate),
             "seconds": float(args.e2e_ab_seconds),
         }}
+
+    return asyncio.run(run())
+
+
+def bench_spec_ab(args) -> dict:
+    """Speculative-formation A/B (ISSUE 16 acceptance; ``--spec-ab``): the
+    SAME seeded offered load through two fresh single-queue apps —
+    ``spec_formation`` on vs off — at a widening-driven operating point
+    (threshold strict at admit, ``widen_per_sec`` grows feasibility while
+    players sit resident, rescan interval deliberately coarse). In the
+    OFF run a pool-resident pair that becomes feasible mid-gap waits for
+    the next rescan tick; in the ON run the gap loop has already
+    precomputed the pairing and the cut commits it in O(delta) — the
+    turnaround (engine-observed wait-at-match) p50/p99 must fall at the
+    SAME offered load and the SAME window wait. The row also records the
+    speculation economics: ``spec_hit_rate`` (validated commits over all
+    speculation outcomes) and ``spec_wasted_step_fraction`` (speculative
+    device steps whose windows were discarded — the overlap price).
+    scripts/bench_diff.py gates all four direction-aware; on a chip-less
+    abort the keys are simply absent and the gate skips them."""
+    import asyncio
+
+    from matchmaking_tpu.config import (
+        BatcherConfig,
+        BrokerConfig,
+        Config,
+        EngineConfig,
+        ObservabilityConfig,
+        QueueConfig,
+    )
+    from matchmaking_tpu.service.app import MatchmakingApp
+    from matchmaking_tpu.service.loadgen import offered_load
+
+    async def one(spec: bool) -> dict:
+        cfg = Config(
+            queues=(QueueConfig(
+                # Strict at admit, feasible while resident: the regime
+                # where gap-cycle speculation has work to steal.
+                rating_threshold=25.0, widen_per_sec=50.0,
+                max_threshold=400.0,
+                # Coarse rescan on BOTH sides — the A/B isolates the
+                # speculative overlap, not a rescan-frequency change.
+                rescan_interval_s=0.5,
+                send_queued_ack=False),),
+            engine=EngineConfig(
+                backend="tpu", pool_capacity=4096, pool_block=1024,
+                batch_buckets=(16, 64, 256), top_k=8,
+                pipeline_depth=min(args.depth, 2), warm_start=True,
+                spec_formation=spec, spec_max_steps=2,
+                spec_interval_ms=10.0),
+            batcher=BatcherConfig(max_batch=256, max_wait_ms=3.0),
+            broker=BrokerConfig(prefetch=8192),
+            observability=ObservabilityConfig(snapshot_interval_s=0.0),
+        )
+        app = MatchmakingApp(cfg)
+        await app.start()
+        rt = app.runtime(cfg.broker.request_queue)
+        res = await offered_load(
+            app, cfg.broker.request_queue, rate=float(args.spec_ab_rate),
+            duration=float(args.spec_ab_seconds), seed=13,
+            quality_stats=True, rating_sigma=200.0)
+        sr = (rt.engine.spec_report()
+              if hasattr(rt.engine, "spec_report") else None) or {}
+        await app.stop()
+        qs = res.get("quality", {})
+        return {
+            "spec_formation": spec,
+            "sent": res.get("sent"),
+            "matched": res.get("players_matched"),
+            "turnaround_ms_p50": qs.get("waited_ms_p50"),
+            "turnaround_ms_p99": qs.get("waited_ms_p99"),
+            "spec_hit": sr.get("spec_hit"),
+            "spec_miss": sr.get("spec_miss"),
+            "spec_wasted": sr.get("spec_wasted"),
+            "spec_hit_rate": sr.get("spec_hit_rate"),
+            "spec_wasted_step_fraction": sr.get(
+                "spec_wasted_step_fraction"),
+        }
+
+    async def run() -> dict:
+        on = await one(True)
+        off = await one(False)
+        return {
+            "e2e_spec_ab": {
+                "on": on, "off": off,
+                "rate_req_s": float(args.spec_ab_rate),
+                "seconds": float(args.spec_ab_seconds),
+            },
+            # Top-level scalars so bench_diff compares them like any
+            # other headline (absent when the phase aborts → skipped).
+            "spec_turnaround_ms_p50": on["turnaround_ms_p50"],
+            "spec_turnaround_ms_p99": on["turnaround_ms_p99"],
+            "spec_hit_rate": on["spec_hit_rate"],
+            "spec_wasted_step_fraction": on["spec_wasted_step_fraction"],
+        }
 
     return asyncio.run(run())
 
@@ -2161,6 +2304,26 @@ def main() -> None:
                         "+ warmups)")
     p.add_argument("--e2e-ab-rate", type=float, default=4000.0,
                    help="offered req/s for the consume-share A/B phase")
+    p.add_argument("--e2e-quality-spec", action="store_true",
+                   help="add the speculation axis to the --e2e-quality "
+                        "frontier (ISSUE 16): rerun every threshold point "
+                        "with spec_formation on (e2e_frontier_spec rows) "
+                        "and gate the per-rating-bucket quality disparity "
+                        "no worse than the spec-off point "
+                        "(e2e_frontier_spec_disparity_ok)")
+    p.add_argument("--spec-ab", action="store_true",
+                   help="speculative-formation A/B phase (ISSUE 16): the "
+                        "same seeded widening-driven load through "
+                        "spec_formation=on and =off apps, recording "
+                        "turnaround p50/p99, spec hit rate, and the "
+                        "wasted-step fraction (e2e_spec_ab + top-level "
+                        "spec_* columns gated by scripts/bench_diff.py)")
+    p.add_argument("--spec-ab-rate", type=float, default=600.0,
+                   help="offered req/s for the spec A/B phase (low on "
+                        "purpose: idle window gaps are the regime the "
+                        "speculative overlap exists to fill)")
+    p.add_argument("--spec-ab-seconds", type=float, default=4.0,
+                   help="duration of each spec A/B leg")
     p.add_argument("--e2e-sweep-seconds", type=float, default=4.0,
                    help="duration of each saturation-sweep step")
     p.add_argument("--e2e-slo-ms", type=float, default=250.0,
@@ -2446,6 +2609,13 @@ def main() -> None:
             e2e.update(bench_consume_ab(args))
         except Exception as e:
             log(f"[e2e-consume-ab] failed: {e!r}")
+    if args.spec_ab:
+        try:
+            e2e.update(bench_spec_ab(args))
+        except Exception as e:
+            # Aborts (chip-less boxes included) leave the spec_* columns
+            # absent — bench_diff skips metrics missing on either side.
+            log(f"[spec-ab] failed: {e!r}")
     mp = {}
     if not args.skip_multiproc:
         try:

@@ -43,6 +43,7 @@ RULES = (
     "lock-pairing",
     "device",
     "stale-ignore",
+    "speculation",
 )
 
 

@@ -38,6 +38,7 @@ from matchmaking_tpu.analysis import (
     locks,
     perf,
     recompile,
+    speculation,
 )
 from matchmaking_tpu.analysis.core import (
     Finding,
@@ -53,13 +54,13 @@ from matchmaking_tpu.analysis.core import (
 )
 
 #: Bump to invalidate every cache entry when rule semantics change.
-ANALYZER_VERSION = "2.1"
+ANALYZER_VERSION = "2.2"
 
 #: Per-file rule-module checkers (run per SourceFile; locks additionally
 #: takes the cross-file contract registry).
 _PER_FILE_CHECKS = (blocking.check, determinism.check, perf.check,
                     lifecycle.check, device_audit.check_static,
-                    recompile.check_static)
+                    recompile.check_static, speculation.check)
 
 
 def _check_file(sf: SourceFile, external) -> list[Finding]:

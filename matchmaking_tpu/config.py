@@ -230,6 +230,25 @@ class EngineConfig:
     #: so a delegated queue with ``rescan_interval_s=0`` still re-promotes
     #: once its wildcards drain (ADVICE round-5 #3).
     health_interval_s: float = 1.0
+    #: Speculative formation (ISSUE 16): spend idle window-gap device
+    #: cycles precomputing pool-resident pairings (an ahead-of-time rescan
+    #: tick over the resident pool), then commit the precomputed window in
+    #: O(delta) at the next cut — or discard and fall back bit-exactly to
+    #: the full step when any pool mutation invalidated the basis. Off by
+    #: default: it trades wasted speculative steps (free on an idle device)
+    #: for turnaround latency, which only pays on gappy traffic.
+    spec_formation: bool = False
+    #: Max chained speculative steps per gap (each runs on the previous
+    #: speculative pool; matched-slot re-selection is a device-side no-op).
+    spec_max_steps: int = 2
+    #: Staleness bound (ms): a speculation older than this at commit time
+    #: is discarded even if no mutation invalidated it — with widening on,
+    #: a committed window is "the rescan evaluated at speculation time",
+    #: and this caps how far in the past that evaluation may sit.
+    spec_staleness_ms: float = 500.0
+    #: Gap-poll cadence for the service speculation loop (ms; 0 disables
+    #: the loop even with spec_formation on — cut-path commit still runs).
+    spec_interval_ms: float = 10.0
 
 
 @dataclass(frozen=True)

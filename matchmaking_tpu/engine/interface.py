@@ -191,6 +191,54 @@ class Engine(abc.ABC):
         on an unhealthy backend. Default: host engines have no device path
         to check, so they are always healthy."""
 
+    # ---- speculative formation (ISSUE 16) ---------------------------------
+    # The speculation seam: precompute a pool-resident formation window in
+    # the gap between cuts, validate it against the pool-mutation delta at
+    # the cut, and commit in O(delta) — or discard and run the full step
+    # bit-exactly. Engines without a speculation path inherit these no-ops,
+    # so CpuEngine (the oracle) and ShardedEngine stay comparable: with
+    # speculation structurally absent, both sides of an A-B run the exact
+    # same code.
+
+    def speculate(self, now: float) -> bool:
+        """Run up to one speculative formation step against the CURRENT
+        pool state without mutating it, stamping the result with a basis
+        token (the pool-mutation sequence at snapshot time). Returns True
+        when a speculation is now pending. Default: no speculation path."""
+        return False
+
+    def spec_validate(self, now: float, max_age_s: float = 0.0) -> "int | None":
+        """Validate the pending speculation against the mutation delta:
+        returns its basis token iff the pool is bit-identical to the
+        snapshot the speculation was computed from (and, when
+        ``max_age_s`` > 0, the speculation is younger than that bound) —
+        else discards it and returns None. O(1): a sequence compare, never
+        a pool scan. Default: nothing pending."""
+        return None
+
+    def spec_commit(self, token: int, now: float) -> "int | None":
+        """Commit the validated speculation as a real window: adopt the
+        precomputed pool state and submit the precomputed outcome through
+        the normal collection path. ``token`` MUST be the value
+        ``spec_validate`` just returned with no pool mutation in between
+        (enforced: a stale token raises). Returns the submitted window
+        token, or None when nothing was pending. Default: nothing to
+        commit."""
+        return None
+
+    def spec_invalidate(self, reason: str = "external") -> None:
+        """Discard any pending speculation (drain, checkpoint/restore,
+        journal replay, placement migration). Safe to call at any time;
+        players are untouched — speculation holds no exclusive state.
+        Default: nothing pending."""
+
+    def spec_report(self) -> "dict | None":
+        """Speculation accounting (``spec_hit``/``spec_miss``/
+        ``spec_wasted``/``spec_steps``), or None when this engine has no
+        speculation path. Lock-free monotone-counter reads, like
+        ``quality_report``."""
+        return None
+
     def pool_tier_counts(self, n_tiers: int) -> "list[int] | None":
         """Waiting players per QoS tier (len ``n_tiers``), or None when
         this engine does not track tiers — admission then counts every

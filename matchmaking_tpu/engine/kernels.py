@@ -352,6 +352,15 @@ class KernelSet:
                                   skip_filters=True), donate_argnums=0)
             self.search_step_packed_rescan = jax.jit(
                 self._rescan_step_packed_bucketed, donate_argnums=0)
+            # Speculative-formation twin (ISSUE 16): the SAME rescan trace
+            # jitted WITHOUT donation — the caller's input pool handle must
+            # survive as the bit-exact fallback basis while the speculative
+            # output pool waits for cut-time validation. Identical math to
+            # search_step_packed_rescan (donation changes buffer reuse, not
+            # results), which is what the commit-equals-cold-rescan proof
+            # leans on.
+            self.search_step_packed_spec = jax.jit(
+                self._rescan_step_packed_bucketed)
             self.index_rebuild = jax.jit(self._index_rebuild,
                                          donate_argnums=0)
             return
@@ -380,6 +389,9 @@ class KernelSet:
         # overlap in-flight windows AND span multiple chunks safely.
         self.search_step_packed_rescan = jax.jit(
             self._search_step_packed_rescan, donate_argnums=0)
+        # Non-donated speculative twin — see the bucketed branch note.
+        self.search_step_packed_spec = jax.jit(
+            self._search_step_packed_rescan)
 
     def _search_step_packed(self, pool, packed, skip_filters: bool = False):
         """Packed window step: batch rows per pool.PACKED_ROWS plus a 9th row

@@ -17,7 +17,7 @@ Concurrency contract (what matchlint's guarded-by rule enforces on the
 SERVICE side): this engine has NO internal locks and must only be driven
 with the owning queue runtime's ``_engine_lock`` held — every public
 entry (search*/rescan*/collect_ready/flush/expire/remove/restore/
-heartbeat) mutates the mirror and the token books (``_pending``,
+heartbeat/speculate/spec_*) mutates the mirror and the token books (``_pending``,
 ``_open``, ``failed_tokens``, ``rescan_tokens``, ``window_marks``)
 unguarded, and the host-sync readbacks in here (``np.asarray`` on device
 handles in ``_materialize``, ``block_until_ready`` in warmup/probe) are
@@ -163,6 +163,34 @@ class _Pending:
     marks: list[tuple[str, float]] = field(default_factory=list)
 
 
+@dataclass
+class _Speculation:
+    """One precomputed speculative formation window (ISSUE 16), held OFF
+    the books until cut-time validation: no mirror mutation, no _Pending,
+    no token — only device handles. ``pool`` is the post-step device pool
+    produced by the NON-donated spec step, so the engine's live
+    ``_dev_pool`` handle stays valid as the bit-exact fallback basis; a
+    commit adopts ``pool`` in O(1), a discard drops the handles and the
+    only cost was idle-gap device cycles."""
+
+    #: ``TpuEngine.pool_mutations`` at snapshot time — the validation
+    #: token: the speculation is committable iff the counter still matches
+    #: (O(1) — a sequence compare, never a pool scan).
+    basis_seq: int
+    #: The ``now`` every speculative step was evaluated at: a committed
+    #: window is bit-identical to rescan ticks issued at this timestamp.
+    spec_now: float
+    #: time.time() at snapshot (the committed window's spec_snapshot mark).
+    wall_t: float
+    #: Post-step device pool (non-donated outputs, adopted at commit).
+    pool: Any
+    #: _Pending-shaped chunks: ((cols, slots), (out_handle,), spec_now).
+    chunks: list[tuple[Any, tuple[Any, ...], float]]
+    steps: int
+    lanes_valid: int = 0
+    lanes_padded: int = 0
+
+
 # The module docstring's concurrency contract, machine-checkable (PR 4
 # carry-over): this engine has NO internal locks — every public entry must
 # be driven with the owning queue runtime's _engine_lock held. The
@@ -170,7 +198,7 @@ class _Pending:
 # under the GIL, no mirror mutation) the service uses off-lock: admission
 # occupancy, backpressure polling, /metrics scrapes.
 # externally-serialized-by: _engine_lock
-# lock-free: pool_size, inflight, pool_tier_counts, deadline_count, util_report, span_report, quality_report, formation_report
+# lock-free: pool_size, inflight, pool_tier_counts, deadline_count, util_report, span_report, quality_report, formation_report, spec_report
 class TpuEngine(Engine):
     def __init__(self, cfg: Config, queue: QueueConfig,
                  devices: "tuple[int, ...] | None" = None):
@@ -414,6 +442,21 @@ class TpuEngine(Engine):
         #: so a tick dispatches at most pipeline_depth chunks and the
         #: oldest-first selection covers the rest on later ticks.
         self._rescan_chunk_cap = max(1, cfg.engine.pipeline_depth)
+        #: Speculative formation (ISSUE 16). ``pool_mutations`` is the
+        #: monotone validation clock: bumped by every operation that
+        #: changes pool CONTENT or donates ``_dev_pool`` buffers (a
+        #: non-donated jit may alias pass-through pool fields to its
+        #: input's buffers, so a later donation of ``_dev_pool`` could
+        #: invalidate a held speculative pool — ``_pool_mutated`` discards
+        #: the speculation BEFORE any such call). ``_spec_validated_seq``
+        #: is the freshness stamp ``spec_validate`` sets and every
+        #: mutation clears: ``spec_commit`` refuses a token that was not
+        #: validated after the last mutation (the commit-without-validate
+        #: / validate-after-mutate orderings the speculation matchlint
+        #: rule and the sanitizer twin catch).
+        self.pool_mutations = 0
+        self._spec: _Speculation | None = None
+        self._spec_validated_seq: "int | None" = None
         #: Chaos fault hook (utils/chaos.py EngineChaosHook), attached by
         #: the queue runtime AFTER construction — the hook (and its step
         #: counters) outlives this engine instance across revives. None =
@@ -673,6 +716,8 @@ class TpuEngine(Engine):
                 seen_ids.add(req.id)
                 fresh.append(req)
 
+        if fresh:
+            self._pool_mutated()  # admission + donating step ahead
         max_bucket = self.buckets[-1]
         for start in range(0, len(fresh), max_bucket):
             self._dispatch(fresh[start:start + max_bucket], now, pending)
@@ -715,6 +760,8 @@ class TpuEngine(Engine):
             cols = cols.take(keep)
         self.spans["dedupe_s"] += time.perf_counter() - _t
 
+        if len(cols):
+            self._pool_mutated()  # admission + donating step ahead
         max_bucket = self.buckets[-1]
         for start in range(0, len(cols), max_bucket):
             self._dispatch_cols(cols.slice(start, start + max_bucket), now, pending)
@@ -799,6 +846,7 @@ class TpuEngine(Engine):
                            created=time.perf_counter())
         pending.columnar = empty_columnar_outcome()
         self._next_token += 1
+        self._pool_mutated()  # donating rescan steps ahead
 
         t0 = self._rel_base(now)
         top = self.buckets[-1]
@@ -860,6 +908,236 @@ class TpuEngine(Engine):
         self._submit(pending)
         return pending.token
 
+    # ---- speculative formation (ISSUE 16) ---------------------------------
+    # Between cut windows the device sits idle (util_report's idle
+    # fraction) while turnaround p50 is pinned to window cadence.
+    # speculate() spends those cycles running the no-admission rescan step
+    # — through a NON-donated jit of the same trace — over the resident
+    # pool, holding the result handles and the post-step pool WITHOUT
+    # touching the mirror, the token books, or ``_dev_pool``. At the next
+    # cut the service validates in O(1) (mutation-sequence compare +
+    # staleness bound) and either commits — adopt the precomputed pool,
+    # submit the held chunks as a normal rescan-family window, O(delta):
+    # the delta admits ride their own traffic window on the adopted pool —
+    # or discards, in which case the full step runs on the untouched
+    # ``_dev_pool`` bit-exactly as if no speculation ever happened.
+    #
+    # Bit-exactness of the commit path: the spec step is the SAME jitted
+    # computation as search_step_packed_rescan (donation changes buffer
+    # reuse, not math), its inputs are the same mirror columns and device
+    # pool a cold rescan_async at ``spec_now`` would read, and validation
+    # guarantees zero pool mutations since the snapshot — so a committed
+    # speculation IS the rescan tick evaluated at ``spec_now``, chunk for
+    # chunk, bit for bit (the equivalence soak in tests/test_speculation.py
+    # pins this).
+
+    def _pool_mutated(self) -> None:
+        """Advance the validation clock and discard any pending
+        speculation. MUST run before every operation that changes pool
+        content or donates ``_dev_pool`` buffers (see __init__ note);
+        zero-effect sweeps return early without calling this, so an idle
+        pool keeps its speculation across expiry ticks."""
+        self.pool_mutations += 1
+        self._spec_validated_seq = None
+        if self._spec is not None:
+            self._spec = None
+            self.counters["spec_wasted"] = (
+                self.counters.get("spec_wasted", 0) + 1)
+
+    def speculate(self, now: float) -> bool:
+        """Precompute up to ``spec_max_steps`` chained no-admission
+        formation steps over the resident pool (tier/deadline-ordered
+        selection, same budget as a rescan tick) and park the result as
+        the pending speculation. Chained steps run on the previous step's
+        output pool at the SAME ``now`` — matched slots are device-active
+        no-ops, leftover lanes get further pairing rounds — so a commit
+        equals ``steps`` rescan ticks at ``spec_now``. No engine state is
+        mutated; returns True when a speculation is pending (already or
+        newly). Exempt from the chaos step hook like admit/evict: a
+        discarded speculation is always safe, so there is no crash-path
+        state to exercise."""
+        ec = self.cfg.engine
+        if not ec.spec_formation:
+            return False
+        if self._team_device or self._team_delegate is not None:
+            return False
+        spec_step = getattr(self.kernels, "search_step_packed_spec", None)
+        if spec_step is None or self._dev_pool is None:
+            return False
+        if self._spec is not None:
+            return True
+        pool = self.pool
+        if len(pool) < 2:
+            return False
+        max_window = self._rescan_chunk_cap * self.buckets[-1]
+        slots_all = pool.waiting_slots()
+        if slots_all.size > max_window:
+            # Same EDF-flavored pick as rescan_async: near-deadline
+            # low-tier waiters speculate first.
+            enq = pool.m_enqueued[slots_all]
+            dl = pool.m_deadline[slots_all]
+            order = np.lexsort((enq, np.where(dl > 0.0, dl, np.inf),
+                                pool.m_tier[slots_all]))[:max_window]
+            chosen = np.sort(slots_all[order]).astype(np.int32)
+        else:
+            chosen = np.sort(slots_all).astype(np.int32)
+        t0 = self._rel_base(now)
+        top = self.buckets[-1]
+        packed_chunks: list[tuple[Any, Any, int]] = []
+        for start in range(0, chosen.size, top):
+            slots = chosen[start:start + top]
+            cols = RequestColumns(
+                ids=pool.m_id[slots].copy(),
+                rating=pool.m_rating[slots].copy(),
+                rd=pool.m_rd[slots].copy(),
+                region=pool.m_region[slots].copy(),
+                mode=pool.m_mode[slots].copy(),
+                threshold=pool.m_threshold[slots].copy(),
+                enqueued_at=pool.m_enqueued[slots].copy(),
+                reply_to=pool.m_reply[slots].copy(),
+                correlation_id=pool.m_corr[slots].copy(),
+            )
+            bucket = self._bucket_for(slots.size)
+            batch = pool.batch_arrays_cols(cols, slots, bucket, t0)
+            packed_chunks.append(
+                ((cols, slots), jnp.asarray(pack_batch(batch, now - t0)),
+                 bucket))
+        dev_pool = self._dev_pool  # non-donated: this handle stays live
+        chunks: list[tuple[Any, tuple[Any, ...], float]] = []
+        lanes_valid = lanes_padded = steps = 0
+        for _pass in range(max(1, ec.spec_max_steps)):
+            for payload, packed_dev, bucket in packed_chunks:
+                dev_pool, out = spec_step(dev_pool, packed_dev)
+                chunks.append((payload, (out,), now))
+                lanes_valid += int(payload[1].size)
+                lanes_padded += bucket
+                steps += 1
+        self.counters["spec_steps"] = (
+            self.counters.get("spec_steps", 0) + steps)
+        self._spec = _Speculation(
+            basis_seq=self.pool_mutations, spec_now=now, wall_t=time.time(),
+            pool=dev_pool, chunks=chunks, steps=steps,
+            lanes_valid=lanes_valid, lanes_padded=lanes_padded)
+        return True
+
+    def spec_validate(self, now: float, max_age_s: float = 0.0) -> "int | None":
+        """O(1) cut-time validation: the pending speculation's basis
+        sequence must equal the live mutation clock (every admit/evict/
+        expire/remove/restore/rebuild bumps it) and, when ``max_age_s`` >
+        0, the snapshot must be younger than the bound (with widening on,
+        a committed window is the rescan evaluated at ``spec_now`` — the
+        bound caps how stale that evaluation may be). Failure discards the
+        speculation (spec_miss) and returns None; success stamps the
+        freshness token spec_commit requires."""
+        s = self._spec
+        if s is None:
+            return None
+        if (s.basis_seq != self.pool_mutations
+                or (max_age_s > 0.0 and now - s.spec_now > max_age_s)):
+            self._spec = None
+            self._spec_validated_seq = None
+            self.counters["spec_miss"] = (
+                self.counters.get("spec_miss", 0) + 1)
+            return None
+        self._spec_validated_seq = s.basis_seq
+        return s.basis_seq
+
+    def spec_commit(self, token: int, now: float) -> "int | None":
+        """Commit the validated speculation as a real rescan-family
+        window: adopt the precomputed pool (O(1) — the old ``_dev_pool``
+        handle is dropped, and nothing else referenced it), submit the
+        held chunks as a normal _Pending, and register the token in
+        ``rescan_tokens`` so the shared collector publishes the matches
+        through the rescan path. ``token`` must be the value
+        ``spec_validate`` returned with NO pool mutation in between — a
+        stale or unvalidated token raises (the invariant the speculation
+        lint rule + sanitizer twin enforce at call sites)."""
+        s = self._spec
+        if s is None:
+            if token is None:
+                return None  # nothing pending, nothing claimed — no-op
+            # The caller holds a token but the speculation is gone: a pool
+            # mutation slipped between spec_validate and spec_commit (the
+            # validate-after-mutate ordering). Raising makes the ordering
+            # bug deterministic instead of a silent dropped commit.
+            raise RuntimeError(
+                f"spec_commit token {token} refers to a discarded "
+                f"speculation (pool_mutations={self.pool_mutations}) — a "
+                f"pool mutation ran between spec_validate and spec_commit")
+        if (self._spec_validated_seq is None
+                or token != self._spec_validated_seq
+                or token != s.basis_seq
+                or token != self.pool_mutations):
+            raise RuntimeError(
+                f"spec_commit token {token} is not freshly validated "
+                f"(validated={self._spec_validated_seq}, "
+                f"basis={s.basis_seq}, pool_mutations="
+                f"{self.pool_mutations}) — call spec_validate immediately "
+                f"before spec_commit with no pool mutation in between")
+        self._spec = None
+        self._spec_validated_seq = None
+        self.pool_mutations += 1  # the commit itself changes pool content
+        self._dev_pool = s.pool
+        pending = _Pending(token=self._next_token,
+                           created=time.perf_counter())
+        pending.columnar = empty_columnar_outcome()
+        pending.marks.append(("spec_snapshot", s.wall_t))
+        pending.marks.append(("spec_commit", time.time()))
+        self._next_token += 1
+        pending.chunks = list(s.chunks)
+        if self._quality is not None:
+            # Exact despite running post-adoption: the accumulator reads
+            # only pool columns admission writes (rating/enqueue_t/
+            # threshold) — match steps flip ``active`` alone, so the
+            # adopted pool's columns equal the snapshot's bit for bit.
+            for _payload, (out,), t in s.chunks:
+                self._quality_accum_dispatch(out, t)
+        self.util["lanes_valid"] += s.lanes_valid
+        self.util["lanes_padded"] += s.lanes_padded
+        self.counters["spec_hit"] = self.counters.get("spec_hit", 0) + 1
+        self.counters["spec_committed_steps"] = (
+            self.counters.get("spec_committed_steps", 0) + s.steps)
+        self._submit(pending)
+        self.rescan_tokens.add(pending.token)
+        return pending.token
+
+    def spec_invalidate(self, reason: str = "external") -> None:
+        """Discard the pending speculation without advancing the mutation
+        clock — the drain/checkpoint/restore/migration/revive hook. The
+        held players are untouched (speculation owns no mirror state), so
+        cancellation can never lose a player."""
+        if self._spec is not None:
+            self._spec = None
+            self.counters["spec_wasted"] = (
+                self.counters.get("spec_wasted", 0) + 1)
+        self._spec_validated_seq = None
+
+    def spec_report(self) -> "dict | None":
+        """Speculation accounting (lock-free monotone-counter reads, like
+        util_report): hit/miss/wasted outcomes, step totals, and the
+        wasted-step fraction the bench A-B records."""
+        if (self._team_device
+                or not hasattr(self.kernels, "search_step_packed_spec")):
+            return None
+        c = self.counters
+        hits = c.get("spec_hit", 0)
+        miss = c.get("spec_miss", 0)
+        wasted = c.get("spec_wasted", 0)
+        steps = c.get("spec_steps", 0)
+        committed = c.get("spec_committed_steps", 0)
+        return {
+            "spec_hit": hits,
+            "spec_miss": miss,
+            "spec_wasted": wasted,
+            "spec_steps": steps,
+            "spec_committed_steps": committed,
+            "spec_pending": int(self._spec is not None),
+            "spec_hit_rate": round(
+                hits / max(1, hits + miss + wasted), 6),
+            "spec_wasted_step_fraction": round(
+                (steps - committed) / max(1, steps), 6),
+        }
+
     def intern_columns(self, regions, modes) -> tuple[np.ndarray, np.ndarray]:
         """str sequences → interned int32 code arrays (pool-owned interners)."""
         rc, mc = self.pool.regions.code, self.pool.modes.code
@@ -881,6 +1159,8 @@ class TpuEngine(Engine):
                 seen.add(pid)
         if not keep.all():
             cols = cols.take(keep)
+        if len(cols):
+            self._pool_mutated()  # re-admission mutates pool + donates
         bucket = self.buckets[-1]
         t0 = self._rel_base(now)
         for start in range(0, len(cols), bucket):
@@ -969,6 +1249,14 @@ class TpuEngine(Engine):
             "lanes_padded": lanes_padded,
             "effective_occupancy": round(
                 lanes_valid / max(1, lanes_padded), 6),
+            # Commit-path share (ISSUE 16): fraction of finalized windows
+            # that were speculative commits — the direct read on how much
+            # of the window stream the idle-gap precompute carried.
+            # Committed windows finalize through the normal collect path,
+            # so they are counted in spans["windows"] like any other.
+            "spec_commit_share": round(
+                self.counters.get("spec_hit", 0)
+                / max(1, self.spans["windows"]), 6),
         }
 
     # ---- hierarchical formation accounting (ISSUE 14) ---------------------
@@ -1170,6 +1458,7 @@ class TpuEngine(Engine):
         if slot is None:
             return None
         req = self.pool.request_at(slot)
+        self._pool_mutated()
         self.pool.release([slot])
         ev = np.full(self.kernels.evict_bucket, self.kernels.capacity, np.int32)
         ev[0] = slot
@@ -1195,7 +1484,8 @@ class TpuEngine(Engine):
         enq = self.pool.m_enqueued[slots]
         expired_slots = slots[(enq != 0.0) & (now - enq > timeout)]
         if expired_slots.size == 0:
-            return []
+            return []  # zero-effect sweep: speculation stays valid
+        self._pool_mutated()
         reqs = [self.pool.request_at(int(s)) for s in expired_slots]
         self.pool.release(expired_slots)
         eb = self.kernels.evict_bucket
@@ -1230,7 +1520,8 @@ class TpuEngine(Engine):
         dl = self.pool.m_deadline[slots]
         expired_slots = slots[(dl != 0.0) & (now >= dl)]
         if expired_slots.size == 0:
-            return []
+            return []  # zero-effect sweep: speculation stays valid
+        self._pool_mutated()
         reqs = [self.pool.request_at(int(s)) for s in expired_slots]
         self.pool.release(expired_slots)
         eb = self.kernels.evict_bucket
@@ -1272,6 +1563,8 @@ class TpuEngine(Engine):
             self._team_delegate.restore(requests, now)
             return
         fresh = [r for r in requests if r.id not in self.pool]
+        if fresh:
+            self._pool_mutated()  # re-admission mutates pool + donates
         bucket = self.buckets[-1]
         for start in range(0, len(fresh), bucket):
             chunk = fresh[start:start + bucket]
@@ -1469,10 +1762,16 @@ class TpuEngine(Engine):
         if self._team_delegate is not None:
             return
         assert self._open == 0, "warmup() with windows in flight"
+        self._pool_mutated()  # warmup steps donate _dev_pool buffers
         variants = [self.kernels.search_step_packed]
-        for name in ("search_step_packed_nofilter",
-                     "search_step_packed_rescan",
-                     "search_step_packed_ring"):
+        names = ["search_step_packed_nofilter",
+                 "search_step_packed_rescan",
+                 "search_step_packed_ring"]
+        if self.cfg.engine.spec_formation:
+            # The non-donated speculative twin is its own executable
+            # (aliasing differs) — warm it only when speculation can run.
+            names.append("search_step_packed_spec")
+        for name in names:
             fn = getattr(self.kernels, name, None)
             if fn is not None:
                 variants.append(fn)
@@ -1514,6 +1813,7 @@ class TpuEngine(Engine):
         stream, so fault soaks can pin probe-failure backoff."""
         if self.chaos_hook is not None:
             self.chaos_hook.on_probe()
+        self._pool_mutated()  # the probe step donates _dev_pool buffers
         batch = self.pool.batch_arrays([], [], self.buckets[0])
         self._dev_pool, out = self._step_fn(batch)(
             self._dev_pool, jnp.asarray(self._pack(batch, 0.0)))
@@ -1536,6 +1836,7 @@ class TpuEngine(Engine):
             return self._maybe_repromote_team(now)
         if (self._dev_pool is not None
                 and getattr(self.kernels, "bucketed", False)):
+            self._pool_mutated()  # rebuild donates _dev_pool buffers
             self._dev_pool = self.kernels.index_rebuild(self._dev_pool)
         return False
 
@@ -1740,6 +2041,7 @@ class TpuEngine(Engine):
                             w = (max(0.0, now - r.enqueued_at)
                                  if r.enqueued_at else 0.0)
                             acc.append((r.rating, qual, w, d))
+                self._pool_mutated()
                 self.pool.release(qs_l)
                 self.pool.release(cs_l)
             for req in window:
@@ -1822,6 +2124,7 @@ class TpuEngine(Engine):
                         wait_s=np.concatenate([wait_a, wait_b]),
                         spread=np.concatenate([d, d]))
                 matched = np.concatenate([qs, cs])
+                self._pool_mutated()
                 pool.release(matched)
                 queued_ids = cols.ids[~np.isin(slots, matched)]
             else:

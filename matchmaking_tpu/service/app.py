@@ -278,6 +278,20 @@ class _QueueRuntime:
             # breaker to probe and no delegate to re-promote, so the timer
             # would just contend on the engine lock every tick for nothing.
             self._health = asyncio.create_task(self._health_loop())
+        #: Speculative formation driver (ISSUE 16): fills idle window gaps
+        #: with precomputed no-admission pairing steps over the resident
+        #: pool; the cut (traffic dispatch / rescan tick / next spec tick)
+        #: validates the speculation against the mutation clock and commits
+        #: it in O(1) or discards it. Pipelined 1v1 device queues only —
+        #: the commit path rides the pipelined collector, and team windows
+        #: delegate formation where no speculative twin exists.
+        self._spec_task: asyncio.Task | None = None
+        if (app.cfg.engine.spec_formation
+                and app.cfg.engine.spec_interval_ms > 0
+                and self._pipelined
+                and queue_cfg.team_size == 1
+                and not queue_cfg.role_slots):
+            self._spec_task = asyncio.create_task(self._spec_loop())
         #: Journal compaction timer (ISSUE 15): checks wants_compact() on
         #: its cadence and runs snapshot + segment rotation off the hot
         #: path, under the engine lock with the pipeline drained. NOT
@@ -729,6 +743,11 @@ class _QueueRuntime:
         t0 = time.perf_counter()
         now = time.time()
         async with self._engine_lock:
+            # Journal replay is an invalidation path in the speculation
+            # contract (ISSUE 16): recovery rebuilds the pool from the
+            # WAL, so any speculation is against a pool that never was.
+            if hasattr(self.engine, "spec_invalidate"):
+                self.engine.spec_invalidate("journal replay")
 
             def apply() -> tuple[int, int]:
                 n_snap = 0
@@ -2027,6 +2046,16 @@ class _QueueRuntime:
                                 deliveries_in = [d for _, d in pairs]
                                 if not pairs:
                                     return
+                # Speculative cut (ISSUE 16): commit-or-discard the gap's
+                # precomputed pairing window BEFORE the traffic step
+                # donates the pool. Validation is an O(1) mutation-clock
+                # compare; on a hit the precomputed matches enter the
+                # pipelined stream as a rescan-family window (the shared
+                # collector publishes them), and [commit S; step W] is
+                # bit-equal to [rescan at t_spec; step W]. On a miss the
+                # traffic step below IS the full-step fallback — nothing
+                # to recompute, only idle-gap work was discarded.
+                self._spec_cut_locked(now)
                 # Cross-queue EDF arbitration (ISSUE 11): while the
                 # placement controller co-locates queues on this device,
                 # the dispatch call waits its (tier, deadline) turn
@@ -2260,6 +2289,12 @@ class _QueueRuntime:
         """Flush every in-flight window and handle its outcome. Caller holds
         _engine_lock. Restores the ``_open == 0`` invariant rescan/expire/
         remove/checkpoint require."""
+        # Speculation dies at every drain chokepoint (ISSUE 16): the
+        # callers are about to mutate, checkpoint, migrate, or revive —
+        # a speculative pool committed after a checkpoint walk would
+        # double-match players the snapshot still holds as waiting.
+        if hasattr(self.engine, "spec_invalidate"):
+            self.engine.spec_invalidate("drain")
         if not self._pipelined:
             return
         if self.engine.inflight() > 0:
@@ -2274,6 +2309,11 @@ class _QueueRuntime:
         failure flags, then rebuild from the mirror. The single place the
         revive-completion sequence lives — three paths (drain, dispatch
         crash, collector tick) all come through here."""
+        # The mirror rebuild replaces the device pool a pending
+        # speculation was computed against — device-loss demotion is one
+        # of the invalidation paths the speculation contract names.
+        if hasattr(self.engine, "spec_invalidate"):
+            self.engine.spec_invalidate("revive")
         self._needs_revive = False
         self.engine.device_error = None
         self._revive_engine(now)
@@ -2785,6 +2825,12 @@ class _QueueRuntime:
                         # variant keep the drained single-chunk contract.
                         if not getattr(self.engine, "rescan_overlap", False):
                             await self._drain_engine(now)
+                        # A rescan tick is a cut too (ISSUE 16): commit a
+                        # still-valid speculation instead of letting the
+                        # rescan's donation discard it as wasted — the
+                        # rescan below then widens over the POST-commit
+                        # pool, exactly as if the spec had been a tick.
+                        self._spec_cut_locked(now)
                         tok = await asyncio.to_thread(
                             self.engine.rescan_async, window, now)
                     elif hasattr(self.engine, "rescan"):
@@ -2844,6 +2890,89 @@ class _QueueRuntime:
                 self._record_engine_crash(now)
                 async with self._engine_lock:
                     self._revive_locked(now)
+
+    # ---- speculative formation (ISSUE 16) ---------------------------------
+
+    # holds-lock: _engine_lock
+    def _spec_cut_locked(self, now: float) -> bool:
+        """Commit-or-discard the pending speculation at a cut point.
+        Caller holds _engine_lock. Validation is O(1) (mutation-clock
+        compare + staleness bound); a hit submits the precomputed window
+        into the pipelined stream as a rescan-family token — the shared
+        collector publishes its matches — and returns True. A miss (or no
+        pending speculation) returns False and the caller's own full step
+        is the bit-exact fallback. spec_validate → spec_commit runs with
+        no pool mutation in between, the exact ordering the sanitizer and
+        the matchlint rule pin."""
+        eng = self.engine
+        if not hasattr(eng, "spec_validate"):
+            return False  # breaker-demoted host oracle: no speculation
+        try:
+            tok = eng.spec_validate(
+                now, max_age_s=self.app.cfg.engine.spec_staleness_ms / 1e3)
+            if tok is None:
+                return False
+            eng.spec_commit(tok, now)
+            self.app.metrics.counters.inc("spec_commits")
+            return True
+        except Exception:
+            # A commit failure must not take the cut down with it: the
+            # traffic/rescan step that follows is the full-step fallback.
+            log.exception("speculative commit failed; falling back to a "
+                          "full step")
+            self.app.metrics.counters.inc("spec_errors")
+            if hasattr(eng, "spec_invalidate"):
+                eng.spec_invalidate("cut-commit failure")
+            return False
+
+    async def _spec_loop(self) -> None:
+        """Speculative-formation driver (ISSUE 16): on its cadence
+        (EngineConfig.spec_interval_ms), when the pipeline is idle — the
+        window gap r04 attribution shows the device spending mostly idle —
+        commit the previous tick's speculation (the tick is a cut: if the
+        mutation clock hasn't moved, the precomputed pairings are the
+        pairings a rescan would form NOW) and precompute the next one.
+        Traffic arriving mid-gap commits the pending speculation at its
+        own cut (_dispatch_pipelined) before dispatching, so gap work is
+        wasted only when a pool mutation (admit/expiry/dedup/removal/
+        recovery) actually invalidated it. Supervised like the collector:
+        one bad tick discards the speculation, never the task."""
+        interval = max(0.001, self.app.cfg.engine.spec_interval_ms / 1e3)
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                eng = self.engine
+                if not hasattr(eng, "speculate"):
+                    continue  # breaker demotion swapped in the host oracle
+                if self._needs_revive or self._flushing > 0:
+                    continue  # not a gap: revive pending / flush running
+                if hasattr(eng, "inflight") and eng.inflight() > 0:
+                    continue  # pipeline busy: the gap has not opened
+                now = time.time()
+                async with self._engine_lock:
+                    eng = self.engine  # re-read: swaps happen under lock
+                    if not hasattr(eng, "speculate"):
+                        continue
+                    self._spec_cut_locked(now)
+                    # Off-thread: the speculative step is real device math
+                    # (the non-donated rescan twin over the packed pool).
+                    await asyncio.to_thread(eng.speculate, now)
+                    # Collect promptly: a commit above submitted a window;
+                    # under zero traffic the collector task is the only
+                    # other reaper and it polls at 10 ms.
+                    await self._collect_ready_locked(time.time())
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("speculation tick failed; discarding")
+                self.app.metrics.counters.inc("spec_errors")
+                try:
+                    async with self._engine_lock:
+                        if hasattr(self.engine, "spec_invalidate"):
+                            self.engine.spec_invalidate("tick failure")
+                except Exception:
+                    log.exception("speculation discard failed")
+                await asyncio.sleep(0.05)
 
     def _publish_rescan_outcome(self, out, now: float) -> None:
         """Publish one rescan outcome's matches. q_ids / queued are
@@ -3228,6 +3357,10 @@ class _QueueRuntime:
             self._rescanner.cancel()
         if self._health is not None:
             self._health.cancel()
+        if self._spec_task is not None:
+            # Before the batcher drain: a speculation tick racing the
+            # final flush would only be discarded at its cut anyway.
+            self._spec_task.cancel()
         if self._durability is not None:
             self._durability.cancel()
         # Drain the batcher BEFORE cancelling the consumer so the final
@@ -3254,7 +3387,7 @@ class _QueueRuntime:
         otherwise leak across cycles); a real crash frees them with the
         process."""
         for task in (self._sweeper, self._rescanner, self._health,
-                     self._durability, self._collector,
+                     self._spec_task, self._durability, self._collector,
                      self.batcher._task):
             if task is not None:
                 task.cancel()
@@ -3658,6 +3791,17 @@ class MatchmakingApp:
                     round(di / (db + di), 6)
                     if db >= 0.0 and di >= 0.0 and db + di > 0
                     else u["idle_fraction"])
+                vals[f"spec_commit_share[{name}]"] = u.get(
+                    "spec_commit_share", 0.0)
+            if hasattr(rt.engine, "spec_report"):
+                sr = rt.engine.spec_report()
+                if sr is not None:
+                    # The speculation scoreboard (ISSUE 16): hit/miss/
+                    # wasted trajectories are what the A-B bench and the
+                    # frontier sweep read off the telemetry ring.
+                    for k in ("spec_hit", "spec_miss", "spec_wasted"):
+                        vals[f"{k}[{name}]"] = float(sr[k])
+                    vals[f"spec_hit_rate[{name}]"] = sr["spec_hit_rate"]
         self.telemetry.append(now, vals)
         for mon in self._slo_monitors.values():
             mon.evaluate(self.telemetry, now)

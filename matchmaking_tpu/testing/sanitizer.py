@@ -235,14 +235,27 @@ class AsyncSanitizer:
         self._journal_clean: dict[int, str] = {}
         #: id(journal) → site of the newest still-uncommitted append.
         self._journal_dirty_site: dict[int, str] = {}
+        # ---- speculation twin state (ISSUE 16) ----------------------------
+        #: Strong refs to every TpuEngine whose speculation seam fired
+        #: while installed (id()-key stability — same argument as
+        #: ``_locks``).
+        self._spec_refs: list[Any] = []
+        #: id(engine) → (validated token, validate site): the freshness
+        #: record a later spec_commit must present. Cleared by every pool
+        #: mutation — a commit that finds no record (or a stale token)
+        #: committed a speculation OLDER than the last pool mutation.
+        self._spec_valid: dict[int, tuple[int, str]] = {}
 
     # ---- installation ------------------------------------------------------
 
     def installed(self):
         """Context manager patching ``asyncio.Lock`` (lock instrumentation)
         plus the admission controller's admit/release and the in-proc
-        broker's app-facing ack/nack (the settlement twin) — every lock
-        and every settle the code under test performs reports here."""
+        broker's app-facing ack/nack (the settlement twin), the pool
+        journal's append/commit discipline (the journal twin), and the
+        engine's speculation validate/commit ordering (the speculation
+        twin) — every lock, settle, journal write, and speculative commit
+        the code under test performs reports here."""
         import contextlib
         import zlib as _zlib
 
@@ -358,6 +371,66 @@ class AsyncSanitizer:
             san._journal_seen.pop(id(j), None)
             san._journal_dirty_site.pop(id(j), None)
 
+        # ---- speculation twin (ISSUE 16) ----------------------------------
+        # Dynamic mirror of the validation-token discipline the engine
+        # enforces by raising and matchlint checks lexically: a committed
+        # speculative window must carry a validation token NEWER than the
+        # last pool mutation. The twin reports the ordering violation
+        # even when a supervising caller (the service's cut helper
+        # swallows commit failures by design) eats the engine's raise.
+        from matchmaking_tpu.engine import tpu as _tpu_mod
+
+        te = _tpu_mod.TpuEngine
+        orig_svalidate = te.spec_validate
+        orig_scommit = te.spec_commit
+        orig_sinval = te.spec_invalidate
+        orig_smutated = te._pool_mutated
+
+        def svalidate(eng, now: float, max_age_s: float = 0.0):
+            tok = orig_svalidate(eng, now, max_age_s)
+            if tok is not None:
+                if not any(e is eng for e in san._spec_refs):
+                    san._spec_refs.append(eng)
+                san._spec_valid[id(eng)] = (tok, _site())
+            else:
+                san._spec_valid.pop(id(eng), None)
+            return tok
+
+        def smutated(eng) -> None:
+            # Every pool mutation retires the freshness record — exactly
+            # the clock semantics spec_commit must be newer than.
+            san._spec_valid.pop(id(eng), None)
+            orig_smutated(eng)
+
+        def sinval(eng, reason: str = "external") -> None:
+            san._spec_valid.pop(id(eng), None)
+            orig_sinval(eng, reason)
+
+        def scommit(eng, token, now: float):
+            site = _site()
+            if token is not None:
+                rec = san._spec_valid.pop(id(eng), None)
+                if rec is None:
+                    san._report(
+                        "spec-commit-unvalidated",
+                        ("spec-unvalidated", site),
+                        f"spec_commit at {site} carries token {token} with "
+                        f"no live validation record — spec_validate never "
+                        f"ran, or a pool mutation ran after it (validate-"
+                        f"after-mutate): a committed speculative window "
+                        f"must carry a validation token newer than the "
+                        f"last pool mutation")
+                elif rec[0] != token or token != eng.pool_mutations:
+                    san._report(
+                        "spec-commit-stale-token",
+                        ("spec-stale", site),
+                        f"spec_commit at {site} presents token {token} but "
+                        f"the live validation is {rec[0]} from {rec[1]} "
+                        f"(pool_mutations={eng.pool_mutations}) — the "
+                        f"committed window would predate the last pool "
+                        f"mutation")
+            return orig_scommit(eng, token, now)
+
         @contextlib.contextmanager
         def _cm():
             self._orig_lock = asyncio.Lock
@@ -367,6 +440,8 @@ class AsyncSanitizer:
             pj.__init__, pj._append = jinit, jappend
             pj.commit, pj.mark_clean = jcommit, jclean
             pj.compact_finish = jcompact
+            te.spec_validate, te.spec_commit = svalidate, scommit
+            te.spec_invalidate, te._pool_mutated = sinval, smutated
             try:
                 yield self
             finally:
@@ -377,6 +452,10 @@ class AsyncSanitizer:
                 pj.__init__, pj._append = orig_jinit, orig_jappend
                 pj.commit, pj.mark_clean = orig_jcommit, orig_jclean
                 pj.compact_finish = orig_jcompact
+                te.spec_validate, te.spec_commit = (orig_svalidate,
+                                                    orig_scommit)
+                te.spec_invalidate = orig_sinval
+                te._pool_mutated = orig_smutated
 
         return _cm()
 

@@ -22,16 +22,20 @@ Compared metrics (direction-aware):
     higher is better:  value (headline matches/s), e2e_rate_req_s
                        (ISSUE 9: the service-path headline the 8x-gap work
                        moves), e2e_matched_per_s, e2e_knee_req_s,
-                       e2e_slo_attainment, frontier quality_mean
+                       e2e_slo_attainment, frontier quality_mean,
+                       spec_hit_rate (ISSUE 16)
     lower is better:   p99_ms, e2e_p99_ms, frontier wait_at_match_ms_p99,
                        frontier quality_disparity, the placement-soak
                        rows (ISSUE 11): placement_blackout_ms_max/mean,
-                       placement_lost, placement_dup, and the crash-soak
+                       placement_lost, placement_dup, the crash-soak
                        rows (ISSUE 15): crash_lost, crash_dup,
                        crash_rto_ms_max/mean, crash_failover_blackout_ms,
                        journal_write_amplification,
-                       crash_journal_overhead_frac
-Frontier rows (``e2e_frontier``, ISSUE 8) are matched by threshold.
+                       crash_journal_overhead_frac, and the speculation
+                       A/B rows (ISSUE 16): spec_turnaround_ms_p50/p99,
+                       spec_wasted_step_fraction
+Frontier rows (``e2e_frontier``, ISSUE 8; the speculation-axis twin
+``e2e_frontier_spec``, ISSUE 16) are matched by threshold.
 Scenario-matrix cells (``scenario_matrix``, ISSUE 13) are matched by
 scenario name — slo_attainment / quality up, admitted_p99_ms / expired
 down — and cells carrying an ``abort_reason`` are skipped on either side,
@@ -89,6 +93,16 @@ TOP_LEVEL_METRICS: dict[str, bool] = {
     "crash_failover_blackout_ms": False,
     "journal_write_amplification": False,
     "crash_journal_overhead_frac": False,
+    # Speculative formation A/B (ISSUE 16, bench.py --spec-ab): the
+    # spec-on leg's turnaround (engine-observed wait-at-match) regresses
+    # upward, the hit rate downward, the wasted-step fraction (discarded
+    # speculative device steps — the overlap price) upward. A chip-less
+    # abort leaves these keys absent and they are skipped per-metric,
+    # like every other one-sided column.
+    "spec_turnaround_ms_p50": False,
+    "spec_turnaround_ms_p99": False,
+    "spec_hit_rate": True,
+    "spec_wasted_step_fraction": False,
 }
 
 #: Pool-scale sweep rows (ISSUE 14, ``bench.py --pool-scale``), matched
@@ -223,22 +237,26 @@ def diff(baseline: dict, fresh: dict,
                            higher, threshold)
         if row is not None:
             rows.append(row)
-    # Frontier rows matched by threshold value (ISSUE 8).
-    base_frontier = {r.get("threshold"): r
-                     for r in baseline.get("e2e_frontier", [])
-                     if isinstance(r, dict)}
-    for fr in fresh.get("e2e_frontier", []):
-        if not isinstance(fr, dict):
-            continue
-        br = base_frontier.get(fr.get("threshold"))
-        if br is None:
-            continue
-        for name, higher in FRONTIER_METRICS.items():
-            row = _compare_one(
-                f"e2e_frontier[thr={fr.get('threshold'):g}].{name}",
-                br.get(name), fr.get(name), higher, threshold)
-            if row is not None:
-                rows.append(row)
+    # Frontier rows matched by threshold value (ISSUE 8); the
+    # speculation-axis rows (ISSUE 16, ``e2e_frontier_spec``) gate the
+    # same metrics spec-on vs spec-on so the fairness bar travels with
+    # the overlap.
+    for key in ("e2e_frontier", "e2e_frontier_spec"):
+        base_frontier = {r.get("threshold"): r
+                         for r in baseline.get(key, [])
+                         if isinstance(r, dict)}
+        for fr in fresh.get(key, []):
+            if not isinstance(fr, dict):
+                continue
+            br = base_frontier.get(fr.get("threshold"))
+            if br is None:
+                continue
+            for name, higher in FRONTIER_METRICS.items():
+                row = _compare_one(
+                    f"{key}[thr={fr.get('threshold'):g}].{name}",
+                    br.get(name), fr.get(name), higher, threshold)
+                if row is not None:
+                    rows.append(row)
     # Pool-scale rows matched by synthetic pool size (ISSUE 14).
     base_scale = {r.get("pool"): r for r in baseline.get("pool_scale", [])
                   if isinstance(r, dict)}

@@ -122,6 +122,22 @@ assert out["crash_rto_ms_max"] is not None and \
 print("crash-soak smoke: OK")
 EOF
 
+echo "== speculation =="
+# ISSUE 16 gate: speculative formation. The equivalence suite runs by
+# name, seconds-scale on the CPU harness: commit ≡ rescan bit-exactness
+# (single and chained steps, fallback after invalidation), every
+# invalidation path (admit delta, expiry incl. the zero-effect sweep
+# carve-out, dedup, mid-gap removal, restore, staleness), the
+# validate-before-commit token discipline (commit-without-validate and
+# validate-after-mutate raise), the seeded spec-on vs spec-off soak
+# (bit-identical match stream, zero lost players, zero double matches
+# across a drain/restore cycle), and the service spec-loop + drain
+# round trips. The static twin of the token discipline is the matchlint
+# `speculation` rule in the full lint above; the dynamic twin rides the
+# sanitizer suite in tier-1.
+JAX_PLATFORMS=cpu python -m pytest tests/test_speculation.py -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider
+
 echo "== scenario observatory =="
 # ISSUE 13 gate: population-model scenario determinism (bit-identical
 # arrival transcripts, steady ≡ legacy loadgen byte for byte), the

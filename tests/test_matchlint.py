@@ -1241,3 +1241,63 @@ class Executor:
         code, path="matchmaking_tpu/control/fixture.py")
         if f.rule == "settlement"]
     assert findings and any("credit leak" in f.message for f in findings)
+
+
+# ---- speculation rule (ISSUE 16) ------------------------------------------
+
+
+def test_speculation_flags_commit_without_validate():
+    findings = analyze_source('''
+class Runtime:
+    def cut(self, now):
+        self.engine.spec_commit(self.engine.pool_mutations, now)
+''', path="matchmaking_tpu/service/fixture.py")
+    spec = [f for f in findings if f.rule == "speculation"]
+    assert spec and "without a live spec_validate" in spec[0].message
+    assert spec[0].context == "Runtime.cut"
+
+
+def test_speculation_flags_validate_after_mutate():
+    findings = analyze_source('''
+class Runtime:
+    def cut(self, now):
+        tok = self.engine.spec_validate(now)
+        self.engine.remove("p0")          # mutation between the pair
+        self.engine.spec_commit(tok, now)
+''', path="matchmaking_tpu/service/fixture.py")
+    assert [f for f in findings if f.rule == "speculation"], findings
+
+
+def test_speculation_accepts_adjacent_validate_commit():
+    findings = analyze_source('''
+class Runtime:
+    def cut(self, now):
+        tok = self.engine.spec_validate(now, max_age_s=0.5)
+        if tok is not None:
+            self.engine.spec_commit(tok, now)
+        self.engine.rescan_async(16, now)  # AFTER the commit: fine
+''', path="matchmaking_tpu/service/fixture.py")
+    assert [f for f in findings if f.rule == "speculation"] == []
+
+
+def test_speculation_commit_consumes_its_validation():
+    findings = analyze_source('''
+class Runtime:
+    def cut(self, now):
+        tok = self.engine.spec_validate(now)
+        self.engine.spec_commit(tok, now)
+        self.engine.spec_commit(tok, now)  # second commit: stale token
+''', path="matchmaking_tpu/service/fixture.py")
+    assert len([f for f in findings if f.rule == "speculation"]) == 1
+
+
+def test_speculation_nested_def_gets_fresh_state():
+    findings = analyze_source('''
+class Runtime:
+    def outer(self, now):
+        tok = self.engine.spec_validate(now)
+
+        def later():
+            self.engine.spec_commit(tok, now)  # runs on its own schedule
+''', path="matchmaking_tpu/service/fixture.py")
+    assert [f for f in findings if f.rule == "speculation"], findings

@@ -293,3 +293,73 @@ def test_settlement_twin_tolerates_at_least_once_redelivery():
         asyncio.run(main())
     assert [f for f in san.findings if f.kind == "double-settle"] == []
     san.assert_clean()
+
+
+# ---- speculation twin (ISSUE 16) ------------------------------------------
+
+
+def _spec_engine():
+    from matchmaking_tpu.config import Config, EngineConfig, QueueConfig
+    from matchmaking_tpu.engine.interface import make_engine
+    from matchmaking_tpu.service.contract import SearchRequest
+
+    q = QueueConfig(rating_threshold=10.0, widen_per_sec=10.0,
+                    max_threshold=200.0)
+    eng = make_engine(Config(queues=(q,), engine=EngineConfig(
+        backend="tpu", pool_capacity=64, pool_block=64, batch_buckets=(16,),
+        spec_formation=True, spec_max_steps=1)), q)
+    eng.restore([SearchRequest(id="a", rating=1500.0, enqueued_at=1.0,
+                               reply_to="rq.a"),
+                 SearchRequest(id="b", rating=1540.0, enqueued_at=1.0,
+                               reply_to="rq.b")], 1.0)
+    return eng
+
+
+def test_spec_twin_reports_commit_without_validate():
+    import pytest
+
+    san = AsyncSanitizer(stall_threshold_s=60.0)
+    with san.installed():
+        eng = _spec_engine()
+        assert eng.speculate(4.0)
+        # Commit with a guessed token, no spec_validate: the engine raises
+        # AND the twin records the ordering violation with the call site —
+        # the report survives even when a supervisor eats the raise.
+        with pytest.raises(RuntimeError):
+            eng.spec_commit(eng.pool_mutations, 4.0)
+    bad = [f for f in san.findings if f.kind == "spec-commit-unvalidated"]
+    assert len(bad) == 1, san.findings
+    assert THIS_FILE in bad[0].message
+    assert "newer than the last pool mutation" in bad[0].message
+
+
+def test_spec_twin_reports_validate_after_mutate():
+    import pytest
+    from matchmaking_tpu.service.contract import SearchRequest
+
+    san = AsyncSanitizer(stall_threshold_s=60.0)
+    with san.installed():
+        eng = _spec_engine()
+        assert eng.speculate(4.0)
+        tok = eng.spec_validate(4.0)
+        assert tok is not None
+        eng.search_async([SearchRequest(id="c", rating=9000.0,
+                                        enqueued_at=4.5, reply_to="rq.c")],
+                         4.5)                     # mutation slips in
+        with pytest.raises(RuntimeError):
+            eng.spec_commit(tok, 5.0)
+        eng.flush()
+    bad = [f for f in san.findings if f.kind == "spec-commit-unvalidated"]
+    assert len(bad) == 1, san.findings
+
+
+def test_spec_twin_clean_validate_commit_is_silent():
+    san = AsyncSanitizer(stall_threshold_s=60.0)
+    with san.installed():
+        eng = _spec_engine()
+        assert eng.speculate(4.0)
+        tok = eng.spec_validate(4.0)
+        assert eng.spec_commit(tok, 4.0) is not None
+        eng.flush()
+    assert [f for f in san.findings if f.kind.startswith("spec-")] == []
+    san.assert_clean()

@@ -1,0 +1,485 @@
+"""Speculative formation (ISSUE 16): idle window-gap cycles precompute
+pairing steps over the resident pool; the cut validates against the
+mutation clock and commits in O(delta) or falls back bit-exactly to a
+full step.
+
+Three layers of proof live here:
+- commit-path bit-exactness: a committed speculation IS the rescan tick
+  evaluated at ``spec_now`` (same jitted trace, non-donated), pinned
+  single-step, chained, and as a seeded mixed-workload equivalence soak
+  with a drain/restore cycle in the middle;
+- one unit test per invalidation path (admit delta, expiry, dedup hit,
+  mid-gap removal, restore, staleness), plus the zero-effect sweeps that
+  must NOT invalidate;
+- the validation-token discipline: commit-without-validate and
+  validate-after-mutate raise instead of silently corrupting."""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from matchmaking_tpu.config import (
+    BatcherConfig,
+    Config,
+    EngineConfig,
+    QueueConfig,
+)
+from matchmaking_tpu.engine.cpu import CpuEngine
+from matchmaking_tpu.engine.interface import make_engine
+from matchmaking_tpu.service.contract import SearchRequest
+
+
+def _q(**kw):
+    return QueueConfig(rating_threshold=10.0, widen_per_sec=10.0,
+                       max_threshold=200.0, **kw)
+
+
+def _cfg(q, **ekw):
+    ekw.setdefault("spec_formation", True)
+    ekw.setdefault("spec_max_steps", 1)
+    return Config(queues=(q,), engine=EngineConfig(
+        backend="tpu", pool_capacity=64, pool_block=64, batch_buckets=(16,),
+        **ekw))
+
+
+def _req(i, rating, t=0.0):
+    return SearchRequest(id=f"p{i}", rating=float(rating), enqueued_at=t,
+                         reply_to=f"rq.p{i}")
+
+
+def _matches(outs):
+    """Ordered match stream from flush() outputs: (id_a, id_b, quality)
+    per match, token order — the bit-exactness comparison unit."""
+    stream = []
+    for _tok, out in outs:
+        if hasattr(out, "m_id_a"):
+            for j in range(out.n_matches):
+                a, b = sorted((out.m_id_a[j], out.m_id_b[j]))
+                stream.append((a, b, float(out.m_quality[j])))
+        else:
+            for m in out.matches:
+                ids = tuple(sorted(r.id for t in m.teams for r in t))
+                stream.append(ids + (None,))
+    return stream
+
+
+# ---- commit-path bit-exactness -------------------------------------------
+
+
+class TestCommitEqualsRescan:
+    def test_single_step_commit_equals_cold_rescan(self):
+        q = _q()
+        spec = make_engine(_cfg(q), q)
+        cold = make_engine(_cfg(q), q)
+        reqs = [_req(0, 1500.0), _req(1, 1540.0), _req(2, 1800.0)]
+        spec.restore(reqs, 0.0)
+        cold.restore(reqs, 0.0)
+
+        assert spec.speculate(4.0)
+        tok = spec.spec_validate(4.0)
+        assert tok is not None
+        assert spec.spec_commit(tok, 4.0) is not None
+        cold.rescan_async(16, now=4.0)
+
+        s_stream, c_stream = _matches(spec.flush()), _matches(cold.flush())
+        assert s_stream == c_stream
+        assert s_stream and s_stream[0][:2] == ("p0", "p1")
+        assert spec.pool_size() == cold.pool_size() == 1
+        r = spec.spec_report()
+        assert r["spec_hit"] == 1 and r["spec_miss"] == 0
+
+    def test_chained_steps_commit_equals_repeated_rescan(self):
+        """spec_max_steps=2 chains two passes over the snapshot lanes —
+        a commit must equal exactly TWO rescan ticks at the same now."""
+        rng = np.random.default_rng(7)
+        q = _q()
+        spec = make_engine(_cfg(q, spec_max_steps=2), q)
+        cold = make_engine(_cfg(q), q)
+        reqs = [_req(i, 1000.0 + float(rng.integers(0, 400)))
+                for i in range(12)]
+        spec.restore(reqs, 0.0)
+        cold.restore(reqs, 0.0)
+
+        assert spec.speculate(6.0)
+        tok = spec.spec_validate(6.0)
+        spec.spec_commit(tok, 6.0)
+        cold.rescan_async(16, now=6.0)
+        cold.flush_stream = _matches(cold.flush())
+        cold.rescan_async(16, now=6.0)
+        cold.flush_stream += _matches(cold.flush())
+
+        assert _matches(spec.flush()) == cold.flush_stream
+        assert spec.pool_size() == cold.pool_size()
+
+    def test_fallback_is_bit_exact_full_step(self):
+        """A wasted speculation leaves the live pool untouched: the full
+        step that follows equals the step of an engine that never
+        speculated (the non-donated twin preserved the input handle)."""
+        q = _q()
+        spec = make_engine(_cfg(q), q)
+        plain = make_engine(_cfg(q, spec_formation=False), q)
+        reqs = [_req(0, 1500.0), _req(1, 1540.0)]
+        spec.restore(reqs, 0.0)
+        plain.restore(reqs, 0.0)
+
+        assert spec.speculate(4.0)
+        spec.spec_invalidate("test")          # gap work discarded
+        spec.rescan_async(16, now=4.0)        # the bit-exact fallback
+        plain.rescan_async(16, now=4.0)
+        assert _matches(spec.flush()) == _matches(plain.flush())
+        assert spec.spec_report()["spec_wasted"] == 1
+
+
+# ---- invalidation paths ---------------------------------------------------
+
+
+class TestInvalidation:
+    def _speculating(self, **ekw):
+        q = _q(request_timeout_s=30.0)
+        eng = make_engine(_cfg(q, **ekw), q)
+        # enqueued_at=1.0 (not the 0.0 no-stamp sentinel the expiry
+        # sweeps skip); distance 400 > max_threshold 200 so the pair
+        # never matches and both stay resident for the whole test.
+        eng.restore([_req(0, 1500.0, 1.0), _req(1, 1900.0, 1.0)], 1.0)
+        assert eng.speculate(1.0)
+        return eng
+
+    def test_admit_delta_invalidates(self):
+        eng = self._speculating()
+        eng.search_async([_req(9, 5000.0)], 1.5)
+        assert eng.spec_validate(2.0) is None
+        assert eng.spec_report()["spec_wasted"] == 1
+        eng.flush()
+
+    def test_expiry_invalidates_but_zero_effect_sweep_does_not(self):
+        eng = self._speculating()
+        assert eng.expire(5.0, timeout=30.0) == []   # nobody expired
+        assert eng.spec_validate(5.0) is not None    # spec survives
+        assert eng.speculate(5.0)                    # still pending
+        expired = eng.expire(40.0, timeout=30.0)     # both expire
+        assert len(expired) == 2
+        assert eng.spec_validate(40.0) is None
+        assert eng.spec_report()["spec_wasted"] == 1
+
+    def test_deadline_sweep_zero_effect_preserves_speculation(self):
+        eng = self._speculating()
+        assert eng.expire_deadlines(5.0) == []       # no deadlines set
+        assert eng.spec_validate(5.0) is not None
+
+    def test_dedup_only_admission_preserves_speculation(self):
+        """A redelivered duplicate dedups against the mirror WITHOUT
+        mutating the pool — the speculation must survive (restore-side
+        dedup is the delta category, not every redelivery)."""
+        eng = self._speculating()
+        eng.restore([_req(0, 1500.0)], 1.5)          # pure dedup hit
+        assert eng.spec_validate(2.0) is not None
+
+    def test_dedup_mixed_with_fresh_invalidates(self):
+        eng = self._speculating()
+        eng.restore([_req(0, 1500.0), _req(9, 5000.0)], 1.5)
+        assert eng.spec_validate(2.0) is None
+        assert eng.spec_report()["spec_wasted"] == 1
+
+    def test_mid_gap_removal_invalidates(self):
+        eng = self._speculating()
+        assert eng.remove("p0") is not None
+        assert eng.spec_validate(2.0) is None
+        assert eng.spec_report()["spec_wasted"] == 1
+
+    def test_removal_of_absent_player_preserves_speculation(self):
+        eng = self._speculating()
+        assert eng.remove("ghost") is None
+        assert eng.spec_validate(2.0) is not None
+
+    def test_restore_invalidates(self):
+        eng = self._speculating()
+        eng.restore([_req(7, 2500.0)], 1.5)
+        assert eng.spec_validate(2.0) is None
+
+    def test_staleness_bound_misses(self):
+        eng = self._speculating()
+        assert eng.spec_validate(1.2, max_age_s=0.5) is not None
+        assert eng.speculate(1.2)                    # still the same spec
+        assert eng.spec_validate(9.0, max_age_s=0.5) is None
+        assert eng.spec_report()["spec_miss"] == 1
+
+
+# ---- validation-token discipline ------------------------------------------
+
+
+class TestTokenDiscipline:
+    def test_commit_without_validate_raises(self):
+        q = _q()
+        eng = make_engine(_cfg(q), q)
+        eng.restore([_req(0, 1500.0), _req(1, 1540.0)], 0.0)
+        assert eng.speculate(4.0)
+        with pytest.raises(RuntimeError, match="not freshly validated"):
+            eng.spec_commit(eng.pool_mutations, 4.0)
+
+    def test_validate_after_mutate_raises_on_commit(self):
+        q = _q()
+        eng = make_engine(_cfg(q), q)
+        eng.restore([_req(0, 1500.0), _req(1, 1540.0)], 0.0)
+        assert eng.speculate(4.0)
+        tok = eng.spec_validate(4.0)
+        assert tok is not None
+        eng.search_async([_req(9, 5000.0)], 4.5)     # mutation slips in
+        with pytest.raises(RuntimeError, match="discarded speculation"):
+            eng.spec_commit(tok, 5.0)
+        eng.flush()
+
+    def test_commit_none_token_is_noop(self):
+        q = _q()
+        eng = make_engine(_cfg(q), q)
+        assert eng.spec_commit(None, 1.0) is None
+
+    def test_cpu_oracle_keeps_default_noop_seam(self):
+        """engine/cpu.py (and via it engine/sharded.py's oracle
+        comparisons) inherit the interface's no-op speculation seam —
+        oracle equivalence harnesses can call the same methods."""
+        q = _q()
+        cpu = CpuEngine(_cfg(q), q)
+        assert cpu.speculate(1.0) is False
+        assert cpu.spec_validate(1.0) is None
+        assert cpu.spec_commit(None, 1.0) is None
+        cpu.spec_invalidate("noop")
+        assert cpu.spec_report() is None
+
+
+# ---- seeded equivalence soak ----------------------------------------------
+
+
+def _soak_trace(seed: int, rounds: int = 14):
+    """Resolved op schedule for the soak: deterministic admit/dup/remove/
+    expire mix with a gap+cut per round and one drain/restore mid-soak.
+    Targets for dup/remove are drawn from recently admitted ids — whether
+    they are still waiting is resolved identically by both runs."""
+    rng = np.random.default_rng(seed)
+    ops, pid, admitted = [], 0, []
+    for rnd in range(rounds):
+        base = 50.0 * rnd
+        admits = []
+        for _ in range(int(rng.integers(1, 4))):
+            admits.append((f"s{pid}",
+                           float(rng.integers(0, 30) * 500
+                                 + rng.integers(0, 120)),
+                           base + 1.0))
+            pid += 1
+        admitted += [a[0] for a in admits]
+        ops.append(("admit", base + 1.0, admits))
+        if rng.random() < 0.4:
+            tgt = admitted[int(rng.integers(0, len(admitted)))]
+            ops.append(("dup", base + 2.0, tgt))
+        if rng.random() < 0.3:
+            tgt = admitted[int(rng.integers(0, len(admitted)))]
+            ops.append(("remove", base + 3.0, tgt))
+        if rng.random() < 0.35:
+            ops.append(("expire", base + 4.0))
+        ops.append(("gap", base + 6.0))
+        if rng.random() < 0.3:
+            admits2 = [(f"s{pid}", float(rng.integers(0, 30) * 500),
+                        base + 7.0)]
+            admitted.append(f"s{pid}")
+            pid += 1
+            ops.append(("admit", base + 7.0, admits2))
+        ops.append(("cut", base + 9.0))
+        if rnd == rounds // 2:
+            ops.append(("drain_restore", base + 9.5))
+    return ops
+
+
+_SOAK_TIMEOUT = 120.0
+
+
+def _run_soak(ops, tmp_path, spec_on: bool, commit_log=None):
+    """Drive one engine through the resolved soak ops. spec_on runs
+    speculation at each gap and commit-or-discard at each cut, recording
+    commits into commit_log; spec_off replays commit_log as cold rescan
+    ticks at the recorded (now, steps) — the ISSUE's equivalence baseline
+    (a commit IS the rescan evaluated at spec_now)."""
+    q = _q(request_timeout_s=_SOAK_TIMEOUT)
+    cfg = _cfg(q, spec_formation=spec_on)
+    eng = make_engine(cfg, q)
+    stream, expired_log, removed_log = [], [], []
+    gap_t = None
+    commits = iter(commit_log or ())
+    next_commit = next(commits, None)
+    from matchmaking_tpu.utils.checkpoint import load_pool, save_pool
+
+    for i, op in enumerate(ops):
+        kind, t = op[0], op[1]
+        if kind == "admit":
+            reqs = [SearchRequest(id=p, rating=r, enqueued_at=e,
+                                  reply_to=f"rq.{p}")
+                    for p, r, e in op[2]]
+            eng.search_async(reqs, t)
+            stream += _matches(eng.flush())
+        elif kind == "dup":
+            # Redelivery of a still-waiting player: a pure dedup hit
+            # (restore dedups against the mirror, zero mutation). Whether
+            # the target is still waiting resolves identically in both
+            # runs — a terminal player's redelivery is absorbed by the
+            # service's _recent cache before ever reaching the engine.
+            if op[2] in eng.pool:
+                eng.restore([SearchRequest(id=op[2], rating=0.0,
+                                           enqueued_at=t,
+                                           reply_to=f"rq.{op[2]}")], t)
+                stream += _matches(eng.flush())
+        elif kind == "remove":
+            r = eng.remove(op[2])
+            removed_log.append(op[2] if r is not None else None)
+        elif kind == "expire":
+            expired_log.append(sorted(
+                r.id for r in eng.expire(t, timeout=_SOAK_TIMEOUT)))
+        elif kind == "gap":
+            gap_t = t
+            if spec_on:
+                eng.speculate(t)
+        elif kind == "cut":
+            if spec_on:
+                tok = eng.spec_validate(t)
+                if tok is not None:
+                    eng.spec_commit(tok, t)
+                    commit_log.append(gap_t)
+            elif next_commit is not None and next_commit == gap_t:
+                eng.rescan_async(16, now=next_commit)
+                next_commit = next(commits, None)
+            stream += _matches(eng.flush())
+        elif kind == "drain_restore":
+            if spec_on:
+                eng.spec_invalidate("drain")
+            eng.flush()
+            path = os.path.join(str(tmp_path), f"soak_{spec_on}_{i}.npz")
+            save_pool(eng, path, queue_name=q.name)
+            eng = make_engine(cfg, q)
+            load_pool(eng, path, t)
+            eng.heartbeat(t)
+    eng.flush()
+    waiting = sorted(p for p, _r, _e in
+                     [a for o in ops if o[0] == "admit" for a in o[2]]
+                     if p in eng.pool)
+    return stream, expired_log, removed_log, waiting, eng.pool_size()
+
+
+def test_seeded_soak_spec_on_matches_spec_off(tmp_path):
+    """The acceptance soak: speculation-on produces a bit-identical match
+    stream to speculation-off (commits replayed as cold rescans at the
+    same instants) under a mixed admit/dedup/remove/expire workload with
+    a drain/restore cycle in the middle — zero lost players, zero double
+    matches."""
+    for seed in (3, 11):
+        ops = _soak_trace(seed)
+        commit_log: list = []
+        on = _run_soak(ops, tmp_path, True, commit_log)
+        off = _run_soak(ops, tmp_path, False, commit_log)
+        assert commit_log, "soak never committed a speculation"
+        assert on == off  # streams, expiries, removals, final pool
+
+        stream, expired, removed, waiting, pool_n = on
+        matched = [pid for m in stream for pid in m[:2]]
+        assert len(matched) == len(set(matched)), "double match"
+        # Zero lost players: every admitted id is accounted for exactly
+        # once — matched, expired, removed, or still waiting.
+        admitted = {a[0] for o in ops if o[0] == "admit" for a in o[2]}
+        accounted = (set(matched)
+                     | {p for sweep in expired for p in sweep}
+                     | {p for p in removed if p is not None}
+                     | set(waiting))
+        assert accounted == admitted
+        assert pool_n == len(waiting)
+
+
+# ---- service integration ---------------------------------------------------
+
+
+def test_service_spec_loop_matches_residents_without_rescan():
+    """Zero-traffic gap matching end to end: rescan is OFF, so only the
+    speculation loop can resolve widening between the two pool residents.
+    The committed window publishes through the shared collector; the
+    scoreboard lands in the engine report and the telemetry snapshot."""
+    from matchmaking_tpu.service.app import MatchmakingApp
+    from matchmaking_tpu.service.client import MatchmakingClient
+
+    async def run():
+        cfg = Config(
+            queues=(QueueConfig(rating_threshold=50.0, widen_per_sec=400.0,
+                                max_threshold=2000.0, rescan_interval_s=0.0),),
+            engine=EngineConfig(backend="tpu", pool_capacity=256,
+                                pool_block=64, batch_buckets=(8, 32), top_k=4,
+                                spec_formation=True, spec_interval_ms=20.0,
+                                spec_max_steps=2, spec_staleness_ms=500.0),
+            batcher=BatcherConfig(max_batch=8, max_wait_ms=10.0),
+        )
+        app = MatchmakingApp(cfg)
+        await app.start()
+        try:
+            client = MatchmakingClient(app.broker, "matchmaking.search")
+            a = client.submit({"id": "alice", "rating": 1500})
+            b = client.submit({"id": "bob", "rating": 1900})
+            ra = await client.next_response(a, timeout=15.0)
+            rb = await client.next_response(b, timeout=15.0)
+            assert {ra.status, rb.status} == {"queued"}
+            ra2 = await client.next_response(a, timeout=15.0)
+            rb2 = await client.next_response(b, timeout=15.0)
+            assert ra2.status == "matched" and rb2.status == "matched"
+            rt = next(iter(app._runtimes.values()))
+            sr = rt.engine.spec_report()
+            assert sr["spec_hit"] >= 1
+            assert rt.engine.util_report()["spec_commit_share"] > 0.0
+            vals = app.sample_telemetry()
+            assert vals["spec_hit[matchmaking.search]"] >= 1.0
+            assert "spec_hit_rate[matchmaking.search]" in vals
+        finally:
+            await app.stop()
+
+    asyncio.run(run())
+
+
+def test_service_drain_restore_with_speculation_loses_no_players():
+    """Drain with an armed speculation: the checkpoint walk invalidates
+    the pending speculation (speculation owns no mirror state), so every
+    waiting player lands in the checkpoint and restores into a successor
+    app — the service half of the zero-lost-players acceptance bullet."""
+    from matchmaking_tpu.service.app import MatchmakingApp
+    from matchmaking_tpu.service.client import MatchmakingClient
+
+    async def run(tmp):
+        def mk():
+            return MatchmakingApp(Config(
+                queues=(QueueConfig(rating_threshold=1.0, widen_per_sec=0.0,
+                                    rescan_interval_s=0.0),),
+                engine=EngineConfig(backend="tpu", pool_capacity=64,
+                                    pool_block=64, batch_buckets=(16,),
+                                    spec_formation=True, spec_interval_ms=5.0,
+                                    spec_max_steps=1),
+                batcher=BatcherConfig(max_batch=16, max_wait_ms=1.0),
+            ))
+
+        app = mk()
+        await app.start()
+        client = MatchmakingClient(app.broker, "matchmaking.search")
+        handles = [client.submit({"id": f"w{i}", "rating": 1000.0 + 300 * i})
+                   for i in range(4)]
+        for h in handles:
+            r = await client.next_response(h, timeout=15.0)
+            assert r.status == "queued"
+        await asyncio.sleep(0.05)   # let the spec loop arm a speculation
+        counts = await app.drain(checkpoint_dir=tmp)
+        assert counts.get("matchmaking.search") == 4
+
+        succ = mk()
+        await succ.start()
+        try:
+            restored = await succ.restore_checkpoint(tmp)
+            assert restored.get("matchmaking.search") == 4
+            rt = next(iter(succ._runtimes.values()))
+            assert rt.engine.pool_size() == 4
+        finally:
+            await succ.stop()
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        asyncio.run(run(tmp))
