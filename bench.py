@@ -1970,6 +1970,292 @@ def bench_crash_soak(args) -> dict:
     }
 
 
+def bench_failover_soak(args) -> dict:
+    """Failover soak (ISSUE 17, ``--failover-soak``): seeded load through
+    N primary-kill/standby-takeover cycles on the CPU harness. Each cycle
+    boots the current owner's app on a FRESH journal dir (a new "host"),
+    attaches a warm standby over the in-process replication link, runs
+    deterministic designed load (pairs that match + singles that wait)
+    with the standby pumping, hard-kills the primary
+    (``MatchmakingApp.crash()``), expires the lease on the authority's
+    scriptable clock, promotes the standby (epoch bump = fencing), and
+    boots the successor, which adopts the standby's shadow
+    (``recover_from_replica`` — the measured ``failover_rto_ms``).
+
+    Chaos: cycle 0 runs a scripted drop/dup/delay vocabulary on the
+    stream's first seqs (retransmission must heal them — zero loss); the
+    LAST cycle partitions the link at a quiesced seq boundary and
+    publishes late singles behind the cut, so the kill lands with real
+    replication lag — the lost players must stay ``<=`` the
+    ``unacked_admit_players()`` bound measured at kill time, and the cut
+    at a quiesced boundary keeps the lost SET framing-independent (the
+    two-run transcript gate needs that).
+
+    Emits ``failover_lost`` / ``failover_dup`` / ``failover_rto_ms`` /
+    ``replication_lag_ms_p99`` (gated by scripts/bench_diff.py, lower is
+    better; lost/dup under the zero-baseline rule) plus the lost bound,
+    recovery count, and the two-run transcript identity pin."""
+    import asyncio
+    import shutil
+    import tempfile
+
+    from matchmaking_tpu.config import (
+        BatcherConfig,
+        ChaosConfig,
+        Config,
+        DurabilityConfig,
+        EngineConfig,
+        QueueConfig,
+        ReplicationConfig,
+    )
+    from matchmaking_tpu.service.app import MatchmakingApp
+    from matchmaking_tpu.service.broker import Properties
+    from matchmaking_tpu.service.replication import ReplicationHub
+
+    q = "failover.soak"
+    pairs = int(args.failover_pairs)
+    singles = int(args.failover_singles)
+    late_singles = int(args.failover_late_singles)
+    n_cycles = max(1, int(args.failover_cycles))
+    lag_cycle = n_cycles - 1  # the last kill lands with replication lag
+    lease_s = float(args.failover_lease_s)
+
+    def cfg_for(jdir: str, owner: str) -> Config:
+        return Config(
+            queues=(QueueConfig(name=q, rating_threshold=50.0,
+                                dedup_ttl_s=3600.0,
+                                send_queued_ack=False),),
+            engine=EngineConfig(backend="tpu", pool_capacity=4096,
+                                pool_block=512, batch_buckets=(16, 64),
+                                top_k=8,
+                                # warm_start: XLA compiles must land
+                                # before the load, not inside the
+                                # measured failover RTO.
+                                warm_start=True),
+            batcher=BatcherConfig(max_batch=64, max_wait_ms=2.0),
+            durability=DurabilityConfig(journal_dir=jdir, fsync="window"),
+            replication=ReplicationConfig(role="primary", owner=owner),
+        )
+
+    def cycle_load(cycle: int) -> "list[tuple[str, float]]":
+        """Designed load (the crash-soak recipe): adjacent-rating pairs
+        MUST match whatever the window framing; far singles never can —
+        the matched/waiting SETS are pure functions of the script."""
+        rows: list[tuple[str, float]] = []
+        for i in range(pairs):
+            base = 1000.0 + i * 200.0
+            rows.append((f"f{cycle}p{2 * i}", base))
+            rows.append((f"f{cycle}p{2 * i + 1}", base + 1.0))
+        for i in range(singles):
+            rows.append((f"f{cycle}s{i}", 50_000.0 + cycle * 10_000.0
+                         + i * 1_000.0))
+        worst = max(r for _, r in rows)
+        if worst >= 1e5:
+            raise ValueError(
+                f"--failover-cycles/--failover-singles too large: cycle "
+                f"{cycle} would publish rating {worst} >= the contract "
+                f"bound 1e5")
+        rng = np.random.default_rng(int(args.failover_seed) + cycle)
+        rng.shuffle(rows)
+        return rows
+
+    async def quiesce(app, rt, standby, matched_at_least: int,
+                      replication: bool = True) -> bool:
+        from matchmaking_tpu.testing.drain import fully_drained
+        for _ in range(6000):
+            await asyncio.sleep(0.005)
+            if standby is not None:
+                standby.pump()
+            if fully_drained(app, rt, q, matched_at_least,
+                             replication=replication):
+                return True
+        return False
+
+    async def one_run(run_idx: int) -> dict:
+        base_dir = tempfile.mkdtemp(prefix=f"mm_failover_r{run_idx}_")
+        # One replication fabric per run: the lease authority, the
+        # per-queue link, and the takeover handoff all survive the app
+        # boots (they model the parts of the deployment that OUTLIVE a
+        # host). Cycle 0's scripted drop/dup/delay seqs exercise the
+        # at-least-once retransmission path; they must heal to zero loss.
+        chaos = ChaosConfig(seed=int(args.failover_seed), queues=(q,),
+                            repl_drop_seqs=(1,), repl_dup_seqs=(2,),
+                            repl_delay_seqs=((3, 2),))
+        hub = ReplicationHub(lease_s=lease_s, chaos=chaos,
+                             seed=int(args.failover_seed))
+        lost = 0
+        lost_bound = 0
+        over_bound = 0
+        rtos: list[float] = []
+        lag_p99s: list[float] = []
+        transcripts: list[dict] = []
+        match_of: dict[str, set[str]] = {}
+        pre_waiting: set[str] = set()
+        kill_bound = 0
+        prev_rows: list[tuple[str, float]] = []
+        owner = "host0"
+        try:
+            for cycle in range(n_cycles):
+                app = MatchmakingApp(
+                    cfg_for(f"{base_dir}/host{cycle}", owner),
+                    replication_hub=hub)
+                await app.start()
+                rt = app.runtime(q)
+                recovered = {r.id for r in rt.engine.waiting()}
+                cycle_lost = len(pre_waiting - recovered)
+                lost += cycle_lost
+                lost_bound += kill_bound
+                over_bound += max(0, cycle_lost - kill_bound)
+                if cycle_lost > kill_bound:
+                    log(f"[failover-soak r{run_idx} c{cycle}] GATE: lost "
+                        f"{cycle_lost} players but the unacked-tail bound "
+                        f"at kill time was {kill_bound}")
+                if cycle > 0:
+                    rto = app.metrics.gauges.get(f"failover_rto_ms[{q}]")
+                    if rto is not None:
+                        rtos.append(float(rto))
+                    if rt.last_recovery is not None:
+                        transcripts.append(rt.last_recovery["transcript"])
+                reply_q = f"failover.replies.{cycle}"
+                app.broker.declare_queue(reply_q)
+
+                async def on_reply(delivery) -> None:
+                    d = json.loads(delivery.body)
+                    if d.get("status") == "matched":
+                        pid = str(d.get("player_id", ""))
+                        mid = (d.get("match") or {}).get("match_id")
+                        if pid and mid:
+                            match_of.setdefault(pid, set()).add(mid)
+
+                app.broker.basic_consume(reply_q, on_reply,
+                                         prefetch=1_000_000)
+                # The NEXT host's warm standby attaches before the load:
+                # it receives the baseline plus every streamed record.
+                standby = hub.standby(q, owner=f"host{cycle + 1}")
+                # At-least-once redelivery storm of every previous-cycle
+                # request: matched players must replay their cached match
+                # (the dedup cache crossed hosts via the stream).
+                for pid, rating in prev_rows:
+                    app.broker.publish(
+                        q, f'{{"id":"{pid}","rating":{rating}}}'.encode(),
+                        Properties(reply_to=reply_q, correlation_id=pid))
+                rows = cycle_load(cycle)
+                gap = 1.0 / max(1.0, float(args.failover_rate))
+                for k, (pid, rating) in enumerate(rows):
+                    app.broker.publish(
+                        q, f'{{"id":"{pid}","rating":{rating}}}'.encode(),
+                        Properties(reply_to=reply_q, correlation_id=pid))
+                    if k % 4 == 3:
+                        await asyncio.sleep(gap * 4)
+                ok = await quiesce(app, rt, standby,
+                                   matched_at_least=2 * pairs)
+                if not ok:
+                    log(f"[failover-soak r{run_idx} c{cycle}] WARNING: "
+                        f"quiesce timed out")
+                if cycle == lag_cycle and late_singles > 0:
+                    # Kill under lag: cut the link at the quiesced seq
+                    # boundary (acked == sent here, so the held tail is
+                    # exactly the late load — framing-independent), then
+                    # publish singles the standby will never see.
+                    repl = rt.replication
+                    hub.link(q).partition(repl.sent_seq + 1)
+                    for i in range(late_singles):
+                        pid = f"f{cycle}L{i}"
+                        app.broker.publish(
+                            q,
+                            f'{{"id":"{pid}","rating":{90_000.0 + i * 1_000.0}}}'
+                            .encode(),
+                            Properties(reply_to=reply_q,
+                                       correlation_id=pid))
+                    ok = await quiesce(app, rt, standby,
+                                       matched_at_least=2 * pairs,
+                                       replication=False)
+                    if not ok:
+                        log(f"[failover-soak r{run_idx} c{cycle}] "
+                            f"WARNING: lag-cycle quiesce timed out")
+                repl = rt.replication
+                kill_bound = repl.unacked_admit_players()
+                lat = app.metrics.latency.get(
+                    f"replication_ack_lag[{q}]")
+                if lat is not None and len(lat):
+                    lag_p99s.append(lat.percentile(99) * 1e3)
+                pre_waiting = {r.id for r in rt.engine.waiting()}
+                prev_rows = rows
+                log(f"[failover-soak r{run_idx} c{cycle}] matched="
+                    f"{app.metrics.counters.get('players_matched')} "
+                    f"waiting={len(pre_waiting)} lag={repl.lag()} "
+                    f"bound={kill_bound} epoch={repl.epoch}")
+                await app.crash()
+                # Takeover after lease expiry on the authority's
+                # scriptable clock (time is a caller-passed monotonic
+                # value by design — no wall-clock sleep needed).
+                standby.takeover(time.monotonic() + lease_s + 0.05)
+                owner = standby.owner
+            # Final successor: the last takeover must adopt too, then
+            # stop cleanly (CLEAN record + lease release).
+            app = MatchmakingApp(
+                cfg_for(f"{base_dir}/host{n_cycles}", owner),
+                replication_hub=hub)
+            await app.start()
+            rt = app.runtime(q)
+            recovered = {r.id for r in rt.engine.waiting()}
+            cycle_lost = len(pre_waiting - recovered)
+            lost += cycle_lost
+            lost_bound += kill_bound
+            over_bound += max(0, cycle_lost - kill_bound)
+            if cycle_lost > kill_bound:
+                log(f"[failover-soak r{run_idx} final] GATE: lost "
+                    f"{cycle_lost} players but the unacked-tail bound "
+                    f"at kill time was {kill_bound}")
+            rto = app.metrics.gauges.get(f"failover_rto_ms[{q}]")
+            if rto is not None:
+                rtos.append(float(rto))
+            if rt.last_recovery is not None:
+                transcripts.append(rt.last_recovery["transcript"])
+            await app.stop()
+        finally:
+            if not args.failover_keep_dirs:
+                shutil.rmtree(base_dir, ignore_errors=True)
+        dup = sum(1 for ids in match_of.values() if len(ids) > 1)
+        return {
+            "lost": lost,
+            "lost_bound": lost_bound,
+            "over_bound": over_bound,
+            "dup": dup,
+            "rtos": rtos,
+            "lag_p99s": lag_p99s,
+            "transcripts": transcripts,
+            "matched_players": len(match_of),
+        }
+
+    runs = [asyncio.run(one_run(i))
+            for i in range(max(1, int(args.failover_runs)))]
+    first = runs[0]
+    identical = None
+    if len(runs) >= 2:
+        identical = all(
+            json.dumps(r["transcripts"], sort_keys=True)
+            == json.dumps(first["transcripts"], sort_keys=True)
+            for r in runs[1:])
+    rtos = [x for r in runs for x in r["rtos"]]
+    lags = [x for r in runs for x in r["lag_p99s"]]
+    return {
+        "failover_cycles": n_cycles,
+        "failover_runs": len(runs),
+        "failover_lost": sum(r["lost"] for r in runs),
+        "failover_lost_bound": sum(r["lost_bound"] for r in runs),
+        "failover_lost_over_bound": sum(r["over_bound"] for r in runs),
+        "failover_dup": sum(r["dup"] for r in runs),
+        "failover_rto_ms": round(max(rtos), 3) if rtos else None,
+        "failover_rto_ms_mean": (round(sum(rtos) / len(rtos), 3)
+                                 if rtos else None),
+        "failover_recoveries": len(rtos),
+        "failover_matched_players": first["matched_players"],
+        "failover_transcript_identical": identical,
+        "replication_lag_ms_p99": (round(max(lags), 3) if lags else None),
+    }
+
+
 async def _scenario_cell(args, scn) -> dict:
     """One matrix cell: a fresh single-queue app driven by one scenario's
     seeded population load, with the autotuner closing the loop (unless
@@ -2423,6 +2709,45 @@ def main() -> None:
     p.add_argument("--crash-keep-dirs", action="store_true",
                    help="keep the per-run journal directories for "
                         "inspection")
+    p.add_argument("--failover-soak", action="store_true",
+                   help="hot-standby failover soak (ISSUE 17): seeded "
+                        "load through N primary-kill/standby-takeover "
+                        "cycles over the in-process replication link — "
+                        "lease-expiry-fenced takeover, successor adopts "
+                        "the standby's shadow, the last kill lands with "
+                        "real replication lag behind a scripted link "
+                        "partition. Emits failover_lost / failover_dup / "
+                        "failover_rto_ms / replication_lag_ms_p99 "
+                        "(bench_diff gates them, lower is better; "
+                        "lost/dup under the zero-baseline rule). "
+                        "Standalone mode: skips every other phase")
+    p.add_argument("--failover-cycles", type=int, default=3,
+                   help="kill/takeover cycles per run (last one is the "
+                        "kill-under-lag cycle)")
+    p.add_argument("--failover-runs", type=int, default=2,
+                   help="full soak repetitions; >= 2 additionally pins "
+                        "the takeover transcripts bit-identical across "
+                        "runs")
+    p.add_argument("--failover-pairs", type=int, default=6,
+                   help="matching pairs per cycle (deterministic "
+                        "designed load)")
+    p.add_argument("--failover-singles", type=int, default=3,
+                   help="never-matching singles per cycle (the adopted "
+                        "waiting pool must carry them across hosts)")
+    p.add_argument("--failover-late-singles", type=int, default=2,
+                   help="singles published BEHIND the lag-cycle link "
+                        "partition — the bounded loss the kill-under-lag "
+                        "gate measures (0 disables the lag cycle)")
+    p.add_argument("--failover-rate", type=float, default=800.0,
+                   help="publish pacing for the cycle load (req/s)")
+    p.add_argument("--failover-seed", type=int, default=29)
+    p.add_argument("--failover-lease-s", type=float, default=0.4,
+                   help="lease duration on the in-process authority "
+                        "(takeover expiry is scripted on the authority's "
+                        "clock, so the soak never sleeps it out)")
+    p.add_argument("--failover-keep-dirs", action="store_true",
+                   help="keep the per-host journal directories for "
+                        "inspection")
     p.add_argument("--scenario-matrix", default="",
                    help="scenario observatory (ISSUE 13): run the named "
                         "population-model scenarios (comma list, or 'all' "
@@ -2471,6 +2796,11 @@ def main() -> None:
                 flags + " --xla_force_host_platform_device_count=2"
             ).strip()
         print(json.dumps(bench_crash_soak(args)), flush=True)
+        return
+    if args.failover_soak:
+        # Standalone like --crash-soak: one queue, CPU-harness friendly
+        # (no mesh needed — the failover axis is hosts, not devices).
+        print(json.dumps(bench_failover_soak(args)), flush=True)
         return
     if args.scenario_matrix:
         # Standalone like --placement-soak: the matrix is its own

@@ -110,7 +110,16 @@ _CLOCKLIKE_TOKENS = ("deadline", "next_snapshot", "snapshot_due",
                      # sanctioned clock in the journal is the fsync
                      # INTERVAL check, which already uses monotonic.
                      "journal_seq", "record_seq", "snapshot_seq",
-                     "anchor_seq")
+                     "anchor_seq",
+                     # Lease/epoch arithmetic (ISSUE 17): fencing decides
+                     # which host may write, so a lease deadline, epoch,
+                     # ack watermark, or lag figure born from time.time()
+                     # would make FAILOVER (and the failover-soak's
+                     # bit-identical transcript) a function of wall-clock
+                     # jitter. The sanctioned clock for lease state is a
+                     # caller-passed time.monotonic() value; epochs and
+                     # ack seqs are counters.
+                     "lease_deadline", "epoch", "ack_seq", "lag_ms")
 
 
 def _clocklike(text: str) -> bool:

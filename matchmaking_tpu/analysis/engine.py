@@ -54,7 +54,7 @@ from matchmaking_tpu.analysis.core import (
 )
 
 #: Bump to invalidate every cache entry when rule semantics change.
-ANALYZER_VERSION = "2.2"
+ANALYZER_VERSION = "2.3"
 
 #: Per-file rule-module checkers (run per SourceFile; locks additionally
 #: takes the cross-file contract registry).

@@ -384,6 +384,31 @@ class ChaosConfig:
     #: Which logical device of the queue's binding "died" (-1 = the last
     #: device — the default models losing the highest shard).
     device_lost_device: int = -1
+    # ---- scripted replication-link faults (per-queue STREAM record seqs,
+    # ---- ISSUE 17; consumed by service/replication.InProcReplicationLink.
+    # ---- Scripted faults fire on a record's FIRST transmission only —
+    # ---- retransmission of the unacked tail is how the stream heals) ----
+    #: Stream record seqs whose first transmission is dropped.
+    repl_drop_seqs: tuple[int, ...] = ()
+    #: Stream record seqs delivered twice (the applier's dedup absorbs).
+    repl_dup_seqs: tuple[int, ...] = ()
+    #: Reordering: (seq, hold_n) — the record is held until ``hold_n``
+    #: further first transmissions pass, then delivered LATE (the
+    #: applier's gap buffer must absorb the out-of-order arrival).
+    repl_delay_seqs: tuple[tuple[int, int], ...] = ()
+    #: Link partitions: [pause_seq, resume_seq) — the stream buffers from
+    #: the pause record's first transmission until ANY transmission
+    #: reaches the resume seq (replication lag grows; the failover-soak's
+    #: lag-bounded-loss gate exercises exactly this window).
+    repl_partitions: tuple[tuple[int, int], ...] = ()
+    #: Seeded stream drop probability, hash-decided per
+    #: (seed, "repl", queue, seq) — reproducible like every seeded fault.
+    repl_drop_prob: float = 0.0
+    #: Scripted lease-expiry faults: global renewal-call indices the
+    #: LeaseAuthority refuses — the deterministic way to make a LIVE
+    #: primary's lease lapse so a standby can legally take over (the
+    #: split-brain fencing regression rides this).
+    repl_fail_renewals: tuple[int, ...] = ()
 
     def enabled(self) -> bool:
         return bool(
@@ -400,6 +425,15 @@ class ChaosConfig:
     def publish_faults(self) -> bool:
         """Any publish-side broker fault configured? (broker hot-path gate)"""
         return bool(self.dup_prob > 0 or self.dup_seqs or self.partitions)
+
+    def replication_faults(self) -> bool:
+        """Any replication-link fault configured? (read by the hub when
+        building links — the broker/engine gates above are untouched)."""
+        return bool(
+            self.repl_drop_seqs or self.repl_dup_seqs or self.repl_delay_seqs
+            or self.repl_partitions or self.repl_drop_prob > 0
+            or self.repl_fail_renewals
+        )
 
 
 @dataclass(frozen=True)
@@ -567,6 +601,44 @@ class DurabilityConfig:
 
     def enabled(self) -> bool:
         return bool(self.journal_dir)
+
+
+@dataclass(frozen=True)
+class ReplicationConfig:
+    """Hot-standby journal replication + fenced cross-host failover
+    (ISSUE 17, service/replication.py). The primary streams every sealed
+    WAL record per queue over a pluggable link to a warm standby that
+    applies them into a shadow pool/dedup/admission state and acks a
+    replication watermark; failover is lease/epoch-fenced (the standby
+    takes over only after lease expiry, bumps the epoch, and the
+    ex-primary's appends and publishes are refused at the journal-append
+    and response-publish seams). Requires durability (the WAL is the
+    stream source) and a :class:`~matchmaking_tpu.service.replication.
+    ReplicationHub` passed to ``MatchmakingApp(replication_hub=...)`` —
+    the hub is the in-process stand-in for the cross-host fabric (links
+    + lease service), so config alone cannot conjure a standby."""
+
+    #: ``""`` = replication off (zero hot-path work: no journal tap, no
+    #: fence checks, no pump task). ``"primary"`` = this app streams and
+    #: serves. (The standby side is not a full app — it is the hub's
+    #: StandbyApplier, promoted via takeover + successor adoption.)
+    role: str = ""
+    #: This host's lease identity. A failover successor must boot with
+    #: the TAKEOVER owner (the standby identity that bumped the epoch) —
+    #: acquire() by the current lease holder renews; by anyone else over
+    #: an unexpired lease it refuses (split-brain guard at boot).
+    owner: str = "primary"
+    #: Sender pump cadence (seconds): ack collection, stall retransmit,
+    #: lease renewal, lag gauges.
+    pump_interval_s: float = 0.02
+
+    def enabled(self) -> bool:
+        if self.role and self.role != "primary":
+            raise ValueError(
+                f"unknown replication role {self.role!r} (\"\" or "
+                f"\"primary\"; the standby is a hub-side StandbyApplier, "
+                f"not an app role)")
+        return bool(self.role)
 
 
 @dataclass(frozen=True)
@@ -817,6 +889,9 @@ class Config:
     #: Crash durability: write-ahead pool journal + hard-crash recovery
     #: (off by default — see DurabilityConfig.enabled()).
     durability: DurabilityConfig = field(default_factory=DurabilityConfig)
+    #: Hot-standby journal replication + fenced failover (off by default
+    #: — see ReplicationConfig.enabled(); requires durability).
+    replication: ReplicationConfig = field(default_factory=ReplicationConfig)
     #: Flight recorder / debug endpoints (tracing on by default).
     observability: ObservabilityConfig = field(
         default_factory=ObservabilityConfig)
@@ -858,6 +933,7 @@ class Config:
             ("chaos", ChaosConfig),
             ("overload", OverloadConfig),
             ("durability", DurabilityConfig),
+            ("replication", ReplicationConfig),
             ("observability", ObservabilityConfig),
             ("placement", PlacementConfig),
             ("autotune", AutotuneConfig),

@@ -192,6 +192,14 @@ class _QueueRuntime:
                 compact_records=dur.compact_records,
                 compact_bytes=dur.compact_bytes,
                 keep_snapshots=dur.keep_snapshots)
+        #: Hot-standby replication (ISSUE 17, service/replication.py;
+        #: None = replication off — zero hot-path cost: no journal tap,
+        #: no fence checks, no pump task). Built by app.start() via
+        #: ``start_replication`` AFTER journal recovery, so the baseline
+        #: the standby receives is the recovered truth. Owns the
+        #: primary→fenced role bit; the publish seams below consult it.
+        self.replication = None
+        self._repl_task: asyncio.Task | None = None
         #: Device-loss failover (ISSUE 15): the logical device a
         #: ChaosDeviceLostError (or a real XLA device-loss) named, consumed
         #: by the next ``_revive_engine`` to demote a sharded queue to its
@@ -802,6 +810,160 @@ class _QueueRuntime:
             "transcript": rec.transcript(),
         }
         return self.last_recovery
+
+    # ---- hot-standby replication (ISSUE 17, service/replication.py) -------
+
+    async def start_replication(self) -> None:
+        """Attach this queue to the replication fabric as the PRIMARY:
+        adopt a takeover handoff if one is registered (the failover
+        successor path), acquire/renew the lease, wire the journal's tap
+        + fence seams, ship the full-state baseline, and start the pump.
+        Called by app.start() AFTER recover_from_journal — the baseline
+        must be the recovered truth, not the pre-crash one."""
+        hub = self.app.replication_hub
+        rcfg = self.app.cfg.replication
+        if hub is None or not rcfg.enabled():
+            return
+        j = self.journal
+        if j is None:
+            raise ValueError(
+                "replication requires durability (journal_dir): the WAL "
+                "is the replication stream source")
+        from matchmaking_tpu.service.replication import QueueReplication
+
+        q = self.queue_cfg.name
+        adopted = hub.adopted.pop(q, None)
+        if adopted is not None:
+            await self.recover_from_replica(adopted)
+        owner = rcfg.owner or "primary"
+        # Raises LeaseHeldError when another owner's lease is live — the
+        # boot-time split-brain guard: two primaries cannot coexist.
+        epoch = hub.authority.acquire(q, owner, time.monotonic())
+        repl = QueueReplication(q, owner, epoch, hub.authority, hub.link(q),
+                                metrics=self.app.metrics,
+                                events=self.app.events)
+        async with self._engine_lock:
+            # Tap + baseline under the engine lock on the event loop: no
+            # dispatch (lock) and no settle (loop) can append between
+            # the seam install and the baseline capture, so the stream
+            # the standby sees is gapless from its baseline seq.
+            self.replication = repl
+            j.tap = repl.on_record
+            j.fence = repl.may_write
+            repl.send_baseline(j.seq, self._baseline_payload(time.time()))
+        self._repl_task = asyncio.create_task(self._replication_loop())
+        self.app.events.append(
+            "replication_attached", q,
+            f"owner {owner!r} epoch {epoch}, baseline seq {j.seq}")
+
+    # holds-lock: _engine_lock
+    def _baseline_payload(self, now: float) -> bytes:
+        """Full-state baseline for a freshly attached standby: the live
+        waiting pool as admit-shaped rows (region/mode by NAME — the
+        journal's portability rule), the unexpired dedup entries, and
+        the admission checkpoint."""
+        from matchmaking_tpu.service.replication import baseline_payload
+
+        try:
+            reqs = self.engine.waiting()
+        except Exception:
+            reqs = []
+        rows = [
+            [r.id, float(r.rating), float(r.rating_deviation), r.region,
+             r.game_mode,
+             (None if r.rating_threshold is None
+              else float(r.rating_threshold)),
+             float(r.enqueued_at), r.reply_to, r.correlation_id,
+             int(r.tier), float(r.deadline_at)]
+            for r in reqs
+        ]
+        recent = [(pid, body, exp)
+                  for pid, (body, exp) in self._recent.items() if exp > now]
+        adm = (self.admission.checkpoint()
+               if self.admission is not None else None)
+        return baseline_payload(rows, recent, adm)
+
+    async def recover_from_replica(self, adopted: "dict[str, Any]") -> dict:
+        """Cross-host failover adoption: apply the standby's shadow state
+        (waiting pool + dedup cache + admission checkpoint — everything
+        the replication stream delivered before the takeover cut) into
+        this fresh runtime. The whole span is the measured failover RTO
+        (``failover_rto_ms`` gauge + ``failover_takeover`` event) —
+        bounded by replication lag, never by journal size, because the
+        shadow already holds everything the old primary streamed."""
+        from matchmaking_tpu.utils.journal import row_to_request
+
+        rec = adopted["state"]
+        q = self.queue_cfg.name
+        t0 = time.perf_counter()
+        now = time.time()
+        async with self._engine_lock:
+            if hasattr(self.engine, "spec_invalidate"):
+                # Same contract as journal replay: the adopted pool
+                # invalidates any speculation against the empty boot pool.
+                self.engine.spec_invalidate("replica adoption")
+
+            def apply() -> int:
+                tail = [row_to_request(rec.waiting[pid])
+                        for pid in sorted(rec.waiting)]
+                if tail:
+                    self.engine.restore(tail, now)
+                if hasattr(self.engine, "heartbeat"):
+                    self.engine.heartbeat(now)
+                return len(tail)
+
+            n_tail = await asyncio.to_thread(apply)
+            for pid, (body, exp) in rec.recent.items():
+                if exp > now:
+                    self._recent.set(pid, (body, exp))
+            if rec.admission is not None and self.admission is not None:
+                self.admission.restore_state(rec.admission)
+        if self.journal is not None:
+            # Anchor the adopted pool in THIS host's journal immediately:
+            # a crash right after takeover must recover from local disk
+            # without needing the (dead) predecessor's stream again.
+            await self.compact_journal()
+        rto_ms = (time.perf_counter() - t0) * 1e3
+        self.app.metrics.set_gauge(f"failover_rto_ms[{q}]", round(rto_ms, 3))
+        self.app.metrics.counters.inc("failover_takeovers")
+        self.app.events.append(
+            "failover_takeover", q,
+            f"epoch {adopted['epoch']}: {n_tail} waiting players adopted, "
+            f"{len(rec.recent)} dedup entries, rto {rto_ms:.1f} ms")
+        log.warning(
+            "queue %r: failover takeover (epoch %s) — %d waiting players "
+            "adopted, %d dedup entries, rto %.1f ms",
+            q, adopted["epoch"], n_tail, len(rec.recent), rto_ms)
+        self.last_recovery = {
+            "rto_ms": round(rto_ms, 3),
+            "snapshot_players": 0,
+            "tail_players": n_tail,
+            "dedup_entries": len(rec.recent),
+            "fallback": False,
+            "corrupt": [],
+            "transcript": rec.transcript(),
+            "source": "replica",
+            "epoch": adopted["epoch"],
+        }
+        return self.last_recovery
+
+    async def _replication_loop(self) -> None:
+        """Sender pump: ack collection, stall retransmission, lease
+        renewal, lag gauges. Supervised like the other timers — one
+        failed pump must not end replication for the process."""
+        interval = self.app.cfg.replication.pump_interval_s
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                r = self.replication
+                if r is None:
+                    continue
+                r.pump(time.monotonic())
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("replication pump failed; retrying")
+                self.app.metrics.counters.inc("replication_pump_errors")
 
     def _note_failure(self, err: BaseException) -> None:
         """Classify an engine failure before the revive: a device-LOSS
@@ -2561,6 +2723,15 @@ class _QueueRuntime:
         (respond→publish) in the attribution taxonomy (PR 6 carry-over)."""
         if not reply_to:
             return
+        r = self.replication
+        if r is not None and not r.may_publish():
+            # Epoch fencing (ISSUE 17): a superseded ex-primary must not
+            # make ANY response visible — the standby's successor owns
+            # these players now, and a fenced publish is exactly the
+            # split-brain double match the lease/epoch machinery exists
+            # to kill. Refused and counted, never silent.
+            self.app.metrics.counters.inc("fenced_publish_refused")
+            return
         # Write-ahead: a terminal response must never be visible before
         # its journal record is durable (fsync per policy) — the invariant
         # that makes recovery yield zero double matches.
@@ -2577,6 +2748,14 @@ class _QueueRuntime:
         "respond" mark as the batch publish starts — publish_lag keeps its
         queueing semantics (…→respond WAIT) and the publish itself is the
         respond→publish WORK gap, now amortized over the window."""
+        r = self.replication
+        if r is not None and not r.may_publish():
+            # Epoch-fencing twin of the _publish_body check: the whole
+            # window of responses is refused at once.
+            self.app.metrics.counters.inc(
+                "fenced_publish_refused",
+                sum(1 for reply_to, _c, _b, _t in rows if reply_to))
+            return
         # Write-ahead twin of _publish_body: ONE commit (and fsync, per
         # policy) covers the whole window's terminal records before any
         # of its responses become visible.
@@ -3363,6 +3542,8 @@ class _QueueRuntime:
             self._spec_task.cancel()
         if self._durability is not None:
             self._durability.cancel()
+        if self._repl_task is not None:
+            self._repl_task.cancel()
         # Drain the batcher BEFORE cancelling the consumer so the final
         # windows can still ack their deliveries; then collect any windows
         # the final flush left in flight.
@@ -3377,6 +3558,12 @@ class _QueueRuntime:
             # skips crash recovery (its ABSENCE is the crash detector).
             self.journal.mark_clean()
             self.journal.close()
+        if self.replication is not None:
+            # mark_clean just streamed the CLEAN record through the tap;
+            # the final pump sweeps acks and releases the lease so a
+            # standby may promote without waiting out the expiry (and
+            # knows from CLEAN that no failover is NEEDED).
+            self.replication.shutdown(time.monotonic())
 
     def abandon(self) -> None:
         """Crash-fidelity teardown (bench --crash-soak / durability
@@ -3387,8 +3574,8 @@ class _QueueRuntime:
         otherwise leak across cycles); a real crash frees them with the
         process."""
         for task in (self._sweeper, self._rescanner, self._health,
-                     self._spec_task, self._durability, self._collector,
-                     self.batcher._task):
+                     self._spec_task, self._durability, self._repl_task,
+                     self._collector, self.batcher._task):
             if task is not None:
                 task.cancel()
         if self.journal is not None:
@@ -3402,8 +3589,15 @@ class _QueueRuntime:
 class MatchmakingApp:
     """Boot/own the whole service (SURVEY.md §3 Entry 1)."""
 
-    def __init__(self, cfg: Config | None = None, broker: InProcBroker | None = None):
+    def __init__(self, cfg: Config | None = None,
+                 broker: InProcBroker | None = None,
+                 replication_hub=None):
         self.cfg = cfg or Config()
+        #: Replication fabric (ISSUE 17, service/replication.ReplicationHub;
+        #: None = no fabric). Injected like a foreign broker: the hub is
+        #: SHARED between a primary app, its standby appliers, and a
+        #: failover successor — in-process here, per-host over DCN later.
+        self.replication_hub = replication_hub
         obs = self.cfg.observability
         #: Lifecycle event timeline (/debug/events): breaker trips, probes,
         #: delegations, revives, chaos faults — one bounded ring, appended
@@ -3501,6 +3695,25 @@ class MatchmakingApp:
             for rt in self._runtimes.values():
                 await rt.recover_from_journal()
                 rt.start_durability_timer()
+        if self.cfg.replication.enabled():
+            # Role state machine (ISSUE 17): this app boots as PRIMARY for
+            # every queue — adopt a registered takeover handoff, acquire
+            # the lease (LeaseHeldError = a live primary already owns it:
+            # the boot-time split-brain guard), stream from the WAL tap.
+            # Runs AFTER journal recovery so the standby's baseline is
+            # the recovered truth, BEFORE any control plane or traffic.
+            if self.replication_hub is None:
+                raise ValueError(
+                    "cfg.replication.role is set but no ReplicationHub was "
+                    "passed to MatchmakingApp(replication_hub=...) — the "
+                    "hub is the shared fabric (links + lease authority) a "
+                    "standby attaches through")
+            if not self.cfg.durability.enabled():
+                raise ValueError(
+                    "replication requires durability (journal_dir): the "
+                    "WAL is the replication stream source")
+            for rt in self._runtimes.values():
+                await rt.start_replication()
         if self.placement is not None:
             self.placement.bind_boot_placements()
             self.placement.start()

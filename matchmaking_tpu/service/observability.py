@@ -124,6 +124,17 @@ def build_report(app) -> dict[str, Any]:
     }
     if durability:
         report["durability"] = durability
+    # Hot-standby replication (ISSUE 17): per-queue role/epoch/watermark
+    # block — the failover story (who owns the queue, how far behind the
+    # standby is, what a host loss right now would cost) must be readable
+    # from /metrics alone, like the RTO story above.
+    replication = {
+        name: rt.replication.snapshot()
+        for name, rt in app._runtimes.items()
+        if getattr(rt, "replication", None) is not None
+    }
+    if replication:
+        report["replication"] = replication
     # Critical-path attribution + SLO burn state (ISSUE 6).
     attribution = getattr(app, "attribution", None)
     if attribution is not None:
@@ -376,6 +387,21 @@ class ObservabilityServer:
             q_mon = monitors.get(name + "#quality")
             if q_mon is not None:
                 entry["slo_quality"] = q_mon.snapshot()
+            # Replication role + lag (ISSUE 17): a load balancer must see
+            # "fenced" (stop routing here — the successor owns the queue)
+            # and operators must see the lag watermark that bounds what a
+            # host loss right now would cost.
+            repl = getattr(rt, "replication", None)
+            if repl is not None:
+                entry["replication"] = {
+                    "role": repl.role,
+                    "epoch": repl.epoch,
+                    "lag": repl.lag(),
+                    "acked_seq": repl.acked_seq,
+                    "sent_seq": repl.sent_seq,
+                }
+                if repl.role == "fenced":
+                    degraded.append(name)
             queues[name] = entry
         # Burning keys include tier monitors ("queue@tN"): routing reacts
         # to the aggregate, placement/QoS tooling to the tier split.

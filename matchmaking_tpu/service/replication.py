@@ -1,0 +1,708 @@
+"""Hot-standby journal replication with fenced cross-host failover
+(ISSUE 17).
+
+PR 15 made single-host hard crashes survivable: the per-queue WAL
+(utils/journal.py) replays a dead PROCESS's pool on the same disk. A dead
+HOST still lost every queue it owned. This module ships the sealed WAL
+stream to a warm standby so the pool can move hosts:
+
+- The **primary** streams every sealed journal record (already CRC-framed
+  and seq-watermarked by the journal) per queue over a pluggable link —
+  :class:`InProcReplicationLink` now, the DCN transport later (same four
+  methods: ``send``/``recv``/``ack``/``acked``). The journal's ``tap``
+  seam hands each record to :meth:`QueueReplication.on_record` at append
+  time; the sender retains the unacked tail for retransmission, so the
+  link is at-least-once with cumulative acks and the stream survives
+  scripted drops/delays/partitions (ChaosConfig ``repl_*``).
+- The **standby** (:class:`StandbyApplier`) continuously applies the
+  stream into a shadow pool + dedup/admission state (the same
+  ``RecoveredQueue`` shape crash recovery uses) and acks a replication
+  watermark — the highest contiguously applied seq. Out-of-order arrivals
+  buffer until the gap fills; duplicates are idempotent.
+- **Failover is lease/epoch-fenced** to kill split-brain: ownership lives
+  in :class:`LeaseAuthority` (the in-process stand-in for the external
+  lease service a DCN deployment would run). The standby takes over only
+  after lease expiry, which bumps the epoch; the old primary's post-fence
+  appends and publishes are refused because the journal-append seam
+  (``PoolJournal.fence``) and the response-publish seam
+  (``_publish_body``/``_publish_batch``) both check
+  :meth:`LeaseAuthority.is_current` — a stale (owner, epoch) pair fails
+  the check no matter how alive the ex-primary feels. Takeover replays
+  only the unacked tail (everything else is already applied), so RTO is
+  bounded by replication lag, not journal size.
+
+Roles form a small state machine per queue: ``primary`` (holds the
+lease, streams, publishes) → ``fenced`` (epoch superseded: appends raise
+:class:`~matchmaking_tpu.utils.journal.FencedError`, publishes are
+refused and counted). The standby is not a full app — it is this
+module's applier, promoted into a fresh app via
+``_QueueRuntime.recover_from_replica`` at takeover.
+
+Determinism: lease deadlines are pure functions of caller-passed ``now``
+values (``time.monotonic()`` at every call site — the matchlint
+determinism rule bans wall-clock arithmetic into lease/epoch state), and
+link faults are scripted by stream record seq, so a seeded failover soak
+replays bit-identically.
+"""
+from __future__ import annotations
+
+import base64
+import collections
+import json
+import logging
+import threading
+import time
+from typing import Any
+
+from matchmaking_tpu.utils.journal import (
+    RT_ADMISSION, RT_ADMIT, RT_CLEAN, RT_TERMINAL, RT_TERMINALS,
+    FencedError, RecoveredQueue)
+
+__all__ = [
+    "RT_REPL_SNAPSHOT", "FencedError", "LeaseHeldError", "LeaseAuthority",
+    "InProcReplicationLink", "StandbyApplier", "QueueReplication",
+    "ReplicationHub", "baseline_payload",
+]
+
+log = logging.getLogger(__name__)
+
+#: Replication-stream-only record type: the primary's full-state baseline
+#: at sender attach (waiting rows + dedup cache + admission checkpoint).
+#: Never written to a journal segment — it exists so a standby can attach
+#: at ANY point in a queue's life, not only at seq 0 (the on-disk journal
+#: compacts its history into snapshots the stream never replays).
+RT_REPL_SNAPSHOT = 100
+
+
+class LeaseHeldError(RuntimeError):
+    """Acquire/takeover refused: another owner holds an unexpired lease."""
+
+
+def baseline_payload(rows: "list[list[Any]]",
+                     recent: "list[tuple[str, bytes, float]]",
+                     admission: "dict[str, Any] | None") -> bytes:
+    """The RT_REPL_SNAPSHOT payload: admit-shaped waiting rows (the
+    journal's portable row format — region/mode by NAME), the live dedup
+    entries, and the admission decision checkpoint."""
+    return json.dumps(
+        {"rows": rows,
+         "recent": [[pid, base64.b64encode(body).decode("ascii"), exp]
+                    for pid, body, exp in recent],
+         "admission": admission},
+        separators=(",", ":")).encode("utf-8")
+
+
+class _Lease:
+    __slots__ = ("owner", "epoch", "deadline")
+
+    def __init__(self, owner: str, epoch: int, deadline: float):
+        self.owner = owner
+        self.epoch = epoch
+        self.deadline = deadline
+
+
+class LeaseAuthority:
+    """The fencing truth: per-queue ``(owner, epoch, lease deadline)``.
+
+    In-process stand-in for the external lease/coordination service a
+    cross-host deployment runs (the DCN seam): everything is a pure
+    function of caller-passed ``now`` values (``time.monotonic()`` at the
+    call sites), so lease expiry is scriptable and a seeded soak replays
+    bit-identically. Thread-safe — the journal-append fence check runs on
+    engine-lock-holding worker threads while the pump loop renews on the
+    event loop.
+
+    The epoch is the fencing token: it bumps on every ownership CHANGE
+    (takeover after expiry, or acquire over an expired lease by a new
+    owner) and never goes backwards. :meth:`is_current` is the check the
+    journal-append and response-publish seams run — a superseded (owner,
+    epoch) pair can never write or publish again.
+    """
+
+    def __init__(self, lease_s: float = 0.5,
+                 fail_renewals: "tuple[int, ...]" = ()):
+        self.lease_s = float(lease_s)
+        #: Scripted lease-expiry faults (ChaosConfig.repl_fail_renewals):
+        #: global renewal-call indices the authority refuses — the
+        #: deterministic way to make a live primary's lease lapse.
+        self._fail_renewals = frozenset(int(i) for i in fail_renewals)
+        self._renewals = 0
+        self._leases: "dict[str, _Lease]" = {}
+        self._lock = threading.Lock()
+
+    def acquire(self, queue: str, owner: str, now: float) -> int:
+        """Take (or re-take) the queue's lease. Same-owner re-acquire
+        renews in place (same epoch); a new owner may only acquire over
+        an absent or EXPIRED lease — and that bumps the epoch, fencing
+        the previous holder. Raises :class:`LeaseHeldError` otherwise."""
+        with self._lock:
+            lease = self._leases.get(queue)
+            if lease is None:
+                self._leases[queue] = _Lease(owner, 1, now + self.lease_s)
+                return 1
+            if lease.owner == owner:
+                lease.deadline = now + self.lease_s
+                return lease.epoch
+            if now < lease.deadline:
+                raise LeaseHeldError(
+                    f"queue {queue!r}: lease held by {lease.owner!r} "
+                    f"(epoch {lease.epoch}) and not expired")
+            lease.owner = owner
+            lease.epoch += 1
+            lease.deadline = now + self.lease_s
+            return lease.epoch
+
+    def renew(self, queue: str, owner: str, epoch: int, now: float) -> bool:
+        """Extend the lease. False when the (owner, epoch) pair is no
+        longer current — the caller must treat itself as fenced — or when
+        a scripted renewal fault fires (the lease then lapses on the
+        authority's clock even though the holder is alive)."""
+        with self._lock:
+            idx = self._renewals
+            self._renewals = idx + 1
+            if idx in self._fail_renewals:
+                return False
+            lease = self._leases.get(queue)
+            if lease is None or lease.owner != owner or lease.epoch != epoch:
+                return False
+            lease.deadline = now + self.lease_s
+            return True
+
+    def expired(self, queue: str, now: float) -> bool:
+        with self._lock:
+            lease = self._leases.get(queue)
+            return lease is None or now >= lease.deadline
+
+    def takeover(self, queue: str, owner: str, now: float,
+                 force: bool = False) -> int:
+        """The failover step: a standby claims the queue AFTER lease
+        expiry (``force`` is the operator override for tests/drills),
+        bumping the epoch — every check the old primary runs from now on
+        fails, which is what makes split-brain impossible rather than
+        merely unlikely."""
+        with self._lock:
+            lease = self._leases.get(queue)
+            if lease is None:
+                self._leases[queue] = _Lease(owner, 1, now + self.lease_s)
+                return 1
+            if not force and now < lease.deadline:
+                raise LeaseHeldError(
+                    f"queue {queue!r}: takeover refused — lease held by "
+                    f"{lease.owner!r} (epoch {lease.epoch}) is not expired")
+            lease.owner = owner
+            lease.epoch += 1
+            lease.deadline = now + self.lease_s
+            return lease.epoch
+
+    def release(self, queue: str, owner: str, epoch: int, now: float) -> None:
+        """Graceful handoff: a cleanly-shutting-down primary expires its
+        own lease so a standby may take over immediately (the CLEAN
+        record it just streamed says no failover is NEEDED — release
+        just removes the wait if one happens anyway)."""
+        with self._lock:
+            lease = self._leases.get(queue)
+            if (lease is not None and lease.owner == owner
+                    and lease.epoch == epoch):
+                lease.deadline = now
+
+    def is_current(self, queue: str, owner: str, epoch: int) -> bool:
+        """THE fencing check (journal-append + response-publish seams)."""
+        with self._lock:
+            lease = self._leases.get(queue)
+            return (lease is not None and lease.owner == owner
+                    and lease.epoch == epoch)
+
+    def epoch_of(self, queue: str) -> int:
+        with self._lock:
+            lease = self._leases.get(queue)
+            return 0 if lease is None else lease.epoch
+
+
+class InProcReplicationLink:
+    """The pluggable stream transport — in-process now, the DCN seam
+    later (a cross-host transport implements the same four methods over
+    the wire; the framing is the journal's, already CRC'd).
+
+    Semantics: at-least-once, NOT in-order (the chaos vocabulary can
+    drop, duplicate, delay, or partition individual records), with one
+    cumulative ack watermark flowing back. Faults are scripted per stream
+    record seq (ChaosConfig ``repl_drop_seqs``/``repl_dup_seqs``/
+    ``repl_delay_seqs``/``repl_partitions``) or seeded per
+    ``hash01(seed, "repl", queue, seq)`` — pure functions of record
+    identity, so two runs inject bit-identical faults. Scripted faults
+    fire on a seq's FIRST transmission only: retransmissions of the
+    unacked tail are how the stream converges after a fault."""
+
+    def __init__(self, queue: str, chaos=None, seed: int = 0):
+        self.queue = queue
+        self._seed = seed
+        self._drop = frozenset(getattr(chaos, "repl_drop_seqs", ()) or ())
+        self._dup = frozenset(getattr(chaos, "repl_dup_seqs", ()) or ())
+        self._delay = {int(s): int(h) for s, h
+                       in (getattr(chaos, "repl_delay_seqs", ()) or ())}
+        self._partitions = [(int(a), int(b)) for a, b
+                            in (getattr(chaos, "repl_partitions", ()) or ())]
+        self._drop_prob = float(getattr(chaos, "repl_drop_prob", 0.0) or 0.0)
+        #: Records deliverable to the standby's next recv().
+        self._wire: "collections.deque[tuple[int, int, bytes]]" = (
+            collections.deque())
+        #: Delayed records: [remaining first-transmission holds, record].
+        self._delayed: "list[list[Any]]" = []
+        self._partitioned = False
+        self._resume_at = 0
+        self._partition_buf: "list[tuple[int, int, bytes]]" = []
+        #: Seqs whose first transmission happened (chaos fires once).
+        self._seen: "set[int]" = set()
+        #: Highest seq ever handed to recv() — the receive horizon the
+        #: ack watermark may never pass (sanitizer: ack-beyond-received).
+        self.max_delivered = 0
+        self._acked = 0
+        self.counters = collections.Counter()
+
+    def partition(self, start: int, resume: "int | None" = None) -> None:
+        """Inject a scripted partition at runtime: transmissions of seqs
+        ``>= start`` are held until any transmission reaches ``resume``
+        (default: never — the bench's kill-under-lag cycle cuts the link
+        at a quiesced seq boundary so the held tail is exactly the
+        designed late load, whatever the window framing did)."""
+        self._partitions.append((int(start),
+                                 (1 << 62) if resume is None else int(resume)))
+
+    # ---- primary side ------------------------------------------------------
+
+    def send(self, seq: int, rtype: int, payload: bytes) -> None:
+        rec = (seq, rtype, payload)
+        first = seq not in self._seen
+        if first:
+            self._seen.add(seq)
+        else:
+            self.counters["retransmits"] += 1
+        self.counters["sent"] += 1
+        # Partition scripting: pause on the scripted seq's first
+        # transmission; resume when ANY transmission reaches the resume
+        # seq (a dropped resume record must not wedge the link — the
+        # retransmitted tail heals it).
+        if self._partitioned and seq >= self._resume_at:
+            self._partitioned = False
+            for held in self._partition_buf:
+                self._wire.append(held)
+            self._partition_buf.clear()
+        elif first and not self._partitioned:
+            for pause, resume in self._partitions:
+                if seq == pause:
+                    self._partitioned = True
+                    self._resume_at = resume
+                    self.counters["partitions"] += 1
+                    break
+        # Age scripted delays by first transmissions, releasing at 0 (a
+        # released record re-enters delivery LATE — the reordering the
+        # applier's gap buffer must absorb). Released records still
+        # respect an active partition.
+        if first and self._delayed:
+            due = [d for d in self._delayed if d[0] <= 1]
+            self._delayed = [[h - 1, r] for h, r in self._delayed if h > 1]
+            for _h, held in due:
+                if self._partitioned:
+                    self._partition_buf.append(held)
+                else:
+                    self._wire.append(held)
+        if self._partitioned:
+            self._partition_buf.append(rec)
+            return
+        if first:
+            if seq in self._drop:
+                self.counters["dropped"] += 1
+                return
+            if self._drop_prob > 0:
+                from matchmaking_tpu.utils.chaos import hash01
+
+                if hash01(self._seed, "repl", self.queue, seq) < self._drop_prob:
+                    self.counters["dropped"] += 1
+                    return
+            hold = self._delay.get(seq)
+            if hold is not None:
+                self.counters["delayed"] += 1
+                self._delayed.append([hold, rec])
+                return
+            if seq in self._dup:
+                self.counters["dup"] += 1
+                self._wire.append(rec)
+        self._wire.append(rec)
+
+    # ---- standby side ------------------------------------------------------
+
+    def recv(self) -> "list[tuple[int, int, bytes]]":
+        out = list(self._wire)
+        self._wire.clear()
+        for rec in out:
+            if rec[0] > self.max_delivered:
+                self.max_delivered = rec[0]
+        self.counters["delivered"] += len(out)
+        return out
+
+    def ack(self, seq: int) -> None:
+        """Cumulative replication watermark from the standby: everything
+        ``<= seq`` is applied into the shadow. (The sanitizer's
+        replication twin patches exactly this to catch an ack past the
+        receive horizon.)"""
+        self._acked = max(self._acked, int(seq))
+
+    @property
+    def acked(self) -> int:
+        return self._acked
+
+
+class StandbyApplier:
+    """The warm standby for ONE queue: applies the replication stream
+    into a shadow ``RecoveredQueue`` (pool membership + dedup cache +
+    admission checkpoint — the exact shape crash recovery applies) and
+    acks the highest contiguously applied seq.
+
+    Ordering: records apply strictly in seq order. Arrivals ahead of the
+    gap buffer in ``_ahead`` until the sender's retransmission fills it;
+    arrivals at or below the watermark are duplicates and drop
+    idempotently. An RT_REPL_SNAPSHOT baseline REPLACES the shadow and
+    re-bases the watermark — it is how a standby attaches mid-life.
+
+    Takeover (:meth:`takeover`): one final pump applies whatever the link
+    already delivered (the unacked tail — all a takeover ever replays,
+    which is why RTO is bounded by replication lag), then the authority
+    bumps the epoch, fencing the ex-primary."""
+
+    def __init__(self, queue: str, link: InProcReplicationLink,
+                 authority: "LeaseAuthority | None" = None,
+                 owner: str = "standby", hub: "ReplicationHub | None" = None):
+        self.queue = queue
+        self.link = link
+        self.authority = authority
+        self.owner = owner
+        self.hub = hub
+        self.shadow = RecoveredQueue(queue=queue, clean=False)
+        #: Highest contiguously applied seq — the ack watermark.
+        self.applied_seq = 0
+        self._ahead: "dict[int, tuple[int, int, bytes]]" = {}
+        self.counters = collections.Counter()
+
+    def pump(self) -> int:
+        """Drain the link, apply in order, ack the new watermark.
+        Returns the number of records applied this call."""
+        before = self.counters["applied"]
+        for seq, rtype, payload in self.link.recv():
+            if rtype == RT_REPL_SNAPSHOT:
+                # A stale baseline (seq below the watermark) is a
+                # retransmitted duplicate of state we already hold.
+                if seq >= self.applied_seq:
+                    self._apply(seq, rtype, payload)
+                    self._ahead = {s: r for s, r in self._ahead.items()
+                                   if s > self.applied_seq}
+                    self._drain_ahead()
+                else:
+                    self.counters["dups"] += 1
+                continue
+            if seq <= self.applied_seq:
+                self.counters["dups"] += 1
+                continue
+            if seq == self.applied_seq + 1:
+                self._apply(seq, rtype, payload)
+                self._drain_ahead()
+            else:
+                self._ahead[seq] = (seq, rtype, payload)
+                self.counters["buffered"] += 1
+        applied = self.counters["applied"] - before
+        self.link.ack(self.applied_seq)
+        return applied
+
+    def _drain_ahead(self) -> None:
+        while True:
+            rec = self._ahead.pop(self.applied_seq + 1, None)
+            if rec is None:
+                return
+            self._apply(*rec)
+
+    def _apply(self, seq: int, rtype: int, payload: bytes) -> None:
+        """THE apply seam (the sanitizer's replication twin patches
+        exactly this): one record into the shadow, mirroring the journal
+        replay semantics in ``PoolJournal._attach`` — admits (re)enter
+        waiting, terminals move players to removed + the dedup cache,
+        admission checkpoints replace, CLEAN marks the stream clean and
+        any later mutation reopens it."""
+        sh = self.shadow
+        if rtype == RT_REPL_SNAPSHOT:
+            d = json.loads(payload.decode("utf-8"))
+            sh = RecoveredQueue(queue=self.queue, clean=False)
+            for row in d["rows"]:
+                sh.waiting[str(row[0])] = row
+            for pid, b64, exp in d["recent"]:
+                sh.recent[str(pid)] = (base64.b64decode(b64), float(exp))
+            sh.admission = d.get("admission")
+            self.shadow = sh
+            self.counters["snapshots"] += 1
+        elif rtype == RT_CLEAN:
+            sh.clean = True
+        elif rtype == RT_ADMIT:
+            sh.clean = False
+            for row in json.loads(payload.decode("utf-8"))["rows"]:
+                sh.waiting[str(row[0])] = row
+                sh.removed.discard(str(row[0]))
+        elif rtype in (RT_TERMINAL, RT_TERMINALS):
+            sh.clean = False
+            d = json.loads(payload.decode("utf-8"))
+            entries = (d["t"] if rtype == RT_TERMINALS
+                       else [[d["id"], d["body"], d["exp"]]])
+            for pid, b64, exp in entries:
+                pid = str(pid)
+                sh.recent[pid] = (base64.b64decode(b64), float(exp))
+                sh.waiting.pop(pid, None)
+                sh.removed.add(pid)
+        elif rtype == RT_ADMISSION:
+            sh.clean = False
+            sh.admission = json.loads(payload.decode("utf-8"))
+        sh.last_seq = max(sh.last_seq, seq)
+        self.applied_seq = seq
+        self.counters["applied"] += 1
+
+    def takeover(self, now: float, force: bool = False) -> int:
+        """Promote this standby: apply the delivered tail, bump the
+        epoch (fencing the ex-primary), and register the shadow with the
+        hub for the successor app to adopt. Returns the new epoch."""
+        assert self.authority is not None, "takeover needs a LeaseAuthority"
+        self.pump()
+        new_epoch = self.authority.takeover(self.queue, self.owner, now,
+                                            force=force)
+        self.shadow.clean = False
+        if self.hub is not None:
+            self.hub.adopted[self.queue] = {
+                "epoch": new_epoch, "owner": self.owner, "state": self.shadow,
+                "applied_seq": self.applied_seq,
+            }
+        return new_epoch
+
+
+class QueueReplication:
+    """Primary-side per-queue replication runtime (lives on
+    ``_QueueRuntime.replication``): retains the unacked tail for
+    retransmission, tracks the sent/acked watermarks, renews the lease,
+    and owns the role bit of the primary → fenced state machine.
+
+    The journal's ``tap`` calls :meth:`on_record` under the journal lock
+    (deque append + counters — cheap); its ``fence`` calls
+    :meth:`may_write`, and the response-publish seams call
+    :meth:`may_publish` — both funnel into the authority's epoch check,
+    so a superseded ex-primary cannot append or publish no matter which
+    thread or path tries."""
+
+    def __init__(self, queue: str, owner: str, epoch: int,
+                 authority: LeaseAuthority, link: InProcReplicationLink,
+                 metrics=None, events=None):
+        self.queue = queue
+        self.owner = owner
+        self.epoch = epoch
+        self.authority = authority
+        self.link = link
+        self.metrics = metrics
+        self.events = events
+        self.role = "primary"
+        self._unacked: "collections.OrderedDict[int, tuple[int, bytes]]" = (
+            collections.OrderedDict())
+        self._send_t: "dict[int, float]" = {}
+        self.sent_seq = 0
+        self.acked_seq = 0
+        self._stalled_pumps = 0
+        self._lock = threading.Lock()
+
+    # ---- stream (journal tap) ----------------------------------------------
+
+    def on_record(self, seq: int, rtype: int, payload: bytes) -> None:
+        """Journal tap: ship one sealed record. Runs under the journal
+        lock on whatever thread appended — must stay cheap and must
+        never raise into the append."""
+        if self.role != "primary":
+            return
+        with self._lock:
+            self._unacked[seq] = (rtype, payload)
+            self._send_t[seq] = time.monotonic()
+            if seq > self.sent_seq:
+                self.sent_seq = seq
+        try:
+            self.link.send(seq, rtype, payload)
+        except Exception:
+            log.exception("replication send failed for %r seq %d",
+                          self.queue, seq)
+
+    def send_baseline(self, seq: int, payload: bytes) -> None:
+        """Ship the full-state baseline at attach (RT_REPL_SNAPSHOT,
+        carrying the journal seq it summarizes). Retained and
+        retransmitted like any record — a standby cannot start from a
+        dropped baseline."""
+        if seq > 0:
+            with self._lock:
+                self._unacked[seq] = (RT_REPL_SNAPSHOT, payload)
+                self._send_t[seq] = time.monotonic()
+                if seq > self.sent_seq:
+                    self.sent_seq = seq
+        try:
+            self.link.send(seq, RT_REPL_SNAPSHOT, payload)
+        except Exception:
+            log.exception("replication baseline send failed for %r",
+                          self.queue)
+
+    # ---- fencing (the two seams) -------------------------------------------
+
+    def may_write(self) -> bool:
+        """Journal-append fence (``PoolJournal.fence``): False flips the
+        role to fenced and the journal raises FencedError."""
+        return self._check_current("journal append")
+
+    def may_publish(self) -> bool:
+        """Response-publish fence (``_publish_body``/``_publish_batch``):
+        False means the caller must drop the publish (and count it)."""
+        return self._check_current("response publish")
+
+    def superseded(self) -> bool:
+        """Side-effect-free twin of the fence checks (sanitizer /
+        telemetry): True when the authority no longer recognizes this
+        (owner, epoch) pair."""
+        return not self.authority.is_current(self.queue, self.owner,
+                                             self.epoch)
+
+    def _check_current(self, site: str) -> bool:
+        if self.role == "fenced":
+            return False
+        if self.authority.is_current(self.queue, self.owner, self.epoch):
+            return True
+        self._fence(f"{site} refused: epoch {self.epoch} superseded by "
+                    f"{self.authority.epoch_of(self.queue)}")
+        return False
+
+    def _fence(self, why: str) -> None:
+        if self.role == "fenced":
+            return
+        self.role = "fenced"
+        if self.metrics is not None:
+            self.metrics.counters.inc("replication_fenced")
+        if self.events is not None:
+            self.events.append("replication_fenced", self.queue, why)
+        log.warning("queue %r: FENCED (%s)", self.queue, why)
+
+    # ---- pump (ack collection / retransmit / lease renewal) ----------------
+
+    def pump(self, now: float) -> None:
+        """One sender tick (``now`` = time.monotonic() at the call site):
+        collect the standby's cumulative ack, retransmit the unacked tail
+        when acks stall across consecutive pumps, renew the lease, and
+        publish the lag gauges."""
+        a = self.link.acked
+        progress = a > self.acked_seq
+        if progress:
+            with self._lock:
+                for seq in [s for s in self._unacked if s <= a]:
+                    del self._unacked[seq]
+                    t = self._send_t.pop(seq, None)
+                    if t is not None and self.metrics is not None:
+                        self.metrics.record_latency(
+                            f"replication_ack_lag[{self.queue}]", now - t)
+                self.acked_seq = a
+            self._stalled_pumps = 0
+        else:
+            self._stalled_pumps += 1
+        if (self.role == "primary" and not progress
+                and self._stalled_pumps >= 2):
+            with self._lock:
+                tail = list(self._unacked.items())
+            for seq, (rtype, payload) in tail:
+                self.link.send(seq, rtype, payload)
+        if self.role == "primary":
+            if not self.authority.renew(self.queue, self.owner, self.epoch,
+                                        now):
+                # A scripted renewal fault leaves the lease lapsing on
+                # the authority's clock; we keep serving until the epoch
+                # is actually superseded — fencing is the AUTHORITY's
+                # epoch, not the primary's optimism.
+                self._check_current("lease renewal")
+        if self.metrics is not None:
+            q = self.queue
+            self.metrics.set_gauge(f"replication_lag[{q}]", self.lag())
+            self.metrics.set_gauge(f"replication_epoch[{q}]", self.epoch)
+            self.metrics.set_gauge(f"replication_acked_seq[{q}]",
+                                   self.acked_seq)
+
+    def shutdown(self, now: float) -> None:
+        """Graceful-close hook (AFTER mark_clean streamed the CLEAN
+        record): final ack sweep, then release the lease so a standby
+        can promote without waiting out the expiry."""
+        self.pump(now)
+        if self.role == "primary":
+            self.authority.release(self.queue, self.owner, self.epoch, now)
+
+    # ---- observability -----------------------------------------------------
+
+    def lag(self) -> int:
+        """Replication lag in records — the unacked-tail bound on what a
+        host loss at this instant could cost."""
+        return max(0, self.sent_seq - self.acked_seq)
+
+    @property
+    def quiescent(self) -> bool:
+        """Acked watermark has caught the appended/sent seq — the
+        replication-quiescence clause of ``testing.drain.fully_drained``."""
+        return self.acked_seq >= self.sent_seq
+
+    def unacked_admit_players(self) -> int:
+        """Players in unacked ADMIT/baseline records — the exact bound on
+        waiting players a kill RIGHT NOW could lose across failover (the
+        --failover-soak gate compares measured losses against this)."""
+        with self._lock:
+            tail = list(self._unacked.values())
+        n = 0
+        for rtype, payload in tail:
+            if rtype == RT_ADMIT or rtype == RT_REPL_SNAPSHOT:
+                n += len(json.loads(payload.decode("utf-8"))["rows"])
+        return n
+
+    def snapshot(self) -> "dict[str, Any]":
+        """Per-queue replication block for /metrics + /healthz."""
+        return {
+            "role": self.role,
+            "owner": self.owner,
+            "epoch": self.epoch,
+            "sent_seq": self.sent_seq,
+            "acked_seq": self.acked_seq,
+            "lag": self.lag(),
+            "link": dict(self.link.counters),
+        }
+
+
+class ReplicationHub:
+    """The in-process replication fabric one primary app, its standby
+    appliers, and a failover successor share — the wiring a cross-host
+    deployment replaces with real transports and a real lease service
+    (the DCN seam). Holds the :class:`LeaseAuthority`, the per-queue
+    links, and the takeover handoff (``adopted``: queue → shadow state a
+    successor app applies via ``recover_from_replica`` at start)."""
+
+    def __init__(self, lease_s: float = 0.5, chaos=None, seed: int = 0):
+        self.authority = LeaseAuthority(
+            lease_s,
+            fail_renewals=getattr(chaos, "repl_fail_renewals", ()) or ())
+        self.chaos = chaos
+        self.seed = seed
+        self._links: "dict[str, InProcReplicationLink]" = {}
+        #: Takeover handoff: queue → {"epoch", "owner", "state",
+        #: "applied_seq"}, consumed by the successor's start_replication.
+        self.adopted: "dict[str, dict[str, Any]]" = {}
+
+    def link(self, queue: str) -> InProcReplicationLink:
+        lk = self._links.get(queue)
+        if lk is None:
+            chaos = self.chaos
+            if chaos is not None:
+                qs = getattr(chaos, "queues", ()) or ()
+                if qs and queue not in qs:
+                    chaos = None
+            lk = InProcReplicationLink(queue, chaos=chaos, seed=self.seed)
+            self._links[queue] = lk
+        return lk
+
+    def standby(self, queue: str, owner: str = "standby") -> StandbyApplier:
+        return StandbyApplier(queue, self.link(queue), self.authority,
+                              owner=owner, hub=self)

@@ -5,9 +5,10 @@ The PR 2 soak pattern: instead of a wall-clock sleep racing the pipeline
 has FULLY settled — the match-count target reached AND nothing buffered at
 any stage between the broker and the device. The conjunction must name
 every buffering stage the runtime has; when a new stage is added (as the
-journal PR added commit buffering), extend it HERE so every caller —
-``bench.py``'s crash-soak ``quiesce`` and the duplicate-delivery e2e test
-alike — stays drain-exact together.
+journal PR added commit buffering, and the replication PR the unacked
+stream tail), extend it HERE so every caller — ``bench.py``'s soak
+``quiesce`` loops and the duplicate-delivery e2e test alike — stays
+drain-exact together.
 """
 
 from __future__ import annotations
@@ -18,16 +19,27 @@ __all__ = ["fully_drained"]
 
 
 def fully_drained(app: Any, rt: Any, queue: str,
-                  matched_at_least: int) -> bool:
+                  matched_at_least: int, *,
+                  replication: bool = True) -> bool:
     """True once ``matched_at_least`` players have matched AND the whole
     request path is empty: broker queue drained, delivery handlers idle,
     batcher backlog cut, no flush in progress, no windows in flight on the
-    device. At that point every duplicate/redelivery has been consumed and
-    its replay response published — the state e2e assertions may read."""
+    device, and — with replication attached — the standby's acked
+    watermark has caught the appended/sent seq (ISSUE 17: a soak that
+    settles with an unacked tail would measure replication lag as "lost
+    players"). At that point every duplicate/redelivery has been consumed
+    and its replay response published — the state e2e assertions may read.
+
+    ``replication=False`` drops the quiescence clause — the knob for
+    soaks that DELIBERATELY hold the stream open (a scripted link
+    partition never acks, so the full conjunction would never settle;
+    the lag at the kill point is exactly what such a soak measures)."""
+    repl = getattr(rt, "replication", None)
     return (app.metrics.counters.get("players_matched") >= matched_at_least
             and app.broker.queue_depth(queue) == 0
             and app.broker.handlers_idle()
             and rt.batcher.depth == 0
             and rt._flushing == 0
             and (not hasattr(rt.engine, "inflight")
-                 or rt.engine.inflight() == 0))
+                 or rt.engine.inflight() == 0)
+            and (not replication or repl is None or repl.quiescent))

@@ -41,6 +41,20 @@ service's own ``asyncio.Lock()`` calls come back instrumented:
   directly, so at-least-once redelivery never trips the check — only the
   app's settle seam is audited, which is exactly the static rule's scope,
   measured instead of proved.
+- **replication twin** (ISSUE 17) — the hot-standby replication seams
+  (service/replication.py) come back instrumented.  A **publish after
+  fence** is a response that became VISIBLE at the broker from a runtime
+  whose (owner, epoch) the lease authority no longer recognizes — the
+  exact split-brain double match epoch fencing exists to kill; refused
+  attempts (the production seam returning early) are NOT findings, only
+  real visibility is.  An **apply out of order** is a standby applying a
+  stream record whose seq is not ``watermark + 1`` (a baseline snapshot
+  legitimately re-bases) — replay order is the correctness contract the
+  applier's gap buffer exists to keep.  An **ack beyond received** is a
+  replication ack past the link's delivered horizon — the primary would
+  drop unacked-tail records the standby never saw, turning a failover
+  into silent loss.  All three report with both sites quoted (the
+  takeover/previous-apply/receive-horizon site and the violating site).
 - **journal twin** (ISSUE 15) — the write-ahead pool journal
   (utils/journal.py) comes back instrumented.  A delivery **acked while
   its queue's journal holds uncommitted records** (fsync policy ≠
@@ -235,6 +249,18 @@ class AsyncSanitizer:
         self._journal_clean: dict[int, str] = {}
         #: id(journal) → site of the newest still-uncommitted append.
         self._journal_dirty_site: dict[int, str] = {}
+        # ---- replication twin state (ISSUE 17) ----------------------------
+        #: Strong refs to every LeaseAuthority whose takeover fired while
+        #: installed (id()-key stability — same argument as ``_locks``).
+        self._repl_refs: list[Any] = []
+        #: (id(authority), queue) → site of the newest takeover — the
+        #: fencing event a publish-after-fence finding quotes.
+        self._repl_takeover: dict[tuple[int, str], str] = {}
+        #: id(applier) → (expected next seq, site of the previous apply).
+        self._repl_applied: dict[int, tuple[int, str]] = {}
+        #: id(link) → site of the newest recv() (the receive horizon an
+        #: ack may never pass).
+        self._repl_recv_site: dict[int, str] = {}
         # ---- speculation twin state (ISSUE 16) ----------------------------
         #: Strong refs to every TpuEngine whose speculation seam fired
         #: while installed (id()-key stability — same argument as
@@ -431,6 +457,108 @@ class AsyncSanitizer:
                         f"mutation")
             return orig_scommit(eng, token, now)
 
+        # ---- replication twin (ISSUE 17) ----------------------------------
+        # Dynamic mirror of the epoch-fencing and stream-ordering
+        # disciplines: the production seams REFUSE violations (fenced
+        # publishes return early, the applier's pump buffers gaps) — the
+        # twin reports when a violation actually became OBSERVABLE, i.e.
+        # a fenced runtime's response reached the broker, a record applied
+        # out of seq order, or an ack passed the delivered horizon.
+        from matchmaking_tpu.service import app as _app_mod
+        from matchmaking_tpu.service import replication as _repl_mod
+
+        la = _repl_mod.LeaseAuthority
+        sap = _repl_mod.StandbyApplier
+        rl = _repl_mod.InProcReplicationLink
+        qrt = _app_mod._QueueRuntime
+        orig_takeover = la.takeover
+        orig_rapply = sap._apply
+        orig_rrecv = rl.recv
+        orig_rack = rl.ack
+        orig_pub_body = qrt._publish_body
+        orig_pub_batch = qrt._publish_batch
+
+        def _pin_repl(obj: Any) -> None:
+            if not any(o is obj for o in san._repl_refs):
+                san._repl_refs.append(obj)
+
+        def rtakeover(auth, queue: str, owner: str, now: float,
+                      force: bool = False) -> int:
+            epoch = orig_takeover(auth, queue, owner, now, force=force)
+            _pin_repl(auth)
+            san._repl_takeover[(id(auth), queue)] = _site()
+            return epoch
+
+        def _audit_publish(rt, before: int, site: str) -> None:
+            r = rt.replication
+            if r is None or not r.superseded():
+                return
+            if rt.app.broker.stats.get("published", 0) > before:
+                tsite = san._repl_takeover.get(
+                    (id(r.authority), r.queue),
+                    "<lease authority (no takeover recorded)>")
+                san._report(
+                    "replication-publish-after-fence",
+                    ("repl-pub", r.queue, site),
+                    f"queue {r.queue!r}: a response became visible at the "
+                    f"broker via {site} from owner {r.owner!r} epoch "
+                    f"{r.epoch} AFTER the epoch was superseded (takeover "
+                    f"at {tsite}) — the split-brain double match epoch "
+                    f"fencing exists to kill")
+
+        def pub_body(rt, reply_to: str, correlation_id: str,
+                     body: bytes, trace=None) -> None:
+            before = rt.app.broker.stats.get("published", 0)
+            orig_pub_body(rt, reply_to, correlation_id, body, trace=trace)
+            _audit_publish(rt, before, _site())
+
+        def pub_batch(rt, rows) -> None:
+            before = rt.app.broker.stats.get("published", 0)
+            orig_pub_batch(rt, rows)
+            _audit_publish(rt, before, _site())
+
+        def rapply(applier, seq: int, rtype: int, payload: bytes) -> None:
+            site = _site()
+            _pin_repl(applier)
+            if rtype != _repl_mod.RT_REPL_SNAPSHOT:
+                rec = san._repl_applied.get(id(applier))
+                expect, prev_site = (
+                    rec if rec is not None
+                    else (applier.applied_seq + 1,
+                          "<applier watermark at install>"))
+                if applier.applied_seq and seq != expect:
+                    san._report(
+                        "replication-apply-out-of-order",
+                        ("repl-order", applier.queue, seq, site),
+                        f"standby for {applier.queue!r} applied stream seq "
+                        f"{seq} at {site} but the watermark expects "
+                        f"{expect} (previous apply at {prev_site}) — "
+                        f"out-of-order apply corrupts the shadow the "
+                        f"failover successor adopts")
+            orig_rapply(applier, seq, rtype, payload)
+            san._repl_applied[id(applier)] = (applier.applied_seq + 1, site)
+
+        def rrecv(link):
+            out = orig_rrecv(link)
+            _pin_repl(link)
+            san._repl_recv_site[id(link)] = _site()
+            return out
+
+        def rack(link, seq: int) -> None:
+            site = _site()
+            if seq > link.max_delivered:
+                rsite = san._repl_recv_site.get(
+                    (id(link)), "<no recv yet>")
+                san._report(
+                    "replication-ack-beyond-received",
+                    ("repl-ack", link.queue, seq, site),
+                    f"replication ack {seq} at {site} passes the delivered "
+                    f"horizon {link.max_delivered} (last recv at {rsite}) "
+                    f"for queue {link.queue!r} — the primary would drop "
+                    f"unacked-tail records the standby never saw, turning "
+                    f"failover into silent loss")
+            orig_rack(link, seq)
+
         @contextlib.contextmanager
         def _cm():
             self._orig_lock = asyncio.Lock
@@ -442,6 +570,9 @@ class AsyncSanitizer:
             pj.compact_finish = jcompact
             te.spec_validate, te.spec_commit = svalidate, scommit
             te.spec_invalidate, te._pool_mutated = sinval, smutated
+            la.takeover, sap._apply = rtakeover, rapply
+            rl.recv, rl.ack = rrecv, rack
+            qrt._publish_body, qrt._publish_batch = pub_body, pub_batch
             try:
                 yield self
             finally:
@@ -456,6 +587,10 @@ class AsyncSanitizer:
                                                     orig_scommit)
                 te.spec_invalidate = orig_sinval
                 te._pool_mutated = orig_smutated
+                la.takeover, sap._apply = orig_takeover, orig_rapply
+                rl.recv, rl.ack = orig_rrecv, orig_rack
+                qrt._publish_body = orig_pub_body
+                qrt._publish_batch = orig_pub_batch
 
         return _cm()
 

@@ -77,6 +77,13 @@ RT_CLEAN = 4     #: clean-shutdown marker
 RT_TERMINALS = 5  #: one window's terminals in ONE record (the hot path:
 #                  one json+crc+lock acquire per window, not per player)
 
+
+class FencedError(RuntimeError):
+    """Append refused: this journal's owner was epoch-fenced (ISSUE 17).
+    A superseded ex-primary must not extend the WAL — the standby's
+    successor owns this queue's history now. Raised by ``_append`` when
+    the installed ``fence`` check fails."""
+
 _FSYNC_POLICIES = ("none", "interval", "window")
 
 _SNAP_RE = re.compile(r"\.snap\.(\d+)\.npz$")
@@ -301,6 +308,18 @@ class PoolJournal:
         self.bytes_written = 0
         self.payload_bytes = 0
         self._fd: int | None = None
+        #: Replication stream tap (ISSUE 17, service/replication.py; None
+        #: = replication off): called as ``tap(seq, rtype, payload)``
+        #: inside the append lock for EVERY sealed record — appends AND
+        #: the compaction carries (which consume seqs without going
+        #: through ``_append``; an untapped carry would stall the
+        #: standby's contiguous-apply watermark forever).
+        self.tap = None
+        #: Epoch fence (ISSUE 17; None = unfenced): a ``() -> bool``
+        #: check run at the top of every append — False means this
+        #: journal's owner was superseded and ``_append`` raises
+        #: :class:`FencedError` instead of extending history.
+        self.fence = None
         #: Recovery parse of whatever artifacts existed at attach (None =
         #: nothing on disk: a genuinely fresh boot).
         self.recovered: RecoveredQueue | None = self._attach()
@@ -452,6 +471,13 @@ class PoolJournal:
         audit cannot race a half-staged append; a PROCESS crash cannot
         lose written bytes, so this is also what recovers a mid-window
         crash's players as waiting). Returns the seq."""
+        if self.fence is not None and not self.fence():
+            # Epoch fencing (ISSUE 17): a superseded ex-primary CANNOT
+            # extend the WAL — checked before the lock so a fenced
+            # writer never even contends with the successor's history.
+            raise FencedError(
+                f"journal append for {self.queue!r} refused: owner was "
+                f"epoch-fenced (a standby took over this queue)")
         with self._lock:
             if self._closed:
                 raise RuntimeError(
@@ -469,6 +495,15 @@ class PoolJournal:
             else:
                 self._buf.append(frame)
             self.payload_bytes += logical
+            if self.tap is not None:
+                # Replication stream (ISSUE 17): ship the sealed record.
+                # Never let a tap failure poison the append — replication
+                # loss is bounded by acks; a failed append is data loss.
+                try:
+                    self.tap(seq, rtype, payload)
+                except Exception:
+                    log.exception("journal tap failed for %r seq %d",
+                                  self.queue, seq)
             return seq
 
     def append_admits(self, rows: list[list[Any]]) -> int:
@@ -583,6 +618,12 @@ class PoolJournal:
         TERMINAL replay in ``_attach`` covers the one window between the
         renames where the carries are not yet the live segment (the old
         segment's terminals still are)."""
+        if self.fence is not None and not self.fence():
+            # A fenced ex-primary must not rewrite history either —
+            # compaction rotates segments and consumes seqs.
+            raise FencedError(
+                f"journal compaction for {self.queue!r} refused: owner "
+                f"was epoch-fenced")
         if not _verify_snapshot(snap_path):
             # Never truncate history against a snapshot that does not
             # read back: the old segment keeps covering the pool.
@@ -598,17 +639,32 @@ class PoolJournal:
                              json.dumps(header,
                                         separators=(",", ":")).encode())]
             logical = 0
+            #: Compaction carries consume seqs without going through
+            #: ``_append`` — tap them too (ISSUE 17), or the replication
+            #: standby would stall forever waiting for the gap. Carries
+            #: are re-statements of already-streamed state, so the
+            #: standby's apply is idempotent over them.
+            tapped: list[tuple[int, int, bytes]] = []
             for pid, body, exp in carry_terminals:
                 self.seq += 1
-                frames.append(_frame(self.seq, RT_TERMINAL,
-                                     terminal_payload(pid, body, exp)))
+                payload = terminal_payload(pid, body, exp)
+                frames.append(_frame(self.seq, RT_TERMINAL, payload))
+                tapped.append((self.seq, RT_TERMINAL, payload))
                 logical += len(body)
             if admission is not None:
                 self.seq += 1
                 payload = json.dumps(admission,
                                      separators=(",", ":")).encode("utf-8")
                 frames.append(_frame(self.seq, RT_ADMISSION, payload))
+                tapped.append((self.seq, RT_ADMISSION, payload))
                 logical += len(payload)
+            if self.tap is not None:
+                for seq, rtype, payload in tapped:
+                    try:
+                        self.tap(seq, rtype, payload)
+                    except Exception:
+                        log.exception("journal tap failed for %r seq %d",
+                                      self.queue, seq)
             data = b"".join(frames)
             fd = os.open(live + ".new",
                          os.O_CREAT | os.O_TRUNC | os.O_WRONLY, 0o644)

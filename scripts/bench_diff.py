@@ -31,9 +31,13 @@ Compared metrics (direction-aware):
                        rows (ISSUE 15): crash_lost, crash_dup,
                        crash_rto_ms_max/mean, crash_failover_blackout_ms,
                        journal_write_amplification,
-                       crash_journal_overhead_frac, and the speculation
+                       crash_journal_overhead_frac, the speculation
                        A/B rows (ISSUE 16): spec_turnaround_ms_p50/p99,
-                       spec_wasted_step_fraction
+                       spec_wasted_step_fraction, and the failover-soak
+                       rows (ISSUE 17): failover_lost, failover_dup,
+                       failover_lost_over_bound, failover_rto_ms(_mean),
+                       replication_lag_ms_p99 (lost/dup/over-bound under
+                       the zero-baseline rule)
 Frontier rows (``e2e_frontier``, ISSUE 8; the speculation-axis twin
 ``e2e_frontier_spec``, ISSUE 16) are matched by threshold.
 Scenario-matrix cells (``scenario_matrix``, ISSUE 13) are matched by
@@ -93,6 +97,20 @@ TOP_LEVEL_METRICS: dict[str, bool] = {
     "crash_failover_blackout_ms": False,
     "journal_write_amplification": False,
     "crash_journal_overhead_frac": False,
+    # Hot-standby failover soak (ISSUE 17, bench.py --failover-soak):
+    # cross-host takeover accounting regresses downward only. lost/dup
+    # (and the over-bound excess — players lost BEYOND the unacked-tail
+    # bound measured at kill time, zero on any correct run) have a zero
+    # baseline, so ANY nonzero fresh value beyond the threshold
+    # regresses (the base==0 rule); the takeover RTO and the replication
+    # ack-lag p99 are lower-is-better latencies. A run without the soak
+    # leaves the keys absent and they are skipped per-metric.
+    "failover_lost": False,
+    "failover_dup": False,
+    "failover_lost_over_bound": False,
+    "failover_rto_ms": False,
+    "failover_rto_ms_mean": False,
+    "replication_lag_ms_p99": False,
     # Speculative formation A/B (ISSUE 16, bench.py --spec-ab): the
     # spec-on leg's turnaround (engine-observed wait-at-match) regresses
     # upward, the hit rate downward, the wasted-step fraction (discarded

@@ -122,6 +122,41 @@ assert out["crash_rto_ms_max"] is not None and \
 print("crash-soak smoke: OK")
 EOF
 
+echo "== replication =="
+# ISSUE 17 gate: hot-standby journal replication + fenced failover. The
+# suite runs by marker first — lease/epoch authority semantics, the
+# at-least-once link under scripted drop/dup/delay/partition faults, the
+# standby applier's ordering + baseline re-base, the service stream
+# round trip, the fenced ex-primary regression (a superseded owner can
+# neither append nor publish), the sanitizer's replication twin, and the
+# offline journal inspector.
+JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'replication and not slow' \
+    --continue-on-collection-errors -p no:cacheprovider
+# Then a 2-cycle failover-soak smoke through the REAL bench.py
+# --failover-soak path (one run, small load): zero double matches, lost
+# players within the unacked-tail bound at kill time, >= 2 takeovers,
+# and a bounded RTO — the acceptance invariants, seconds-scale.
+python - <<'EOF'
+import json, subprocess, sys
+proc = subprocess.run(
+    [sys.executable, "bench.py", "--failover-soak", "--failover-cycles",
+     "2", "--failover-runs", "1", "--failover-pairs", "3",
+     "--failover-singles", "2"],
+    capture_output=True, text=True, timeout=600)
+sys.stderr.write(proc.stderr)
+if proc.returncode != 0:
+    sys.exit(f"failover-soak smoke exited {proc.returncode}")
+out = json.loads(proc.stdout.splitlines()[-1])
+print("failover-soak smoke:", json.dumps(out))
+assert out["failover_dup"] == 0, f"double matches: {out['failover_dup']}"
+assert out["failover_lost_over_bound"] == 0, \
+    f"lost beyond the unacked-tail bound: {out['failover_lost_over_bound']}"
+assert out["failover_recoveries"] >= 2, out["failover_recoveries"]
+assert out["failover_rto_ms"] is not None and \
+    out["failover_rto_ms"] < 30_000, f"RTO unbounded: {out['failover_rto_ms']}"
+print("failover-soak smoke: OK")
+EOF
+
 echo "== speculation =="
 # ISSUE 16 gate: speculative formation. The equivalence suite runs by
 # name, seconds-scale on the CPU harness: commit ≡ rescan bit-exactness

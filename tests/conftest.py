@@ -96,6 +96,14 @@ def pytest_configure(config):
         "failover, and the sanitizer's journal twin — scripts/check.sh "
         "runs it by marker plus a 2-cycle crash-soak smoke; part of "
         "tier-1)")
+    config.addinivalue_line(
+        "markers", "replication: hot-standby replication suite (ISSUE "
+        "17: lease/epoch fencing, the at-least-once stream link under "
+        "scripted faults, the standby applier, the service stream round "
+        "trip, cross-host failover with the fenced ex-primary "
+        "regression, the sanitizer's replication twin, and the offline "
+        "journal inspector — scripts/check.sh runs it by marker plus a "
+        "2-cycle failover-soak smoke; part of tier-1)")
 
 
 @pytest.fixture

@@ -438,6 +438,42 @@ def edf_key(item, deadline_of):
     assert clean == []
 
 
+def test_determinism_covers_lease_epoch_arithmetic():
+    """ISSUE 17 satellite: lease/epoch fencing decides which host may
+    write, so lease-deadline / epoch / ack-watermark / lag arithmetic
+    born from time.time() would make FAILOVER (and the failover-soak's
+    bit-identical transcript) a function of wall-clock jitter. The
+    sanctioned shapes are a caller-passed ``now`` (time.monotonic() at
+    the call site) and counter arithmetic."""
+    findings = analyze_source('''
+import time
+
+class Lease:
+    def renew_all(self, interval, acked):
+        self.lease_deadline = time.time() + interval
+        epoch = int(time.time())
+        ack_seq = acked + time.time()
+        lag_ms = (time.time() - self.sent_at) * 1e3
+        return lag_ms
+''', path="matchmaking_tpu/service/fixture.py")
+    assert _rules(findings) == ["determinism"] * 4
+    # The sanctioned shape (service/replication.py): every deadline is a
+    # pure function of a caller-passed now; epochs/ack seqs are counters.
+    clean = analyze_source('''
+class Lease:
+    def acquire(self, now, lease_s):
+        self.lease_deadline = now + lease_s
+        self.epoch += 1
+        return self.epoch
+
+    def pump(self, now, sent_at, acked):
+        ack_seq = acked + 1
+        lag_ms = (now - sent_at) * 1e3
+        return ack_seq, lag_ms
+''', path="matchmaking_tpu/service/fixture.py")
+    assert clean == []
+
+
 # ---- perf (ISSUE 8: O(pool)/O(matches) scans on the hot path) --------------
 
 def test_perf_flags_pool_scan_in_hot_path_function():
