@@ -2256,6 +2256,283 @@ def bench_failover_soak(args) -> dict:
     }
 
 
+def bench_incident_soak(args) -> dict:
+    """Incident-forensics soak (ISSUE 18, ``--incident-soak``): a seeded
+    flash crowd + scripted lease-expiry failover + hard crash, with the
+    black-box recorder armed — every trigger class the script exercises
+    (slo_burn, slo_burn_clear, failover, crash_recovery) must auto-capture
+    at least one bundle, the event spine's deterministic transcript must
+    be bit-identical across two runs (the bar every other soak meets),
+    capture p99 must stay <= 50 ms with ZERO rate-limiter drops, and
+    ``scripts/postmortem.py`` must reconstruct the takeover root chain
+    (lease expiry → epoch bump → replay window → takeover → burn →
+    burn clear) OFFLINE from the persisted bundle alone.
+
+    Script per run, three app boots on one replication fabric:
+    host0 (primary + warm standby) takes a paced flash crowd against a
+    deliberately unmeetable SLO target — the burn fires mid-burst and
+    clears when the crowd drains; host0 is hard-killed and the standby
+    promoted at scripted lease expiry; host1 adopts the shadow (failover
+    bundle), takes a second crowd (its OWN burn/clear — the bundle whose
+    spine holds the whole takeover chain), then hard-crashes; host2
+    reboots on host1's journal (crash_recovery bundle) and stops clean."""
+    import asyncio
+    import hashlib
+    import importlib.util
+    import shutil
+    import tempfile
+
+    from matchmaking_tpu.config import (
+        BatcherConfig,
+        Config,
+        DurabilityConfig,
+        EngineConfig,
+        ForensicsConfig,
+        ObservabilityConfig,
+        QueueConfig,
+        ReplicationConfig,
+    )
+    from matchmaking_tpu.service.app import MatchmakingApp
+    from matchmaking_tpu.service.broker import Properties
+    from matchmaking_tpu.service.replication import ReplicationHub
+    from matchmaking_tpu.testing.drain import fully_drained
+
+    q = "incident.soak"
+    pairs = int(args.incident_pairs)
+    singles = int(args.incident_singles)
+    lease_s = float(args.incident_lease_s)
+    rate = max(1.0, float(args.incident_rate))
+    #: The classes this script exercises — the >= 1-bundle-each gate.
+    exercised = ("slo_burn", "slo_burn_clear", "failover", "crash_recovery")
+    expected_chain = ["lease_expired", "epoch_bump", "replay_window",
+                      "failover_takeover", "slo_burn", "slo_burn_clear"]
+
+    def cfg_for(jdir: str, inc_dir: str, owner: "str | None") -> Config:
+        return Config(
+            queues=(QueueConfig(name=q, rating_threshold=50.0,
+                                dedup_ttl_s=3600.0,
+                                send_queued_ack=False),),
+            engine=EngineConfig(backend="tpu", pool_capacity=4096,
+                                pool_block=512, batch_buckets=(16, 64),
+                                top_k=8, warm_start=True),
+            # max_wait 5 ms >> the 1 ms SLO target below: EVERY settled
+            # pair misses, so the flash crowd burns deterministically-in-
+            # outcome (the burn EVENTS stay out of the transcript — only
+            # their occurrence is gated, not their timing).
+            batcher=BatcherConfig(max_batch=64, max_wait_ms=5.0),
+            durability=DurabilityConfig(journal_dir=jdir, fsync="window"),
+            observability=ObservabilityConfig(
+                slo_target_ms=1.0, slo_objective=0.99,
+                slo_fast_window_s=0.4, slo_slow_window_s=0.9,
+                snapshot_interval_s=0.1, slow_trace_ms=1.0),
+            forensics=ForensicsConfig(incident_dir=inc_dir,
+                                      min_interval_s=0.25),
+            replication=(ReplicationConfig(role="primary", owner=owner)
+                         if owner else ReplicationConfig()),
+        )
+
+    def burst(host: int) -> "list[tuple[str, float]]":
+        """The crash/failover-soak designed-load recipe: adjacent pairs
+        MUST match whatever the framing; far singles never can."""
+        rows: list[tuple[str, float]] = []
+        for i in range(pairs):
+            base = 1000.0 + i * 200.0
+            rows.append((f"i{host}p{2 * i}", base))
+            rows.append((f"i{host}p{2 * i + 1}", base + 1.0))
+        for i in range(singles):
+            rows.append((f"i{host}s{i}", 50_000.0 + host * 10_000.0
+                         + i * 1_000.0))
+        rng = np.random.default_rng(int(args.incident_seed) + host)
+        rng.shuffle(rows)
+        return rows
+
+    async def publish_paced(app, reply_q: str, rows) -> None:
+        for pid, rating in rows:
+            app.broker.publish(
+                q, f'{{"id":"{pid}","rating":{rating}}}'.encode(),
+                Properties(reply_to=reply_q, correlation_id=pid))
+            await asyncio.sleep(1.0 / rate)
+
+    async def quiesce(app, rt, standby, matched_at_least: int,
+                      replication: bool = True) -> bool:
+        for _ in range(6000):
+            await asyncio.sleep(0.005)
+            if standby is not None:
+                standby.pump()
+            if fully_drained(app, rt, q, matched_at_least,
+                             replication=replication):
+                return True
+        return False
+
+    async def wait_capture(app, cls: str, timeout_s: float,
+                           standby=None) -> bool:
+        """Poll until the recorder has >= 1 bundle of ``cls`` (the
+        telemetry loop keeps sampling / the burn monitors keep
+        evaluating in the background)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if app.incidents.by_class.get(cls, 0) > 0:
+                return True
+            if standby is not None:
+                standby.pump()
+            await asyncio.sleep(0.05)
+        return False
+
+    def app_stats(app) -> "tuple[float | None, int]":
+        lat = app.metrics.latency.get("incident_capture")
+        p99 = (lat.percentile(99) * 1e3
+               if lat is not None and len(lat) else None)
+        return p99, app.incidents.dropped
+
+    async def one_run(run_idx: int) -> dict:
+        base_dir = tempfile.mkdtemp(prefix=f"mm_incident_r{run_idx}_")
+        hub = ReplicationHub(lease_s=lease_s,
+                             seed=int(args.incident_seed))
+        by_class: dict[str, int] = {}
+        transcripts: list[list] = []
+        p99s: list[float] = []
+        dropped = 0
+        missed: list[str] = []
+        clear_bundle_path = ""
+        try:
+            # -- host0: flash crowd -> burn -> clear -> hard kill -------
+            app = MatchmakingApp(
+                cfg_for(f"{base_dir}/host0", f"{base_dir}/inc0", "host0"),
+                replication_hub=hub)
+            await app.start()
+            rt = app.runtime(q)
+            reply_q = "incident.replies.0"
+            app.broker.declare_queue(reply_q)
+            app.broker.basic_consume(reply_q, lambda d: None,
+                                     prefetch=1_000_000)
+            standby = hub.standby(q, owner="host1")
+            await publish_paced(app, reply_q, burst(0))
+            if not await quiesce(app, rt, standby, 2 * pairs):
+                log(f"[incident-soak r{run_idx} h0] WARNING: quiesce "
+                    f"timed out")
+            for cls, t in (("slo_burn", 3.0), ("slo_burn_clear", 5.0)):
+                if not await wait_capture(app, cls, t, standby=standby):
+                    missed.append(f"host0:{cls}")
+            for k, v in app.incidents.by_class.items():
+                by_class[k] = by_class.get(k, 0) + v
+            p99, d = app_stats(app)
+            if p99 is not None:
+                p99s.append(p99)
+            dropped += d
+            transcripts.append(app.spine.transcript())
+            await app.crash()
+            standby.takeover(time.monotonic() + lease_s + 0.05)
+
+            # -- host1: adoption (failover bundle) + its own burn/clear -
+            app = MatchmakingApp(
+                cfg_for(f"{base_dir}/host1", f"{base_dir}/inc1",
+                        standby.owner),
+                replication_hub=hub)
+            await app.start()
+            rt = app.runtime(q)
+            reply_q = "incident.replies.1"
+            app.broker.declare_queue(reply_q)
+            app.broker.basic_consume(reply_q, lambda d: None,
+                                     prefetch=1_000_000)
+            if not await wait_capture(app, "failover", 2.0):
+                missed.append("host1:failover")
+            await publish_paced(app, reply_q, burst(1))
+            # host1 is the terminal primary — no standby drains its
+            # stream, so the replication-quiescence clause can't hold.
+            if not await quiesce(app, rt, None, 2 * pairs,
+                                 replication=False):
+                log(f"[incident-soak r{run_idx} h1] WARNING: quiesce "
+                    f"timed out")
+            for cls, t in (("slo_burn", 3.0), ("slo_burn_clear", 5.0)):
+                if not await wait_capture(app, cls, t):
+                    missed.append(f"host1:{cls}")
+            for k, v in app.incidents.by_class.items():
+                by_class[k] = by_class.get(k, 0) + v
+            p99, d = app_stats(app)
+            if p99 is not None:
+                p99s.append(p99)
+            dropped += d
+            transcripts.append(app.spine.transcript())
+            # The persisted burn-clear bundle is the postmortem artifact:
+            # its spine window holds the whole takeover chain.
+            for f in sorted(os.listdir(f"{base_dir}/inc1")):
+                if f.endswith("_slo_burn_clear.json"):
+                    clear_bundle_path = os.path.join(f"{base_dir}/inc1", f)
+            await app.crash()
+
+            # -- host2: reboot on host1's journal (crash_recovery) ------
+            app = MatchmakingApp(
+                cfg_for(f"{base_dir}/host1", f"{base_dir}/inc2", None))
+            await app.start()
+            if not await wait_capture(app, "crash_recovery", 2.0):
+                missed.append("host2:crash_recovery")
+            for k, v in app.incidents.by_class.items():
+                by_class[k] = by_class.get(k, 0) + v
+            p99, d = app_stats(app)
+            if p99 is not None:
+                p99s.append(p99)
+            dropped += d
+            transcripts.append(app.spine.transcript())
+            await app.stop()
+
+            # -- offline postmortem on the persisted bundle -------------
+            analysis = None
+            if clear_bundle_path:
+                spec = importlib.util.spec_from_file_location(
+                    "mm_postmortem",
+                    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "scripts", "postmortem.py"))
+                pm = importlib.util.module_from_spec(spec)
+                spec.loader.exec_module(pm)
+                with open(clear_bundle_path, encoding="utf-8") as f:
+                    bundle = json.load(f)
+                analysis = pm.analyze(bundle)
+        finally:
+            if not args.incident_keep_dirs:
+                shutil.rmtree(base_dir, ignore_errors=True)
+        blob = json.dumps(transcripts, sort_keys=True,
+                          separators=(",", ":")).encode("utf-8")
+        log(f"[incident-soak r{run_idx}] by_class={by_class} "
+            f"dropped={dropped} capture_p99="
+            f"{round(max(p99s), 3) if p99s else None} missed={missed}")
+        return {
+            "by_class": by_class,
+            "dropped": dropped,
+            "p99s": p99s,
+            "missed": missed,
+            "transcripts": transcripts,
+            "digest": hashlib.sha256(blob).hexdigest(),
+            "analysis": analysis,
+        }
+
+    runs = [asyncio.run(one_run(i))
+            for i in range(max(1, int(args.incident_runs)))]
+    first = runs[0]
+    identical = None
+    if len(runs) >= 2:
+        identical = all(r["digest"] == first["digest"] for r in runs[1:])
+    p99s = [x for r in runs for x in r["p99s"]]
+    analysis = first["analysis"]
+    chain = (analysis or {}).get("root_chain_kinds") or []
+    return {
+        "incident_runs": len(runs),
+        "incident_captured": sum(sum(r["by_class"].values()) for r in runs),
+        "incident_by_class": first["by_class"],
+        "incident_classes_missed": [m for r in runs for m in r["missed"]],
+        "incident_classes_ok": all(
+            all(r["by_class"].get(cls, 0) >= 1 for cls in exercised)
+            for r in runs),
+        "incident_dropped": sum(r["dropped"] for r in runs),
+        "incident_capture_ms_p99": (round(max(p99s), 3) if p99s else None),
+        "incident_transcript_identical": identical,
+        "incident_spine_digest": first["digest"],
+        "incident_bundle_valid": (analysis is not None
+                                  and not analysis["problems"]),
+        "incident_root_chain": chain,
+        "incident_root_chain_ok": chain == expected_chain,
+    }
+
+
 async def _scenario_cell(args, scn) -> dict:
     """One matrix cell: a fresh single-queue app driven by one scenario's
     seeded population load, with the autotuner closing the loop (unless
@@ -2748,6 +3025,37 @@ def main() -> None:
     p.add_argument("--failover-keep-dirs", action="store_true",
                    help="keep the per-host journal directories for "
                         "inspection")
+    p.add_argument("--incident-soak", action="store_true",
+                   help="incident-forensics soak (ISSUE 18): seeded flash "
+                        "crowd + scripted lease-expiry failover + hard "
+                        "crash with the black-box recorder armed — every "
+                        "exercised trigger class must capture a bundle, "
+                        "the spine transcript must be bit-identical "
+                        "across runs, capture p99 <= 50ms with zero "
+                        "rate-limiter drops, and scripts/postmortem.py "
+                        "must reconstruct the takeover root chain offline "
+                        "from the persisted bundle alone. Standalone "
+                        "mode: skips every other phase")
+    p.add_argument("--incident-pairs", type=int, default=30,
+                   help="matching pairs per flash crowd (sized so the "
+                        "paced burst outlasts the slow burn window)")
+    p.add_argument("--incident-singles", type=int, default=6,
+                   help="never-matching singles per flash crowd")
+    p.add_argument("--incident-rate", type=float, default=30.0,
+                   help="publish pacing for the flash crowd (req/s); the "
+                        "default keeps the burst > slo_slow_window_s so "
+                        "the burn fires mid-burst")
+    p.add_argument("--incident-runs", type=int, default=2,
+                   help="soak repetitions; >= 2 additionally pins the "
+                        "spine transcripts bit-identical across runs")
+    p.add_argument("--incident-seed", type=int, default=31)
+    p.add_argument("--incident-lease-s", type=float, default=0.5,
+                   help="lease duration on the in-process authority "
+                        "(takeover expiry is scripted on the authority's "
+                        "clock)")
+    p.add_argument("--incident-keep-dirs", action="store_true",
+                   help="keep the per-run journal + incident directories "
+                        "for inspection")
     p.add_argument("--scenario-matrix", default="",
                    help="scenario observatory (ISSUE 13): run the named "
                         "population-model scenarios (comma list, or 'all' "
@@ -2801,6 +3109,11 @@ def main() -> None:
         # Standalone like --crash-soak: one queue, CPU-harness friendly
         # (no mesh needed — the failover axis is hosts, not devices).
         print(json.dumps(bench_failover_soak(args)), flush=True)
+        return
+    if args.incident_soak:
+        # Standalone like --failover-soak: one queue, CPU-harness
+        # friendly; the forensics axis is the event spine + recorder.
+        print(json.dumps(bench_incident_soak(args)), flush=True)
         return
     if args.scenario_matrix:
         # Standalone like --placement-soak: the matrix is its own

@@ -119,7 +119,18 @@ _CLOCKLIKE_TOKENS = ("deadline", "next_snapshot", "snapshot_due",
                      # jitter. The sanctioned clock for lease state is a
                      # caller-passed time.monotonic() value; epochs and
                      # ack seqs are counters.
-                     "lease_deadline", "epoch", "ack_seq", "lag_ms")
+                     "lease_deadline", "epoch", "ack_seq", "lag_ms",
+                     # Event-spine arithmetic (ISSUE 18): the forensics
+                     # spine's causal order IS its monotone counter seq —
+                     # a spine/event/incident seq derived from time.time()
+                     # deltas would make the incident-soak's bit-identical
+                     # transcript (and every postmortem timeline) a
+                     # function of wall-clock jitter. The sanctioned
+                     # clocks on a spine row are DATA fields: mono_ns
+                     # (monotonic, for gap annotation) and wall (display
+                     # only) — neither may feed the seq.
+                     "spine_seq", "event_seq", "incident_seq", "trigger_seq",
+                     "mono_ns", "capture_due", "next_capture")
 
 
 def _clocklike(text: str) -> bool:

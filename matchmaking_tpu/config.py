@@ -853,6 +853,51 @@ class ObservabilityConfig:
 
 
 @dataclass(frozen=True)
+class ForensicsConfig:
+    """Incident forensics (ISSUE 18; utils/forensics.py): the causal
+    event spine every lifecycle emission is stamped onto, plus the
+    black-box auto-capture that freezes ring snapshots into
+    schema-versioned incident bundles on trigger rules (SLO burn start,
+    breaker trip, failover takeover, crash recovery, migration blackout
+    over budget, autotuner oscillation). Surfaced at /debug/incidents."""
+
+    #: Spine events kept in the process-wide causal ring.
+    spine_ring: int = 4096
+    #: Master switch for auto-capture (the spine itself always runs —
+    #: it is the EventLog's ordering substrate and costs one counter).
+    capture: bool = True
+    #: Where bundles are persisted as JSON; "" keeps them in-proc only
+    #: (/debug/incidents still serves the bounded ring).
+    incident_dir: str = ""
+    #: Bundles kept in the in-proc ring (newest wins).
+    incident_ring: int = 16
+    #: Bundle FILES kept under incident_dir (oldest pruned).
+    retention_files: int = 32
+    #: Per-trigger-class minimum seconds between captures — the burn-storm
+    #: damper. Dropped captures are counted (incidents_dropped), never
+    #: silent.
+    min_interval_s: float = 5.0
+    #: Spine events frozen per bundle (the incident window).
+    spine_window: int = 512
+    #: Telemetry-ring snapshots frozen per bundle.
+    telemetry_tail: int = 32
+    #: Slow-trace exemplars frozen per queue per bundle.
+    trace_slice: int = 8
+    #: Placement/autotune audit records frozen per bundle.
+    audit_slice: int = 32
+    #: Migration blackout budget (ms): a completed placement action whose
+    #: measured blackout exceeds this triggers a capture. 0 disables the
+    #: blackout trigger.
+    blackout_budget_ms: float = 0.0
+    #: Knob moves remembered per (queue, knob) for the autotuner
+    #: oscillation detector (src→dst then dst→src within this window).
+    oscillation_window: int = 8
+
+    def enabled(self) -> bool:
+        return self.capture
+
+
+@dataclass(frozen=True)
 class BatcherConfig:
     """Request windowing: collect a batch per queue, dispatch one kernel."""
 
@@ -895,6 +940,9 @@ class Config:
     #: Flight recorder / debug endpoints (tracing on by default).
     observability: ObservabilityConfig = field(
         default_factory=ObservabilityConfig)
+    #: Incident forensics: causal event spine + black-box bundle capture
+    #: (ISSUE 18; spine always on, capture on by default).
+    forensics: ForensicsConfig = field(default_factory=ForensicsConfig)
     #: Elastic queue→device placement control plane (off by default — see
     #: PlacementConfig.enabled()).
     placement: PlacementConfig = field(default_factory=PlacementConfig)
@@ -935,6 +983,7 @@ class Config:
             ("durability", DurabilityConfig),
             ("replication", ReplicationConfig),
             ("observability", ObservabilityConfig),
+            ("forensics", ForensicsConfig),
             ("placement", PlacementConfig),
             ("autotune", AutotuneConfig),
         ):

@@ -334,7 +334,10 @@ class AutoTuner:
             decision.dst = applied
             self.app.events.append(
                 "autotune_" + move.knob, move.queue,
-                f"{move.src} -> {applied}: {move.reason}")
+                f"{move.src} -> {applied}: {move.reason}",
+                component="control",
+                refs={"decision": decision.seq, "knob": move.knob,
+                      "src": str(move.src), "dst": str(applied)})
             self.app.metrics.counters.inc("autotune_moves")
             self.app.metrics.set_gauge(
                 f"autotune_{move.knob}[{move.queue}]",

@@ -156,7 +156,8 @@ class PlacementController:
             return refused.to_dict()
         self.app.events.append(
             "placement_" + action.kind, action.queue,
-            f"{list(decision.src)} -> {list(decision.dst)}: {action.reason}")
+            f"{list(decision.src)} -> {list(decision.dst)}: {action.reason}",
+            component="control", refs={"decision": decision.seq})
         try:
             stats = await rt.migrate(decision.dst)
         except BaseException as e:
@@ -170,7 +171,8 @@ class PlacementController:
             # reads, never a second wall-clock sample.
             self.state.fail(decision, now, f"{e!r}")
             self.app.events.append("placement_failed", action.queue,
-                                   repr(e))
+                                   repr(e), component="control",
+                                   refs={"decision": decision.seq})
             if not isinstance(e, Exception):
                 raise
             log.exception("placement %s of %r failed; binding unchanged",
@@ -180,6 +182,18 @@ class PlacementController:
         self.state.complete(decision, now,
                             stats["blackout_s"], stats["transferred"],
                             detail=action.reason)
+        budget_ms = self.app.cfg.forensics.blackout_budget_ms
+        if budget_ms > 0 and stats["blackout_s"] * 1e3 > budget_ms:
+            # Incident trigger (ISSUE 18): a migration that froze the
+            # queue longer than the operator's budget is a capture-worthy
+            # fact even when the migration itself succeeded.
+            self.app.events.append(
+                "placement_blackout_over_budget", action.queue,
+                f"blackout {stats['blackout_s'] * 1e3:.1f} ms > budget "
+                f"{budget_ms:.1f} ms ({action.kind})",
+                component="control",
+                refs={"decision": decision.seq,
+                      "blackout_ms": round(stats["blackout_s"] * 1e3, 3)})
         self._feed_arbiter()
         self.app.metrics.counters.inc("placement_migrations")
         self.app.metrics.set_gauge(

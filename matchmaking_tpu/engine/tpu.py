@@ -1309,6 +1309,17 @@ class TpuEngine(Engine):
             rep["bands"] = band
         return rep
 
+    def frontier_snapshot(self) -> "dict | None":
+        """Adaptive frontier-K slice for the telemetry sampler (ISSUE 18
+        satellite): the active rung plus the MONOTONE move counter — the
+        bounded ``frontier_moves`` ring rotates, so trajectory deltas need
+        the counter, not the ring length. None without a ladder. Lock-free
+        host-int reads, same contract as util_report()."""
+        if not self._frontier_ladder:
+            return None
+        return {"frontier_k": self._frontier_k_active,
+                "frontier_k_moves": self.counters.get("frontier_k_moves", 0)}
+
     # ---- match-quality & fairness accumulation (ISSUE 8) ------------------
 
     def _quality_accum_dispatch(self, out: Any, now: float) -> None:
@@ -1880,6 +1891,8 @@ class TpuEngine(Engine):
             k = next((r for r in self._frontier_ladder if r >= occ), None)
             if k is not None:
                 if k != self._frontier_k_active:
+                    self.counters["frontier_k_moves"] = (
+                        self.counters.get("frontier_k_moves", 0) + 1)
                     self.frontier_moves.append({
                         "t": time.time(), "from": self._frontier_k_active,
                         "to": k, "peak_bucket_occupancy": occ})

@@ -430,7 +430,9 @@ class _QueueRuntime:
             self.app.events.append(
                 "breaker_trip", self.queue_cfg.name,
                 f"{self.breaker.threshold} crashes in "
-                f"{self.breaker.window_s:.1f}s")
+                f"{self.breaker.window_s:.1f}s",
+                component="service",
+                refs={"crashes": self.breaker.threshold})
             self._publish_breaker_gauges()
             log.error(
                 "queue %r: circuit breaker TRIPPED (%d engine crashes "
@@ -720,7 +722,8 @@ class _QueueRuntime:
         self.app.metrics.counters.inc("journal_compactions")
         self.app.events.append(
             "journal_compacted", self.queue_cfg.name,
-            f"anchor seq {anchor}, {count} waiting players snapshotted")
+            f"anchor seq {anchor}, {count} waiting players snapshotted",
+            component="durability", refs={"anchor": anchor, "count": count})
         return {"anchor": anchor, "snapshot": snap_path, "count": count}
 
     async def recover_from_journal(self) -> "dict | None":
@@ -754,8 +757,7 @@ class _QueueRuntime:
             # Journal replay is an invalidation path in the speculation
             # contract (ISSUE 16): recovery rebuilds the pool from the
             # WAL, so any speculation is against a pool that never was.
-            if hasattr(self.engine, "spec_invalidate"):
-                self.engine.spec_invalidate("journal replay")
+            self._spec_invalidate_audited("journal replay")
 
             def apply() -> tuple[int, int]:
                 n_snap = 0
@@ -795,7 +797,10 @@ class _QueueRuntime:
             f"unclean shutdown: {n_snap} snapshot + {n_tail} journal-tail "
             f"players restored, {len(rec.recent)} dedup entries, "
             f"rto {rto_ms:.1f} ms"
-            + (" (snapshot fallback)" if rec.fallback else ""))
+            + (" (snapshot fallback)" if rec.fallback else ""),
+            component="durability",
+            refs={"snapshot_players": n_snap, "players": n_tail,
+                  "rto_ms": round(rto_ms, 3)})
         log.warning(
             "queue %r: recovered from unclean shutdown — %d snapshot + %d "
             "journal-tail players, %d dedup entries, rto %.1f ms",
@@ -854,7 +859,9 @@ class _QueueRuntime:
         self._repl_task = asyncio.create_task(self._replication_loop())
         self.app.events.append(
             "replication_attached", q,
-            f"owner {owner!r} epoch {epoch}, baseline seq {j.seq}")
+            f"owner {owner!r} epoch {epoch}, baseline seq {j.seq}",
+            component="replication",
+            refs={"epoch": epoch, "records": j.seq})
 
     # holds-lock: _engine_lock
     def _baseline_payload(self, now: float) -> bytes:
@@ -897,11 +904,25 @@ class _QueueRuntime:
         q = self.queue_cfg.name
         t0 = time.perf_counter()
         now = time.time()
+        # The takeover's causal chain onto the event spine (ISSUE 18),
+        # in cause order with epoch refs linking the links: the analyzer
+        # (scripts/postmortem.py) reconstructs lease expiry → epoch bump
+        # → replay window → takeover from the bundle alone.
+        epoch = int(adopted["epoch"])
+        self.app.events.append(
+            "lease_expired", q,
+            f"predecessor's lease lapsed; standby {adopted['owner']!r} "
+            f"claimed the queue", component="replication",
+            refs={"epoch": epoch - 1})
+        self.app.events.append(
+            "epoch_bump", q,
+            f"takeover fenced epoch {epoch - 1} -> {epoch}",
+            component="replication",
+            refs={"epoch": epoch, "prev_epoch": epoch - 1})
         async with self._engine_lock:
-            if hasattr(self.engine, "spec_invalidate"):
-                # Same contract as journal replay: the adopted pool
-                # invalidates any speculation against the empty boot pool.
-                self.engine.spec_invalidate("replica adoption")
+            # Same contract as journal replay: the adopted pool
+            # invalidates any speculation against the empty boot pool.
+            self._spec_invalidate_audited("replica adoption")
 
             def apply() -> int:
                 tail = [row_to_request(rec.waiting[pid])
@@ -924,12 +945,22 @@ class _QueueRuntime:
             # without needing the (dead) predecessor's stream again.
             await self.compact_journal()
         rto_ms = (time.perf_counter() - t0) * 1e3
+        self.app.events.append(
+            "replay_window", q,
+            f"standby shadow applied: {n_tail} waiting players, "
+            f"{len(rec.recent)} dedup entries (applied seq "
+            f"{adopted.get('applied_seq', 0)})", component="replication",
+            refs={"epoch": epoch, "players": n_tail,
+                  "records": int(adopted.get("applied_seq", 0))})
         self.app.metrics.set_gauge(f"failover_rto_ms[{q}]", round(rto_ms, 3))
         self.app.metrics.counters.inc("failover_takeovers")
         self.app.events.append(
             "failover_takeover", q,
             f"epoch {adopted['epoch']}: {n_tail} waiting players adopted, "
-            f"{len(rec.recent)} dedup entries, rto {rto_ms:.1f} ms")
+            f"{len(rec.recent)} dedup entries, rto {rto_ms:.1f} ms",
+            component="replication",
+            refs={"epoch": epoch, "players": n_tail,
+                  "rto_ms": round(rto_ms, 3)})
         log.warning(
             "queue %r: failover takeover (epoch %s) — %d waiting players "
             "adopted, %d dedup entries, rto %.1f ms",
@@ -2446,6 +2477,22 @@ class _QueueRuntime:
         self.app.metrics.counters.inc("windows")
         self.app.metrics.counters.inc("requests_batched", len(deliveries))
 
+    def _spec_invalidate_audited(self, reason: str) -> None:
+        """Discard any pending speculation AND stamp the invalidation
+        onto the event spine (ISSUE 18) — invalidations were counter-only
+        before, invisible to the incident timeline. The event fires only
+        when a speculation was actually pending: the drain/checkpoint
+        chokepoints call this unconditionally, and an empty invalidation
+        is not a causal fact worth a timeline row."""
+        eng = self.engine
+        if not hasattr(eng, "spec_invalidate"):
+            return
+        had = getattr(eng, "_spec", None) is not None
+        eng.spec_invalidate(reason)
+        if had:
+            self.app.events.append("spec_invalidate", self.queue_cfg.name,
+                                   reason, component="engine")
+
     # holds-lock: _engine_lock
     async def _drain_engine(self, now: float) -> None:
         """Flush every in-flight window and handle its outcome. Caller holds
@@ -2455,8 +2502,7 @@ class _QueueRuntime:
         # callers are about to mutate, checkpoint, migrate, or revive —
         # a speculative pool committed after a checkpoint walk would
         # double-match players the snapshot still holds as waiting.
-        if hasattr(self.engine, "spec_invalidate"):
-            self.engine.spec_invalidate("drain")
+        self._spec_invalidate_audited("drain")
         if not self._pipelined:
             return
         if self.engine.inflight() > 0:
@@ -2474,8 +2520,7 @@ class _QueueRuntime:
         # The mirror rebuild replaces the device pool a pending
         # speculation was computed against — device-loss demotion is one
         # of the invalidation paths the speculation contract names.
-        if hasattr(self.engine, "spec_invalidate"):
-            self.engine.spec_invalidate("revive")
+        self._spec_invalidate_audited("revive")
         self._needs_revive = False
         self.engine.device_error = None
         self._revive_engine(now)
@@ -3101,7 +3146,12 @@ class _QueueRuntime:
                           "full step")
             self.app.metrics.counters.inc("spec_errors")
             if hasattr(eng, "spec_invalidate"):
+                had = getattr(eng, "_spec", None) is not None
                 eng.spec_invalidate("cut-commit failure")
+                if had:
+                    self.app.events.append(
+                        "spec_invalidate", self.queue_cfg.name,
+                        "cut-commit failure", component="engine")
             return False
 
     async def _spec_loop(self) -> None:
@@ -3147,8 +3197,7 @@ class _QueueRuntime:
                 self.app.metrics.counters.inc("spec_errors")
                 try:
                     async with self._engine_lock:
-                        if hasattr(self.engine, "spec_invalidate"):
-                            self.engine.spec_invalidate("tick failure")
+                        self._spec_invalidate_audited("tick failure")
                 except Exception:
                     log.exception("speculation discard failed")
                 await asyncio.sleep(0.05)
@@ -3599,10 +3648,22 @@ class MatchmakingApp:
         #: failover successor — in-process here, per-host over DCN later.
         self.replication_hub = replication_hub
         obs = self.cfg.observability
+        #: Causal event spine (ISSUE 18, utils/forensics.py): ONE
+        #: process-wide monotone sequence every lifecycle emission is
+        #: stamped onto — EventLog appends, knob/placement decisions,
+        #: replication epoch transitions, journal compaction/replay,
+        #: breaker trips, SLO burns, speculation invalidations — so a
+        #: single seq-ordered timeline spans engine→service→control→
+        #: replication. Per-app, not module-global: two seeded runs must
+        #: each start at seq 1 for the incident-soak's transcript pin.
+        from matchmaking_tpu.utils.forensics import EventSpine
+
+        self.spine = EventSpine(ring=self.cfg.forensics.spine_ring)
         #: Lifecycle event timeline (/debug/events): breaker trips, probes,
         #: delegations, revives, chaos faults — one bounded ring, appended
         #: to by the app, the broker, the engines, and the chaos hooks.
-        self.events = EventLog(obs.event_ring)
+        #: Every append routes through the spine above.
+        self.events = EventLog(obs.event_ring, spine=self.spine)
         #: Trace stamping master switch (flight recorder).
         self.trace_enabled = obs.trace
         #: Trace every Nth request publish (1 = all; PR 3 follow-up for
@@ -3663,6 +3724,13 @@ class MatchmakingApp:
         if hasattr(self.broker, "trace_sample_n"):
             self.broker.trace_sample_n = self.trace_sample_n
         self._runtimes: dict[str, _QueueRuntime] = {}
+        #: Black-box incident capture (ISSUE 18): subscribes to the spine
+        #: and freezes bounded ring snapshots into schema-versioned
+        #: bundles on trigger rules (/debug/incidents). Built after
+        #: _runtimes exists — a capture racing construction reads {}.
+        from matchmaking_tpu.utils.forensics import IncidentRecorder
+
+        self.incidents = IncidentRecorder(self, self.cfg.forensics)
         self._started = False
         self._observability = None
         #: Elastic placement control plane (ISSUE 11; None = disabled).
@@ -4015,6 +4083,15 @@ class MatchmakingApp:
                     for k in ("spec_hit", "spec_miss", "spec_wasted"):
                         vals[f"{k}[{name}]"] = float(sr[k])
                     vals[f"spec_hit_rate[{name}]"] = sr["spec_hit_rate"]
+            if hasattr(rt.engine, "frontier_snapshot"):
+                # Adaptive frontier-K (ISSUE 14) into the ring (ISSUE 18
+                # satellite): incident bundles and TuneView read the
+                # active rung + monotone move count as trajectories.
+                fs = rt.engine.frontier_snapshot()
+                if fs is not None:
+                    vals[f"frontier_k[{name}]"] = float(fs["frontier_k"])
+                    vals[f"frontier_k_moves[{name}]"] = float(
+                        fs["frontier_k_moves"])
         self.telemetry.append(now, vals)
         for mon in self._slo_monitors.values():
             mon.evaluate(self.telemetry, now)

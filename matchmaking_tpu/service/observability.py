@@ -164,6 +164,13 @@ def build_report(app) -> dict[str, Any]:
             engine_quality[name] = rep
     if engine_quality:
         report["quality_engine"] = engine_quality
+    # Incident forensics (ISSUE 18): capture/drop counters, per-class
+    # split and bundle summaries — the black box must be discoverable
+    # from /metrics (and prom, via the incidents_* counters) without
+    # knowing /debug/incidents exists.
+    incidents = getattr(app, "incidents", None)
+    if incidents is not None:
+        report["incidents"] = incidents.snapshot()
     return report
 
 
@@ -313,6 +320,13 @@ def _flatten_prom(report: dict[str, Any]) -> str:
         if rep.get("quality_mean") is not None:
             fams.add("matchmaking_quality_mean", "gauge", {"queue": queue},
                      rep["quality_mean"])
+    # Incident forensics (ISSUE 18): the aggregate incidents_captured /
+    # incidents_dropped counters already flow through the counters block
+    # above; the per-trigger-class split gets its own labeled family so
+    # alert rules can page on "failover captured a bundle" specifically.
+    for cls, n in report.get("incidents", {}).get("by_class", {}).items():
+        fams.add("matchmaking_incidents_by_class", "counter",
+                 {"class": cls}, n)
     # True per-stage latency histograms (the flight recorder's output) as a
     # proper histogram family: cumulative le buckets + _sum + _count.
     for queue, stages in report.get("stage_seconds", {}).items():
@@ -422,6 +436,19 @@ class ObservabilityServer:
             "slo_burning_queues": burning,
             "queues": queues,
         }
+        # Black-box health (ISSUE 18): an operator triaging a page sees
+        # "3 bundles captured, newest inc-000003" here and goes straight
+        # to /debug/incidents instead of spelunking five rings.
+        incidents = getattr(self.app, "incidents", None)
+        if incidents is not None:
+            inc = incidents.snapshot()
+            body["incidents"] = {
+                "captured": inc["captured"],
+                "dropped": inc["dropped"],
+                "by_class": inc["by_class"],
+                "last_id": (inc["incidents"][-1]["id"]
+                            if inc["incidents"] else None),
+            }
         return web.json_response(body)
 
     async def _metrics(self, request) -> "web.Response":
@@ -625,6 +652,29 @@ class ObservabilityServer:
             "events": events.snapshot(queue=request.query.get("queue"),
                                       limit=limit)})
 
+    async def _debug_incidents(self, request) -> "web.Response":
+        """Black-box bundles (ISSUE 18): summaries + capture/drop
+        counters; ``?id=inc-NNNNNN`` fetches one full bundle,
+        ``?bundles=1`` inlines them all (incident-soak convenience —
+        bundles are bounded but not small)."""
+        incidents = getattr(self.app, "incidents", None)
+        if incidents is None or not self.app.cfg.forensics.enabled():
+            return web.json_response({"error": "incident capture disabled"},
+                                     status=404)
+        incident_id = request.query.get("id")
+        if incident_id:
+            bundle = incidents.get(incident_id)
+            if bundle is None:
+                return web.json_response(
+                    {"error": f"no incident {incident_id!r} in the ring"},
+                    status=404)
+            return web.Response(text=json.dumps(bundle, sort_keys=True),
+                                content_type="application/json")
+        body = incidents.snapshot(
+            include_bundles=request.query.get("bundles") == "1")
+        return web.Response(text=json.dumps(body, sort_keys=True),
+                            content_type="application/json")
+
     async def _debug_profile(self, request) -> "web.Response":
         """jax.profiler capture of the live process: ``?secs=N`` (clamped to
         30 s). One capture at a time — the profiler is process-global."""
@@ -679,6 +729,7 @@ class ObservabilityServer:
         http_app.router.add_get("/debug/autotune", self._debug_autotune)
         http_app.router.add_get("/debug/telemetry", self._debug_telemetry)
         http_app.router.add_get("/debug/events", self._debug_events)
+        http_app.router.add_get("/debug/incidents", self._debug_incidents)
         http_app.router.add_get("/debug/profile", self._debug_profile)
         self._runner = web.AppRunner(http_app)
         await self._runner.setup()

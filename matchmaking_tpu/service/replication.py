@@ -581,7 +581,9 @@ class QueueReplication:
         if self.metrics is not None:
             self.metrics.counters.inc("replication_fenced")
         if self.events is not None:
-            self.events.append("replication_fenced", self.queue, why)
+            self.events.append("replication_fenced", self.queue, why,
+                               component="replication",
+                               refs={"epoch": self.epoch})
         log.warning("queue %r: FENCED (%s)", self.queue, why)
 
     # ---- pump (ack collection / retransmit / lease renewal) ----------------

@@ -236,9 +236,17 @@ class SloMonitor:
                         f"slow={self.burn_slow:.2f} "
                         f"(threshold {self.burn_threshold:.2f}, target "
                         f"{target}, objective "
-                        f"{self.objective:.4f})")
+                        f"{self.objective:.4f})",
+                        component="slo",
+                        refs={"slo_kind": self.kind,
+                              "burn_fast": round(self.burn_fast, 4),
+                              "burn_slow": round(self.burn_slow, 4)})
                 else:
-                    self._events.append("slo_burn_clear", self.queue)
+                    self._events.append("slo_burn_clear", self.queue,
+                                        "error budget burn back under "
+                                        "threshold on both windows",
+                                        component="slo",
+                                        refs={"slo_kind": self.kind})
         if self._metrics is not None:
             q = self.queue
             self._metrics.set_gauge(f"slo_burning[{q}]",

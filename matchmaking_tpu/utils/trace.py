@@ -113,17 +113,34 @@ class EventLog:
     """Bounded ring of lifecycle events — the single place trips, probes,
     delegations, re-promotions, revives and chaos faults become a readable
     timeline instead of counter deltas. Appended from the event loop AND
-    engine worker threads (delegation events fire inside to_thread), so
-    the seq source must be atomic — itertools.count is."""
+    engine worker threads (delegation events fire inside to_thread).
 
-    def __init__(self, maxlen: int = 512):
-        self._events: deque[tuple[int, float, str, str, str]] = deque(
-            maxlen=max(1, maxlen))
-        self._seq = itertools.count(1)
+    Since ISSUE 18 every append is stamped onto the app's causal
+    EventSpine (utils/forensics.py): rows carry the process-wide monotone
+    ``seq`` + ``mono_ns`` pair and a ``component`` tag, plus optional
+    ``refs`` linking causal neighbors (epoch, decision id, WAL range).
+    ``snapshot()`` orders by SEQ, not wall clock — two events in the same
+    millisecond can no longer render out of causal order, and a worker
+    thread that drew its seq but lost the append race to the ring no
+    longer appears late."""
 
-    def append(self, kind: str, queue: str = "", detail: str = "") -> None:
-        self._events.append(
-            (next(self._seq), time.time(), kind, queue, detail))
+    def __init__(self, maxlen: int = 512, spine=None):
+        self._events: deque[dict[str, Any]] = deque(maxlen=max(1, maxlen))
+        if spine is None:
+            # Standalone EventLog (tests, subsystems constructed without
+            # an app): own a private spine so rows are shaped identically.
+            from matchmaking_tpu.utils.forensics import EventSpine
+
+            spine = EventSpine(ring=max(1, maxlen))
+        self.spine = spine
+
+    def append(self, kind: str, queue: str = "", detail: str = "",
+               component: str = "",
+               refs: "dict[str, Any] | None" = None) -> dict[str, Any]:
+        ev = self.spine.stamp(kind, queue, detail, component=component,
+                              refs=refs)
+        self._events.append(ev)
+        return ev
 
     def __len__(self) -> int:
         return len(self._events)
@@ -133,10 +150,18 @@ class EventLog:
         # tuple() first: worker threads append concurrently, and iterating
         # a live deque across their mutations raises RuntimeError.
         rows = [
-            {"seq": s, "t": t, "kind": k, "queue": q, "detail": d}
-            for s, t, k, q, d in tuple(self._events)
-            if queue is None or q == queue
+            {"seq": ev["seq"], "t": ev["wall"], "mono_ns": ev["mono_ns"],
+             "component": ev["component"], "kind": ev["kind"],
+             "queue": ev["queue"], "detail": ev["detail"],
+             "refs": ev["refs"]}
+            for ev in tuple(self._events)
+            if queue is None or ev["queue"] == queue
         ]
+        # Causal order is the SEQ order: ring append order can diverge
+        # when a worker thread is preempted between its seq draw and the
+        # stamp landing (the old wall-clock-only sort had the same hole
+        # one level up).
+        rows.sort(key=lambda r: r["seq"])
         return rows[-limit:] if limit else rows
 
 

@@ -157,6 +157,41 @@ assert out["failover_rto_ms"] is not None and \
 print("failover-soak smoke: OK")
 EOF
 
+echo "== forensics =="
+# ISSUE 18 gate: incident forensics. The suite runs by marker first —
+# the causal spine's monotone seq under concurrent worker threads, the
+# deterministic transcript projection (clock fields and timing refs
+# dropped), trigger/rate-limit/reentrancy capture with counted drops,
+# concurrent /debug/incidents + prom scrapes after a real failover, the
+# capture-during-drain non-interference check, the offline postmortem
+# root chain, and the journal LSN-range slicer. The static twin is the
+# matchlint determinism rule's spine-seq tokens in the full lint above.
+JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'forensics and not slow' \
+    --continue-on-collection-errors -p no:cacheprovider
+# Every committed example bundle must validate against the current
+# schema AND survive the offline analyzer's root-chain resolution — a
+# schema drift that orphans the examples fails here, not in an incident.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import glob, json, subprocess, sys
+from matchmaking_tpu.utils.forensics import validate_bundle
+bundles = sorted(glob.glob("examples/incidents/*.json"))
+if not bundles:
+    sys.exit("no committed example bundles under examples/incidents/")
+for path in bundles:
+    with open(path, encoding="utf-8") as f:
+        problems = validate_bundle(json.load(f))
+    if problems:
+        sys.exit(f"{path}: {problems}")
+    proc = subprocess.run(
+        [sys.executable, "scripts/postmortem.py", path, "--json"],
+        capture_output=True, text=True, timeout=120)
+    if proc.returncode != 0:
+        sys.stderr.write(proc.stderr)
+        sys.exit(f"postmortem failed on {path} ({proc.returncode})")
+    chain = json.loads(proc.stdout)["root_chain_kinds"]
+    print(f"example bundle OK: {path} (root chain: {' -> '.join(chain)})")
+EOF
+
 echo "== speculation =="
 # ISSUE 16 gate: speculative formation. The equivalence suite runs by
 # name, seconds-scale on the CPU harness: commit ≡ rescan bit-exactness

@@ -14,6 +14,11 @@ Read-only: it never truncates, repairs, or appends.
     # one queue, machine-readable
     python scripts/journal_dump.py /path/to/dir --queue matchmaking.search --json
 
+    # slice the LSN window an incident bundle names (ISSUE 18): record
+    # seq + type + payload size for every frame in [A, B]
+    python scripts/journal_dump.py /path/to/dir --queue matchmaking.search \
+        --lsn-range 120,180
+
 Exit status is 0 when every inspected artifact is intact, 1 when any
 segment has a torn tail / CRC-bad frame or any snapshot fails
 verification — so the script doubles as a fleet health probe.
@@ -115,6 +120,42 @@ def inspect_queue(directory: str, queue: str) -> dict:
     return report
 
 
+def slice_lsn_range(directory: str, queue: str, lo: int,
+                    hi: int) -> dict:
+    """The live segment's records with ``lo <= seq <= hi`` — the slice an
+    incident bundle's journal watermark names (``lsn_range``), so the
+    forensics workflow is: read the bundle, then dump exactly that WAL
+    window. Read-only, torn tails tolerated (the intact prefix is
+    sliced)."""
+    seg_path = journal_path(directory, queue)
+    out: dict = {"queue": queue, "lsn_range": [lo, hi], "records": []}
+    if not os.path.exists(seg_path):
+        out["error"] = f"no segment for queue {queue!r}"
+        return out
+    try:
+        _header, records, torn, _intact = read_segment(seg_path)
+    except ValueError as e:
+        out["error"] = str(e)
+        return out
+    out["torn"] = torn
+    seqs = [seq for seq, _rtype, _payload in records]
+    if seqs:
+        out["segment_range"] = [min(seqs), max(seqs)]
+    for seq, rtype, payload in records:
+        if lo <= seq <= hi:
+            out["records"].append({
+                "seq": seq,
+                "type": RT_NAMES.get(rtype, f"rtype{rtype}"),
+                "payload_bytes": len(payload),
+            })
+    if not out["records"] and seqs and hi < min(seqs):
+        out["note"] = (
+            f"window {lo}..{hi} predates the live segment "
+            f"(seq {min(seqs)}..{max(seqs)}) — compaction carried it into "
+            "a snapshot; check the snapshot at or above this range")
+    return out
+
+
 def inspect_dir(directory: str) -> dict:
     """Every queue with artifacts under ``directory`` → its report."""
     queues: set[str] = set()
@@ -157,9 +198,39 @@ def main(argv: "list[str] | None" = None) -> int:
                     help="inspect one queue (default: every queue found)")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable output")
+    ap.add_argument("--lsn-range", default="",
+                    help="A,B — dump the records with A <= seq <= B from "
+                         "the live segment (the window an incident "
+                         "bundle's journal watermark names); requires "
+                         "--queue")
     args = ap.parse_args(argv)
     if not os.path.isdir(args.directory):
         sys.exit(f"not a directory: {args.directory}")
+    if args.lsn_range:
+        if not args.queue:
+            sys.exit("--lsn-range requires --queue")
+        try:
+            lo_s, hi_s = args.lsn_range.split(",", 1)
+            lo, hi = int(lo_s), int(hi_s)
+        except ValueError:
+            sys.exit(f"--lsn-range wants A,B integers, got "
+                     f"{args.lsn_range!r}")
+        sliced = slice_lsn_range(args.directory, args.queue, lo, hi)
+        if args.as_json:
+            json.dump(sliced, sys.stdout, indent=2, sort_keys=True)
+            print()
+        else:
+            print(f"queue {args.queue!r} LSN range {lo}..{hi}:")
+            if sliced.get("error"):
+                print(f"  error: {sliced['error']}")
+            for rec in sliced["records"]:
+                print(f"  seq {rec['seq']:<8} {rec['type']:<10} "
+                      f"{rec['payload_bytes']} bytes")
+            print(f"  {len(sliced['records'])} record(s) in range"
+                  + ("  [torn tail]" if sliced.get("torn") else ""))
+            if sliced.get("note"):
+                print(f"  note: {sliced['note']}")
+        return 0 if not sliced.get("error") else 1
     if args.queue:
         reports = {args.queue: inspect_queue(args.directory, args.queue)}
     else:
@@ -176,4 +247,9 @@ def main(argv: "list[str] | None" = None) -> int:
 
 
 if __name__ == "__main__":
-    raise SystemExit(main())
+    try:
+        raise SystemExit(main())
+    except BrokenPipeError:
+        # downstream pager/head closed the pipe; exit quietly like other CLIs
+        sys.stderr.close()
+        raise SystemExit(0)

@@ -11,8 +11,13 @@ request slow" workflow:
     # dump one trace by id (ids appear in the listing)
     python scripts/trace_dump.py --id 'matchmaking.search#1234'
 
-    # recent lifecycle events (breaker trips, probes, chaos faults)
+    # recent lifecycle events (breaker trips, probes, chaos faults),
+    # causally ordered by the spine seq (ISSUE 18)
     python scripts/trace_dump.py --events
+
+    # incident bundles (ISSUE 18): the live ring, or one bundle offline
+    python scripts/trace_dump.py --incident live
+    python scripts/trace_dump.py --incident incident_inc-000001_failover.json
 
     # wait-vs-work gap waterfall (the ISSUE 6 attribution taxonomy)
     python scripts/trace_dump.py --queue matchmaking.search --slow --gaps
@@ -223,6 +228,11 @@ def main(argv=None) -> None:
     ap.add_argument("--n", type=int, default=16, help="traces per ring")
     ap.add_argument("--events", action="store_true",
                     help="show the lifecycle event log instead of traces")
+    ap.add_argument("--incident", default="",
+                    help="incident forensics (ISSUE 18): 'live' lists the "
+                         "service's bundle ring (with --id, fetches one "
+                         "bundle and renders its timeline); a file path "
+                         "renders that bundle offline via postmortem.py")
     ap.add_argument("--gaps", action="store_true",
                     help="render traces as a wait-vs-work gap waterfall "
                          "(attribution taxonomy) instead of raw stages")
@@ -300,14 +310,53 @@ def main(argv=None) -> None:
             render_attribution(body)
         return
 
+    if args.incident:
+        import postmortem
+
+        if args.incident != "live":
+            with open(args.incident, encoding="utf-8") as f:
+                bundle = json.load(f)
+            if args.json:
+                print(json.dumps(postmortem.analyze(bundle), indent=2,
+                                 sort_keys=True))
+            else:
+                postmortem.render(bundle, limit=args.n)
+            return
+        if args.id:
+            bundle = _get(base, "/debug/incidents", {"id": args.id})
+            if args.json:
+                print(json.dumps(postmortem.analyze(bundle), indent=2,
+                                 sort_keys=True))
+            else:
+                postmortem.render(bundle, limit=args.n)
+            return
+        body = _get(base, "/debug/incidents", {})
+        if args.json:
+            print(json.dumps(body, indent=2))
+            return
+        print(f"incidents: {body.get('captured', 0)} captured, "
+              f"{body.get('dropped', 0)} dropped "
+              f"(by class {body.get('by_class', {})}); "
+              f"capture p99 {body.get('capture_ms_p99')} ms")
+        for inc in body.get("incidents", []):
+            print(f"  {inc['id']}  class={inc['class']:<20} "
+                  f"queue={inc['queue'] or '-':<22} seq={inc['seq']:<7} "
+                  f"{inc['spine_events']} spine events, "
+                  f"captured in {inc['capture_ms']:.1f} ms")
+        return
+
     if args.events:
         body = _get(base, "/debug/events",
                     {"queue": args.queue, "n": args.n})
         if args.json:
             print(json.dumps(body, indent=2))
             return
+        # Causal order: rows arrive seq-sorted from the server; render
+        # the seq + component so two events in the same millisecond read
+        # in their true order (the old wall-clock print hid ties).
         for ev in body.get("events", []):
-            print(f"{ev['t']:.3f}  [{ev['kind']}] {ev['queue']}"
+            print(f"#{ev.get('seq', 0):<6} {ev['t']:.3f}  "
+                  f"[{ev.get('component', '?')}/{ev['kind']}] {ev['queue']}"
                   + (f" — {ev['detail']}" if ev.get("detail") else ""))
         return
 
@@ -335,4 +384,8 @@ def main(argv=None) -> None:
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except BrokenPipeError:
+        # downstream pager/head closed the pipe; exit quietly like other CLIs
+        sys.stderr.close()

@@ -104,6 +104,15 @@ def pytest_configure(config):
         "regression, the sanitizer's replication twin, and the offline "
         "journal inspector — scripts/check.sh runs it by marker plus a "
         "2-cycle failover-soak smoke; part of tier-1)")
+    config.addinivalue_line(
+        "markers", "forensics: incident-forensics suite (ISSUE 18: the "
+        "causal event spine's monotone seq under threads, black-box "
+        "trigger/rate-limit/reentrancy capture, bundle schema "
+        "validation, /debug/incidents + prom exposition mid-failover, "
+        "capture-during-drain non-interference, the offline postmortem "
+        "root chain, and the journal LSN-range slicer — "
+        "scripts/check.sh runs it by marker plus committed-example "
+        "bundle validation; part of tier-1)")
 
 
 @pytest.fixture

@@ -474,6 +474,45 @@ class Lease:
     assert clean == []
 
 
+@pytest.mark.forensics
+def test_determinism_covers_spine_seq_arithmetic():
+    """ISSUE 18 satellite: the forensics spine's causal order IS its
+    monotone counter seq — a spine/event/incident seq or capture
+    schedule derived from time.time() would make the incident-soak's
+    bit-identical transcript (and every postmortem timeline) a function
+    of wall-clock jitter. The sanctioned clocks on a spine row are DATA
+    fields (mono_ns, wall) that never feed the seq."""
+    findings = analyze_source('''
+import time
+
+class Spine:
+    def stamp(self, last):
+        spine_seq = int(time.time() * 1e6)
+        event_seq = last + time.time()
+        self.next_capture = time.time() + 5.0
+        mono_ns = time.time() * 1e9
+        return spine_seq, event_seq, mono_ns
+''', path="matchmaking_tpu/utils/fixture.py")
+    assert _rules(findings) == ["determinism"] * 4
+    # The sanctioned shape (utils/forensics.py): seq from a counter,
+    # mono_ns from the monotonic clock, wall as plain display data.
+    clean = analyze_source('''
+import itertools
+import time
+
+class Spine:
+    def __init__(self):
+        self._seq = itertools.count(1)
+
+    def stamp(self):
+        spine_seq = next(self._seq)
+        mono_ns = time.monotonic_ns()
+        wall = time.time()
+        return spine_seq, mono_ns, wall
+''', path="matchmaking_tpu/utils/fixture.py")
+    assert clean == []
+
+
 # ---- perf (ISSUE 8: O(pool)/O(matches) scans on the hot path) --------------
 
 def test_perf_flags_pool_scan_in_hot_path_function():
