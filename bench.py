@@ -2693,6 +2693,43 @@ async def _scenario_cell(args, scn) -> dict:
         await app.stop()
 
 
+def bench_modelcheck(args) -> dict:
+    """Small-scope interleaving model check (ISSUE 19, ``--modelcheck``):
+    bounded EXHAUSTIVE enumeration of action interleavings x fault
+    injections over the real lease/replication/journal objects
+    (analysis/modelcheck.py), no jax backend needed. Emits
+    ``modelcheck_states_explored`` / ``modelcheck_violations`` /
+    ``modelcheck_exhaustive`` (gated by scripts/bench_diff.py:
+    violations under the zero-baseline rule) plus the minimized,
+    digest-replayable counterexample when one exists."""
+    from matchmaking_tpu.analysis.modelcheck import (
+        ModelCheckConfig, run_modelcheck)
+
+    cfg = ModelCheckConfig(
+        queues=args.modelcheck_queues,
+        depth=args.modelcheck_depth,
+        admits=args.modelcheck_admits,
+        settles=args.modelcheck_settles,
+        faults=tuple(f for f in args.modelcheck_faults.split(",") if f),
+        fault_budget=args.modelcheck_fault_budget,
+        deadline_s=args.modelcheck_deadline_s or None,
+    )
+    return run_modelcheck(cfg)
+
+
+def bench_modelcheck_mutations(args) -> dict:
+    """Mutation gate for the model checker (ISSUE 19,
+    ``--modelcheck-mutations``): break each fenced seam one at a time
+    (skip the append fence, ack past the horizon, apply a gapped seq,
+    publish from a stale epoch) and require every mutant to yield a
+    minimized counterexample that replays bit-identically under its
+    schedule digest — the checker's own falsifiability proof. Emits
+    ``mutation_gate_passed`` (check.sh fails the build on False)."""
+    from matchmaking_tpu.analysis.modelcheck import run_mutation_gate
+
+    return run_mutation_gate()
+
+
 def bench_scenario_matrix(args) -> dict:
     """The scenario observatory (ISSUE 13): run every requested scenario
     as one matrix cell — fresh app, seeded population load, autotuner
@@ -3056,6 +3093,37 @@ def main() -> None:
     p.add_argument("--incident-keep-dirs", action="store_true",
                    help="keep the per-run journal + incident directories "
                         "for inspection")
+    p.add_argument("--modelcheck", action="store_true",
+                   help="standalone: bounded exhaustive interleaving "
+                        "model check of the lease/replication/failover "
+                        "protocol on the REAL objects "
+                        "(analysis/modelcheck.py) — no backend needed; "
+                        "emits modelcheck_* metrics and a minimized "
+                        "digest-replayable counterexample on violation")
+    p.add_argument("--modelcheck-mutations", action="store_true",
+                   help="standalone: the model checker's mutation gate — "
+                        "break each fenced seam one at a time and "
+                        "require a minimized counterexample per mutant "
+                        "(mutation_gate_passed)")
+    p.add_argument("--modelcheck-queues", type=int, default=2,
+                   help="modelcheck scope: queues sharing one lease "
+                        "authority")
+    p.add_argument("--modelcheck-depth", type=int, default=6,
+                   help="modelcheck scope: schedule length bound")
+    p.add_argument("--modelcheck-admits", type=int, default=2,
+                   help="modelcheck scope: admit windows per queue")
+    p.add_argument("--modelcheck-settles", type=int, default=1,
+                   help="modelcheck scope: terminal settles per queue")
+    p.add_argument("--modelcheck-faults",
+                   default="expire,crash,drop,dup",
+                   help="modelcheck scope: comma list from "
+                        "expire,crash,drop,dup,reorder,partition")
+    p.add_argument("--modelcheck-fault-budget", type=int, default=2,
+                   help="modelcheck scope: total fault actions per "
+                        "schedule")
+    p.add_argument("--modelcheck-deadline-s", type=float, default=0.0,
+                   help="modelcheck wall-clock cap in seconds (0 = "
+                        "none; hitting it reports exhaustive=false)")
     p.add_argument("--scenario-matrix", default="",
                    help="scenario observatory (ISSUE 13): run the named "
                         "population-model scenarios (comma list, or 'all' "
@@ -3093,6 +3161,15 @@ def main() -> None:
                         "<dir>/<scenario>.json (the configs/tuned/ "
                         "capacity artifacts)")
     args = p.parse_args()
+    if args.modelcheck:
+        # Standalone, pure host-side: the checker drives the real
+        # replication objects under a virtual clock — no jax backend,
+        # no broker, deterministic by construction.
+        print(json.dumps(bench_modelcheck(args)), flush=True)
+        return
+    if args.modelcheck_mutations:
+        print(json.dumps(bench_modelcheck_mutations(args)), flush=True)
+        return
     if args.crash_soak:
         # Standalone like --placement-soak: the device-lost cycle needs a
         # D=2 mesh, so force >= 2 host devices before any jax import (a

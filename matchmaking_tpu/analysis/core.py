@@ -44,6 +44,7 @@ RULES = (
     "device",
     "stale-ignore",
     "speculation",
+    "protocol",
 )
 
 

@@ -37,6 +37,7 @@ from matchmaking_tpu.analysis import (
     lifecycle,
     locks,
     perf,
+    protocol,
     recompile,
     speculation,
 )
@@ -54,18 +55,19 @@ from matchmaking_tpu.analysis.core import (
 )
 
 #: Bump to invalidate every cache entry when rule semantics change.
-ANALYZER_VERSION = "2.3"
+ANALYZER_VERSION = "2.4"
 
-#: Per-file rule-module checkers (run per SourceFile; locks additionally
-#: takes the cross-file contract registry).
+#: Per-file rule-module checkers (run per SourceFile; locks and protocol
+#: additionally take cross-file registries).
 _PER_FILE_CHECKS = (blocking.check, determinism.check, perf.check,
                     lifecycle.check, device_audit.check_static,
                     recompile.check_static, speculation.check)
 
 
-def _check_file(sf: SourceFile, external) -> list[Finding]:
+def _check_file(sf: SourceFile, external, vocab=None) -> list[Finding]:
     findings: list[Finding] = []
     findings.extend(locks.check([sf], external=external))
+    findings.extend(protocol.check([sf], vocab=vocab))
     for chk in _PER_FILE_CHECKS:
         findings.extend(chk([sf]))
     return findings
@@ -83,7 +85,8 @@ def analyze_source(code: str, path: str = "snippet.py") -> list[Finding]:
         with open(full, "w", encoding="utf-8") as f:
             f.write(code)
         sf = SourceFile(tmp, path)
-    findings = _check_file(sf, locks.collect_external([sf]))
+    findings = _check_file(sf, locks.collect_external([sf]),
+                           vocab=protocol.collect_vocab([sf]))
     findings = apply_ignores(findings, {sf.path: sf})
     # stale-ignore findings are themselves inline-suppressible, like
     # every other rule — apply the ignore map to them too.
@@ -168,7 +171,12 @@ def analyze_repo(root: str | None = None, dynamic: bool = True,
     # per-file scope is narrowed: a changed caller must see an unchanged
     # class's externally-serialized-by declaration.
     external = locks.collect_external(sources)
-    salt = _external_digest(external)
+    # The record-type vocabulary is a cross-file registry like the lock
+    # contracts: collected over the FULL tree, folded into the per-file
+    # cache salt so a new RT_* constant elsewhere re-evaluates cached
+    # drift/coverage verdicts.
+    vocab = protocol.collect_vocab(sources)
+    salt = _external_digest(external) + ":" + vocab.digest()
 
     scope = sources
     warnings: list[str] = []
@@ -191,7 +199,7 @@ def analyze_repo(root: str | None = None, dynamic: bool = True,
             findings.extend(_finding_from_dict(d)
                             for d in hit.get("findings", []))
             continue
-        file_findings = _check_file(sf, external)
+        file_findings = _check_file(sf, external, vocab=vocab)
         findings.extend(file_findings)
         cache_out[sf.path] = {
             "key": key,

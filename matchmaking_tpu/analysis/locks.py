@@ -30,11 +30,19 @@ Every mutation of a declared attribute (rebind, aug-assign, ``del``,
 subscript store, or a mutating method call like ``.pop``/``.append``, and
 attribute stores THROUGH it like ``self.engine.device_error = ...``) must
 be dominated by the declared lock: lexically inside ``with``/``async
-with`` on that lock, or in a method that is ``__init__``, ends with
-``_locked``, or carries ``# holds-lock: <lock>`` on/above its ``def``
-line. Calls to ``self.<m>()`` where ``m`` is a lock-holding method are
-checked the same way, so the caller-holds-lock convention is enforced one
-level deep instead of trusted.
+with`` on that lock (the ``with`` shape covers ``threading.Lock``/
+``RLock`` in host-side modules — journal, replication, forensics — the
+same way ``async with`` covers ``asyncio.Lock``), or in a method that is
+``__init__``, ends with ``_locked``, carries ``# holds-lock: <lock>``
+on/above its ``def`` line, or is **construction-only**: a private helper
+whose every in-class caller is ``__init__`` (directly or through other
+construction-only helpers) and that never escapes as a bound value —
+construction is single-threaded, no other thread can hold the half-built
+instance, so ``__init__``-factored ``_open_*``/``_reopen_*`` helpers
+binding guarded attributes stay undeclared. Calls to ``self.<m>()``
+where ``m`` is a lock-holding method are checked the same way, so the
+caller-holds-lock convention is enforced one level deep instead of
+trusted.
 
 **cross-class mode** — a class whose WHOLE public surface is serialized by
 a lock its CALLER owns (TpuEngine: "this engine has NO internal locks and
@@ -279,6 +287,7 @@ class _GuardedByClass:
         self.guarded: dict[str, str] = {}   # attr -> lock
         self.methods: dict[str, _MethodInfo] = {}
         self._collect()
+        self._ctor_only = self._construction_only()
 
     def _collect(self) -> None:
         for item in self.cls.body:
@@ -307,8 +316,48 @@ class _GuardedByClass:
                     if g:
                         self.guarded[attr] = g
 
+    def _construction_only(self) -> set[str]:
+        """Private helpers reachable ONLY from ``__init__`` (directly or
+        through other construction-only helpers) and never referenced as
+        a bound value (a callback could run on any thread). Construction
+        is single-threaded — no other thread holds the half-built
+        instance — so their guarded-attribute binds need no lock."""
+        callers: dict[str, set[str]] = {}
+        escaped: set[str] = set()
+        for name, info in self.methods.items():
+            call_funcs: set[int] = set()
+            for node in ast.walk(info.node):
+                if isinstance(node, ast.Call):
+                    call_funcs.add(id(node.func))
+                    attr = _self_attr(node.func)
+                    if attr in self.methods:
+                        callers.setdefault(attr, set()).add(name)
+            for node in ast.walk(info.node):
+                attr = _self_attr(node)
+                if attr in self.methods and id(node) not in call_funcs:
+                    escaped.add(attr)
+        ctor: set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for name, info in self.methods.items():
+                # An async def "called" in __init__ only CREATES the
+                # coroutine (create_task(self._loop())) — the body runs
+                # concurrently later, the opposite of construction-only.
+                if (name in ctor or not name.startswith("_")
+                        or name.startswith("__") or name in escaped
+                        or isinstance(info.node, ast.AsyncFunctionDef)):
+                    continue
+                calls = callers.get(name)
+                if calls and all(c == "__init__" or c in ctor
+                                 for c in calls):
+                    ctor.add(name)
+                    changed = True
+        return ctor
+
     def _method_holds(self, name: str, lock: str) -> bool:
-        if name == "__init__" or name.endswith("_locked"):
+        if (name == "__init__" or name.endswith("_locked")
+                or name in self._ctor_only):
             return True
         info = self.methods.get(name)
         return info is not None and lock in info.holds

@@ -2759,6 +2759,7 @@ class _QueueRuntime:
         self._publish_body(reply_to, correlation_id, encode_response(resp),
                            trace=trace)
 
+    # protocol-effect: response_publish requires-fence may_publish
     def _publish_body(self, reply_to: str, correlation_id: str,
                       body: bytes, trace=None) -> None:
         """THE response-publish seam (every respond helper funnels here).
@@ -2786,6 +2787,7 @@ class _QueueRuntime:
         self.app.broker.publish(reply_to, body,
                                 Properties(correlation_id=correlation_id))
 
+    # protocol-effect: response_publish requires-fence may_publish
     def _publish_batch(self, rows: "list[tuple[str, str, bytes, Any]]") -> None:
         """Window-granular twin of ``_publish_body`` (ISSUE 9): one broker
         ``publish_batch`` call for a whole window of responses (rows:
@@ -3001,18 +3003,22 @@ class _QueueRuntime:
                           trace=trace)
 
     def _respond_error(self, delivery: Delivery, code: str, reason: str) -> None:
+        # Routed through the _publish_body funnel so error responses obey
+        # the same epoch fence and write-ahead commit as every other
+        # publish — a fenced ex-primary must not answer AT ALL, not even
+        # with errors (the protocol rule's undeclared-effect sweep pins
+        # this: no direct broker.publish outside the annotated funnels).
         if not delivery.properties.reply_to:
             return
         tr = delivery.trace
-        self.app.broker.publish(
+        self._publish_body(
             delivery.properties.reply_to,
+            delivery.properties.correlation_id,
             encode_response(SearchResponse(
                 status="error", player_id="", error_code=code,
                 error_reason=reason,
                 trace_id=tr.trace_id if tr is not None else "",
-            )),
-            Properties(correlation_id=delivery.properties.correlation_id),
-        )
+            )))
 
     # ---- periodic rescan (threshold widening between pool members) --------
 

@@ -92,6 +92,7 @@ def baseline_payload(rows: "list[list[Any]]",
         separators=(",", ":")).encode("utf-8")
 
 
+# protocol-monotone: epoch
 class _Lease:
     __slots__ = ("owner", "epoch", "deadline")
 
@@ -218,6 +219,7 @@ class LeaseAuthority:
             return 0 if lease is None else lease.epoch
 
 
+# protocol-monotone: max_delivered, _acked
 class InProcReplicationLink:
     """The pluggable stream transport — in-process now, the DCN seam
     later (a cross-host transport implements the same four methods over
@@ -352,6 +354,7 @@ class InProcReplicationLink:
         return self._acked
 
 
+# protocol-monotone: applied_seq, last_seq
 class StandbyApplier:
     """The warm standby for ONE queue: applies the replication stream
     into a shadow ``RecoveredQueue`` (pool membership + dedup cache +
@@ -383,6 +386,7 @@ class StandbyApplier:
         self._ahead: "dict[int, tuple[int, int, bytes]]" = {}
         self.counters = collections.Counter()
 
+    # protocol-effect: standby_ack bounded-by applied_seq
     def pump(self) -> int:
         """Drain the link, apply in order, ack the new watermark.
         Returns the number of records applied this call."""
@@ -458,6 +462,7 @@ class StandbyApplier:
             sh.clean = False
             sh.admission = json.loads(payload.decode("utf-8"))
         sh.last_seq = max(sh.last_seq, seq)
+        # protocol-rebase: callers admit only the contiguous next seq or a re-basing snapshot
         self.applied_seq = seq
         self.counters["applied"] += 1
 
@@ -478,6 +483,8 @@ class StandbyApplier:
         return new_epoch
 
 
+# protocol-role: primary -> fenced
+# protocol-monotone: sent_seq, acked_seq
 class QueueReplication:
     """Primary-side per-queue replication runtime (lives on
     ``_QueueRuntime.replication``): retains the unacked tail for
@@ -503,8 +510,8 @@ class QueueReplication:
         self.events = events
         self.role = "primary"
         self._unacked: "collections.OrderedDict[int, tuple[int, bytes]]" = (
-            collections.OrderedDict())
-        self._send_t: "dict[int, float]" = {}
+            collections.OrderedDict())  # guarded-by: _lock
+        self._send_t: "dict[int, float]" = {}  # guarded-by: _lock
         self.sent_seq = 0
         self.acked_seq = 0
         self._stalled_pumps = 0
@@ -588,6 +595,7 @@ class QueueReplication:
 
     # ---- pump (ack collection / retransmit / lease renewal) ----------------
 
+    # protocol-effect: lease_renewal requires-check renew
     def pump(self, now: float) -> None:
         """One sender tick (``now`` = time.monotonic() at the call site):
         collect the standby's cumulative ack, retransmit the unacked tail

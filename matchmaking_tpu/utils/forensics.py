@@ -118,6 +118,7 @@ class EventSpine:
     sequence at 1 or the transcript identity pin is meaningless."""
 
     def __init__(self, ring: int = 4096):
+        # guarded-by: _lock
         self._ring: deque[dict[str, Any]] = deque(maxlen=max(16, ring))
         self._seq = itertools.count(1)
         #: Guards seq draw + ring append as one step so ring order IS seq
@@ -224,6 +225,7 @@ class IncidentRecorder:
     def __init__(self, app, cfg):
         self.app = app
         self.cfg = cfg
+        # guarded-by: _lock
         self._ring: deque[dict[str, Any]] = deque(
             maxlen=max(1, cfg.incident_ring))
         self._lock = threading.Lock()
@@ -231,12 +233,13 @@ class IncidentRecorder:
         #: Per-trigger-class monotonic stamp of the last capture (the
         #: rate limiter's memory) and the last few autotune moves per
         #: (queue, knob) for the oscillation detector.
-        self._last_capture: dict[str, float] = {}
+        self._last_capture: dict[str, float] = {}  # guarded-by: _lock
+        # guarded-by: _lock
         self._moves: dict[tuple[str, str], deque[tuple[Any, Any]]] = {}
-        self._capturing = False
-        self.captured = 0
-        self.dropped = 0
-        self.by_class: dict[str, int] = {}
+        self._capturing = False  # guarded-by: _lock
+        self.captured = 0  # guarded-by: _lock
+        self.dropped = 0  # guarded-by: _lock
+        self.by_class: dict[str, int] = {}  # guarded-by: _lock
         if cfg.enabled():
             app.spine.subscribe(self.observe)
 
@@ -266,12 +269,18 @@ class IncidentRecorder:
         if src is None or dst is None:
             return
         key = (ev["queue"], ev["kind"])
-        ring = self._moves.get(key)
-        if ring is None:
-            ring = self._moves[key] = deque(
-                maxlen=max(2, self.cfg.oscillation_window))
-        flip = any(p_src == dst and p_dst == src for p_src, p_dst in ring)
-        ring.append((src, dst))
+        # Observers run on whatever thread emitted the spine event, so
+        # the move rings mutate under the lock — but the oscillation
+        # emission below stays OUTSIDE it: events.append re-enters
+        # observe → _fire, which takes this same (non-reentrant) lock.
+        with self._lock:
+            ring = self._moves.get(key)
+            if ring is None:
+                ring = self._moves[key] = deque(
+                    maxlen=max(2, self.cfg.oscillation_window))
+            flip = any(p_src == dst and p_dst == src
+                       for p_src, p_dst in ring)
+            ring.append((src, dst))
         if flip:
             osc = self.app.events.append(
                 "autotune_oscillation", ev["queue"],
@@ -303,7 +312,8 @@ class IncidentRecorder:
         try:
             self.capture(cls, ev)
         finally:
-            self._capturing = False
+            with self._lock:
+                self._capturing = False
 
     # -- bundle assembly ----------------------------------------------------
 

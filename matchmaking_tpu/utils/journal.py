@@ -258,6 +258,7 @@ def _verify_snapshot(path: str) -> bool:
         return False
 
 
+# protocol-monotone: seq, synced_seq, last_seq
 class PoolJournal:
     """One queue's write-ahead journal. Thread-safe: appends come from the
     event loop (terminal settles) AND from engine-lock-holding worker
@@ -286,28 +287,28 @@ class PoolJournal:
         self.compact_bytes = max(1, compact_bytes)
         self.keep_snapshots = max(1, keep_snapshots)
         self._lock = threading.Lock()
-        self._buf: list[bytes] = []
-        self._closed = False
+        self._buf: list[bytes] = []  # guarded-by: _lock
+        self._closed = False  # guarded-by: _lock
         #: Bytes written out (os.write) but not yet fsynced — what a
         #: policy commit still owes durability for.
-        self._unsynced = False
-        self._last_fsync = time.monotonic()
+        self._unsynced = False  # guarded-by: _lock
+        self._last_fsync = time.monotonic()  # guarded-by: _lock
         #: Monotone record sequence (recovery replay order; the matchlint
         #: determinism rule guards this against wall-clock arithmetic).
-        self.seq = 0
+        self.seq = 0  # guarded-by: _lock
         #: Highest seq covered by an fsync — the durability watermark
         #: (seq - synced_seq = records a HOST loss could still drop;
         #: surfaced per queue in the /metrics durability report).
-        self.synced_seq = 0
+        self.synced_seq = 0  # guarded-by: _lock
         #: Live-segment accounting (compaction trigger).
-        self.segment_records = 0
-        self.segment_bytes = 0
+        self.segment_records = 0  # guarded-by: _lock
+        self.segment_bytes = 0  # guarded-by: _lock
         #: Lifetime write-amplification accounting: file bytes written vs
         #: logical payload bytes journaled (bench.py --crash-soak reports
         #: the ratio).
-        self.bytes_written = 0
-        self.payload_bytes = 0
-        self._fd: int | None = None
+        self.bytes_written = 0  # guarded-by: _lock
+        self.payload_bytes = 0  # guarded-by: _lock
+        self._fd: int | None = None  # guarded-by: _lock
         #: Replication stream tap (ISSUE 17, service/replication.py; None
         #: = replication off): called as ``tap(seq, rtype, payload)``
         #: inside the append lock for EVERY sealed record — appends AND
@@ -461,6 +462,7 @@ class PoolJournal:
         self.segment_bytes = len(frame)
         self.bytes_written += len(frame)
 
+    # protocol-effect: journal_append requires-fence fence
     def _append(self, rtype: int, payload: bytes, logical: int,
                 writeout: bool = False) -> int:
         """THE append seam (the sanitizer's journal twin patches exactly
@@ -529,6 +531,7 @@ class PoolJournal:
     def dirty(self) -> bool:
         return bool(self._buf)
 
+    # holds-lock: _lock
     def _writeout_locked(self) -> None:
         """Drain the frame buffer in one os.write (caller holds _lock)."""
         if not self._buf or self._fd is None:
@@ -604,6 +607,7 @@ class PoolJournal:
             return self.seq, snapshot_path(self.directory, self.queue,
                                            self.seq)
 
+    # protocol-effect: journal_append requires-fence fence
     def compact_finish(self, anchor_seq: int, snap_path: str,
                        carry_terminals: list[tuple[str, bytes, float]] = (),
                        admission: dict[str, Any] | None = None) -> None:

@@ -37,7 +37,11 @@ Compared metrics (direction-aware):
                        rows (ISSUE 17): failover_lost, failover_dup,
                        failover_lost_over_bound, failover_rto_ms(_mean),
                        replication_lag_ms_p99 (lost/dup/over-bound under
-                       the zero-baseline rule)
+                       the zero-baseline rule), and the model-checker
+                       rows (ISSUE 19): modelcheck_violations (zero
+                       baseline — any counterexample regresses) with
+                       modelcheck_states_explored higher-is-better
+                       (coverage at the committed scope)
 Frontier rows (``e2e_frontier``, ISSUE 8; the speculation-axis twin
 ``e2e_frontier_spec``, ISSUE 16) are matched by threshold.
 Scenario-matrix cells (``scenario_matrix``, ISSUE 13) are matched by
@@ -121,6 +125,17 @@ TOP_LEVEL_METRICS: dict[str, bool] = {
     "spec_turnaround_ms_p99": False,
     "spec_hit_rate": True,
     "spec_wasted_step_fraction": False,
+    # Small-scope model checker (ISSUE 19, bench.py --modelcheck):
+    # states_explored is coverage — a same-scope run that visits fewer
+    # unique states means the world's digest collapsed or an action was
+    # lost, both silent coverage regressions. violations has a zero
+    # baseline on the real protocol, so ANY nonzero fresh value beyond
+    # the threshold regresses (the base==0 rule) — a violation count of
+    # 1 is a minimized counterexample, not a flaky latency. A run
+    # without the phase leaves the keys absent and they are skipped
+    # per-metric.
+    "modelcheck_states_explored": True,
+    "modelcheck_violations": False,
 }
 
 #: Pool-scale sweep rows (ISSUE 14, ``bench.py --pool-scale``), matched

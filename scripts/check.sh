@@ -157,6 +157,56 @@ assert out["failover_rto_ms"] is not None and \
 print("failover-soak smoke: OK")
 EOF
 
+echo "== protocol =="
+# ISSUE 19 gate: protocol conformance. The suite runs by marker first —
+# the matchlint `protocol` rule's fixture positives/negatives (fence
+# dominance incl. exception edges, watermark monotonicity, the role
+# state machine, bounded-by/requires-check effects, the cross-file RT_*
+# vocabulary) and the small-scope model checker's own regressions
+# (explorer exhaustiveness + POR state-space preservation on a toy
+# world, clean protocol scopes, the stale-epoch-resume replay, every
+# seeded mutant's minimized digest-replayable counterexample).
+JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'protocol and not slow' \
+    --continue-on-collection-errors -p no:cacheprovider
+# Then the committed-scope smoke through the REAL bench.py --modelcheck
+# path: 2 queues x depth 6 x {expire,crash,drop,dup} x fault budget 2
+# must be EXHAUSTIVE with zero violations (~22 s on /dev/shm, ~30k
+# unique states), and the seeded-mutant gate must catch all four
+# protocol mutants with replay-verified counterexamples while the
+# unmutated baseline stays clean. Pure host-side (no jax backend).
+python - <<'EOF'
+import json, subprocess, sys
+proc = subprocess.run(
+    [sys.executable, "bench.py", "--modelcheck"],
+    capture_output=True, text=True, timeout=300)
+sys.stderr.write(proc.stderr)
+if proc.returncode != 0:
+    sys.exit(f"modelcheck smoke exited {proc.returncode}")
+out = json.loads(proc.stdout.splitlines()[-1])
+print("modelcheck smoke:", json.dumps(
+    {k: out[k] for k in ("modelcheck_states_explored", "modelcheck_nodes",
+                         "modelcheck_exhaustive", "modelcheck_violations",
+                         "modelcheck_elapsed_s")}))
+assert out["modelcheck_violations"] == 0, \
+    f"protocol violation: {out['modelcheck_violation']}\n" \
+    + "\n".join(out["modelcheck_timeline"])
+assert out["modelcheck_exhaustive"], "scope not exhausted (cap hit)"
+proc = subprocess.run(
+    [sys.executable, "bench.py", "--modelcheck-mutations"],
+    capture_output=True, text=True, timeout=300)
+sys.stderr.write(proc.stderr)
+if proc.returncode != 0:
+    sys.exit(f"mutation gate exited {proc.returncode}")
+gate = json.loads(proc.stdout.splitlines()[-1])
+for name, rec in sorted(gate["mutation_gate_mutants"].items()):
+    print(f"mutant {name}: caught={rec['caught']} "
+          f"replay_ok={rec['replay_ok']} steps={rec['steps']} "
+          f"digest={rec['digest']}")
+assert gate["mutation_gate_passed"], \
+    f"mutation gate failed: {json.dumps(gate, indent=2)}"
+print("modelcheck smoke: OK")
+EOF
+
 echo "== forensics =="
 # ISSUE 18 gate: incident forensics. The suite runs by marker first —
 # the causal spine's monotone seq under concurrent worker threads, the

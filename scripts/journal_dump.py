@@ -39,11 +39,15 @@ if __package__ is None and "matchmaking_tpu" not in sys.modules:
     sys.path.insert(
         0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from matchmaking_tpu.service.replication import (  # noqa: E402
+    RT_REPL_SNAPSHOT)
 from matchmaking_tpu.utils.journal import (  # noqa: E402
     RT_ADMISSION, RT_ADMIT, RT_CLEAN, RT_SEGMENT, RT_TERMINAL, RT_TERMINALS,
     _verify_snapshot, journal_path, list_snapshots, read_segment)
 
 #: Record-type names for reports (RT_SEGMENT appears only as the header).
+#: Must cover every RT_* constant in the tree — the ``protocol`` rule's
+#: vocabulary check enforces it.
 RT_NAMES = {
     RT_SEGMENT: "segment",
     RT_ADMIT: "admit",
@@ -51,6 +55,7 @@ RT_NAMES = {
     RT_ADMISSION: "admission",
     RT_CLEAN: "clean",
     RT_TERMINALS: "terminals",
+    RT_REPL_SNAPSHOT: "repl_snapshot",
 }
 
 

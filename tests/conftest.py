@@ -105,6 +105,13 @@ def pytest_configure(config):
         "journal inspector — scripts/check.sh runs it by marker plus a "
         "2-cycle failover-soak smoke; part of tier-1)")
     config.addinivalue_line(
+        "markers", "protocol: protocol-conformance suite (ISSUE 19: the "
+        "matchlint protocol rule's fixture positives/negatives plus the "
+        "small-scope interleaving model checker — clean exhaustive runs "
+        "on the real lease/replication/journal objects and the seeded "
+        "mutation gate — scripts/check.sh runs it by marker plus the "
+        "committed-scope modelcheck smoke; the fast scopes are tier-1)")
+    config.addinivalue_line(
         "markers", "forensics: incident-forensics suite (ISSUE 18: the "
         "causal event spine's monotone seq under threads, black-box "
         "trigger/rate-limit/reentrancy capture, bundle schema "

@@ -1376,3 +1376,370 @@ class Runtime:
             self.engine.spec_commit(tok, now)  # runs on its own schedule
 ''', path="matchmaking_tpu/service/fixture.py")
     assert [f for f in findings if f.rule == "speculation"], findings
+
+
+# ---- protocol: fence dominance ---------------------------------------------
+
+@pytest.mark.protocol
+def test_protocol_fence_flags_unchecked_append():
+    findings = analyze_source('''
+class Journal:
+    # protocol-effect: journal_append requires-fence fence
+    def _append(self, payload):
+        self.seq += 1
+        return self.seq
+''', path="matchmaking_tpu/utils/fixture.py")
+    assert _rules(findings) == ["protocol"]
+    assert "not fence-dominated" in findings[0].message
+    assert findings[0].line == 5
+
+
+@pytest.mark.protocol
+def test_protocol_fence_accepts_checked_append():
+    findings = analyze_source('''
+class Journal:
+    # protocol-effect: journal_append requires-fence fence
+    def _append(self, payload):
+        if self.fence is not None and not self.fence():
+            raise RuntimeError("fenced")
+        self.seq += 1
+        return self.seq
+''', path="matchmaking_tpu/utils/fixture.py")
+    assert findings == []
+
+
+@pytest.mark.protocol
+def test_protocol_fence_catches_exception_path_leak():
+    """A handler entered from BEFORE the fence check reaches the append
+    with the pre-check state — the classic try/except bypass."""
+    findings = analyze_source('''
+class Journal:
+    # protocol-effect: journal_append requires-fence fence
+    def _append(self, payload):
+        try:
+            frame = self.encode(payload)
+            if not self.fence():
+                raise RuntimeError("fenced")
+            self.seq += 1
+        except ValueError:
+            self.seq += 1
+        return self.seq
+''', path="matchmaking_tpu/utils/fixture.py")
+    assert _rules(findings) == ["protocol"]
+    assert findings[0].line == 11
+
+
+# ---- protocol: bounded-by / requires-check ---------------------------------
+
+@pytest.mark.protocol
+def test_protocol_bounded_by_flags_foreign_watermark():
+    findings = analyze_source('''
+class Applier:
+    # protocol-effect: standby_ack bounded-by applied_seq
+    def pump(self):
+        for rec in self.link.recv():
+            self.apply(rec)
+        self.link.ack(self.link.max_delivered)
+''', path="matchmaking_tpu/service/fixture.py")
+    assert _rules(findings) == ["protocol"]
+    assert "not bounded by 'applied_seq'" in findings[0].message
+    assert "max_delivered" in findings[0].message
+
+
+@pytest.mark.protocol
+def test_protocol_bounded_by_accepts_declared_watermark():
+    findings = analyze_source('''
+class Applier:
+    # protocol-effect: standby_ack bounded-by applied_seq
+    def pump(self):
+        for rec in self.link.recv():
+            self.apply(rec)
+        self.link.ack(self.applied_seq)
+''', path="matchmaking_tpu/service/fixture.py")
+    assert findings == []
+
+
+@pytest.mark.protocol
+def test_protocol_requires_check_flags_discarded_renewal():
+    findings = analyze_source('''
+class Repl:
+    # protocol-effect: lease_renewal requires-check renew
+    def pump(self, now):
+        self.authority.renew(self.queue, self.owner, self.epoch, now)
+''', path="matchmaking_tpu/service/fixture.py")
+    assert _rules(findings) == ["protocol"]
+    assert "result discarded" in findings[0].message
+
+
+@pytest.mark.protocol
+def test_protocol_requires_check_accepts_tested_renewal():
+    findings = analyze_source('''
+class Repl:
+    # protocol-effect: lease_renewal requires-check renew
+    def pump(self, now):
+        if not self.authority.renew(self.queue, self.owner, self.epoch,
+                                    now):
+            self.refuse()
+''', path="matchmaking_tpu/service/fixture.py")
+    assert findings == []
+
+
+# ---- protocol: role state machine ------------------------------------------
+
+@pytest.mark.protocol
+def test_protocol_role_machine_flags_every_illegal_shape():
+    findings = analyze_source('''
+# protocol-role: primary -> fenced
+class Repl:
+    def __init__(self):
+        self.role = "fenced"
+
+    def fence(self):
+        self.role = self.compute()
+
+    def resume(self):
+        self.role = "primary"
+
+    def zombie(self):
+        self.role = "zombie"
+''', path="matchmaking_tpu/service/fixture.py")
+    assert _rules(findings) == ["protocol"] * 4
+    msgs = "\n".join(f.message for f in findings)
+    assert "must bind the start state 'primary'" in msgs
+    assert "literal state name" in msgs
+    assert "role regression" in msgs
+    assert "undeclared role state 'zombie'" in msgs
+
+
+@pytest.mark.protocol
+def test_protocol_role_machine_accepts_forward_transitions():
+    findings = analyze_source('''
+# protocol-role: primary -> fenced
+class Repl:
+    def __init__(self):
+        self.role = "primary"
+
+    def fence(self):
+        self.role = "fenced"
+''', path="matchmaking_tpu/service/fixture.py")
+    assert findings == []
+
+
+# ---- protocol: monotone watermarks -----------------------------------------
+
+@pytest.mark.protocol
+def test_protocol_monotone_flags_rewind_scale_and_unguarded():
+    findings = analyze_source('''
+# protocol-monotone: seq, acked_seq
+class Journal:
+    def __init__(self):
+        self.seq = 0
+        self.acked_seq = 0
+
+    def rewind(self):
+        self.seq = self.seq - 1
+
+    def double(self):
+        self.seq *= 2
+
+    def unguarded(self, a):
+        self.acked_seq = a
+''', path="matchmaking_tpu/utils/fixture.py")
+    assert _rules(findings) == ["protocol"] * 3
+    msgs = "\n".join(f.message for f in findings)
+    assert "rewound from its own value" in msgs
+    assert "mutated with Mult" in msgs
+    assert "non-monotone rebind of watermark 'acked_seq'" in msgs
+
+
+@pytest.mark.protocol
+def test_protocol_monotone_accepts_guarded_flag_and_max_advances():
+    findings = analyze_source('''
+# protocol-monotone: acked_seq, sent_seq, synced_seq
+class Repl:
+    def __init__(self):
+        self.acked_seq = 0
+        self.sent_seq = 0
+        self.synced_seq = 0
+
+    def guarded(self, a):
+        if a > self.acked_seq:
+            self.acked_seq = a
+
+    def flagged(self, a):
+        progress = a > self.acked_seq
+        if progress:
+            self.acked_seq = a
+
+    def maxed(self, written):
+        self.synced_seq = max(self.synced_seq, written)
+
+    def bump(self):
+        self.sent_seq += 1
+''', path="matchmaking_tpu/service/fixture.py")
+    assert findings == []
+
+
+@pytest.mark.protocol
+def test_protocol_rebase_annotation_admits_the_apply_seam():
+    findings = analyze_source('''
+# protocol-monotone: applied_seq
+class Applier:
+    def __init__(self):
+        self.applied_seq = 0
+
+    def _apply(self, seq, rec):
+        # protocol-rebase: callers admit only the contiguous next seq
+        self.applied_seq = seq
+''', path="matchmaking_tpu/service/fixture.py")
+    assert findings == []
+
+
+@pytest.mark.protocol
+def test_protocol_rebase_without_covered_store_reads_stale():
+    findings = analyze_source('''
+# protocol-monotone: applied_seq
+class Applier:
+    def __init__(self):
+        self.applied_seq = 0
+
+    def peek(self, seq):
+        # protocol-rebase: nothing on the next line stores a watermark
+        return seq
+''', path="matchmaking_tpu/service/fixture.py")
+    assert _rules(findings) == ["protocol"]
+    assert "stale protocol-rebase" in findings[0].message
+
+
+# ---- protocol: annotation hygiene ------------------------------------------
+
+@pytest.mark.protocol
+def test_protocol_annotation_hygiene_parse_unknown_and_stale():
+    findings = analyze_source('''
+# protocol-role: primary
+class A:
+    pass
+
+
+# protocol-lease: primary -> fenced
+class B:
+    # protocol-effect: journal_append requires-fence fence
+    def helper(self):
+        return 1
+''', path="matchmaking_tpu/service/fixture.py")
+    assert _rules(findings) == ["protocol"] * 3
+    msgs = "\n".join(f.message for f in findings)
+    assert "wants 'state -> state" in msgs
+    assert "unknown protocol annotation 'protocol-lease:'" in msgs
+    assert "stale protocol-effect" in msgs
+
+
+@pytest.mark.protocol
+def test_protocol_undeclared_effect_sweep_pins_sibling_methods():
+    """A class that declares response_publish on the funnel cannot grow
+    a second publish path without its own annotation (the _respond_error
+    shape this PR routed through the funnel)."""
+    findings = analyze_source('''
+class App:
+    # protocol-effect: response_publish requires-fence may_publish
+    def _publish_body(self, body):
+        if self.may_publish():
+            self.broker.publish(body)
+
+    def _respond_error(self, body):
+        self.broker.publish(body)
+''', path="matchmaking_tpu/service/fixture.py")
+    assert _rules(findings) == ["protocol"]
+    assert "undeclared protocol effect" in findings[0].message
+    assert "_respond_error" in findings[0].message
+
+
+# ---- protocol: record-type vocabulary --------------------------------------
+
+@pytest.mark.protocol
+def test_protocol_vocab_collision_flags_both_definers():
+    findings = analyze_source('''
+RT_ADMIT = 1
+RT_TERMINAL = 1
+RT_NAMES = {RT_ADMIT: "admit", RT_TERMINAL: "terminal"}
+''', path="matchmaking_tpu/utils/fixture.py")
+    assert _rules(findings) == ["protocol"] * 2
+    assert all("share value 1" in f.message for f in findings)
+
+
+@pytest.mark.protocol
+def test_protocol_vocab_rt_names_must_cover_every_type():
+    findings = analyze_source('''
+RT_ADMIT = 1
+RT_CLEAN = 4
+RT_NAMES = {RT_ADMIT: "admit"}
+''', path="scripts/fixture_dump.py")
+    assert _rules(findings) == ["protocol"]
+    assert "RT_NAMES misses record type(s) RT_CLEAN" in findings[0].message
+
+
+@pytest.mark.protocol
+def test_protocol_vocab_applier_must_reference_every_streamed_type():
+    findings = analyze_source('''
+RT_ADMIT = 1
+RT_CLEAN = 4
+
+
+class StreamApplier:
+    def _apply(self, seq, rtype, payload):
+        if rtype == RT_ADMIT:
+            self.admit(payload)
+''', path="matchmaking_tpu/service/fixture.py")
+    assert _rules(findings) == ["protocol"]
+    assert "never references record type(s) RT_CLEAN" in findings[0].message
+
+
+@pytest.mark.protocol
+def test_protocol_vocab_flags_hardcoded_schema_version():
+    findings = analyze_source('''
+FORMAT_VERSION = 1
+
+
+def header():
+    return {"version": 1}
+''', path="matchmaking_tpu/utils/fixture.py")
+    assert _rules(findings) == ["protocol"]
+    assert "schema version hardcoded" in findings[0].message
+
+
+@pytest.mark.protocol
+def test_protocol_vocab_accepts_constant_reference():
+    findings = analyze_source('''
+FORMAT_VERSION = 1
+
+
+def header():
+    return {"version": FORMAT_VERSION}
+''', path="matchmaking_tpu/utils/fixture.py")
+    assert findings == []
+
+
+# ---- protocol: ignore hygiene ----------------------------------------------
+
+@pytest.mark.protocol
+def test_protocol_findings_are_suppressible_and_stale_ignores_flag():
+    live = '''
+class Journal:
+    # protocol-effect: journal_append requires-fence fence
+    def _append(self, payload):
+        self.seq += 1  # matchlint: ignore[protocol] fixture: fence checked by caller
+'''
+    assert analyze_source(live,
+                          path="matchmaking_tpu/utils/fixture.py") == []
+    dead = '''
+class Journal:
+    # protocol-effect: journal_append requires-fence fence
+    def _append(self, payload):
+        if not self.fence():
+            raise RuntimeError("fenced")
+        self.seq += 1  # matchlint: ignore[protocol] fence checked by caller
+'''
+    findings = analyze_source(dead,
+                              path="matchmaking_tpu/utils/fixture.py")
+    assert _rules(findings) == ["stale-ignore"]
+    assert "no longer suppresses" in findings[0].message
