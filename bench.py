@@ -1994,8 +1994,16 @@ def bench_failover_soak(args) -> dict:
     Emits ``failover_lost`` / ``failover_dup`` / ``failover_rto_ms`` /
     ``replication_lag_ms_p99`` (gated by scripts/bench_diff.py, lower is
     better; lost/dup under the zero-baseline rule) plus the lost bound,
-    recovery count, and the two-run transcript identity pin."""
+    recovery count, and the two-run transcript identity pin.
+
+    ``--transport`` (ISSUE 20): ``socket-loopback`` runs THIS script
+    unchanged over real UDS sockets + a remote lease client (nemesis
+    off) — the in-proc ≡ socket equivalence pin: the emitted
+    ``failover_transcript_digest`` must be bit-identical to an inproc
+    run on the same seed. ``socket`` dispatches to the cross-process
+    soak (:func:`bench_failover_soak_proc`)."""
     import asyncio
+    import hashlib
     import shutil
     import tempfile
 
@@ -2012,6 +2020,10 @@ def bench_failover_soak(args) -> dict:
     from matchmaking_tpu.service.broker import Properties
     from matchmaking_tpu.service.replication import ReplicationHub
 
+    transport = getattr(args, "transport", "inproc")
+    if transport == "socket":
+        return bench_failover_soak_proc(args)
+
     q = "failover.soak"
     pairs = int(args.failover_pairs)
     singles = int(args.failover_singles)
@@ -2019,6 +2031,23 @@ def bench_failover_soak(args) -> dict:
     n_cycles = max(1, int(args.failover_cycles))
     lag_cycle = n_cycles - 1  # the last kill lands with replication lag
     lease_s = float(args.failover_lease_s)
+    loopback = transport == "socket-loopback"
+    if loopback:
+        # Real renewals ride the remote client's budgeted validity:
+        # floor the lease so an XLA warm-up stall on the CPU harness
+        # can't lapse it mid-boot. Transcripts are recovered-state
+        # functions — lease duration never enters them, so the
+        # equivalence pin against inproc (default lease) still holds.
+        lease_s = max(lease_s, 2.0)
+
+    def make_hub(chaos):
+        if loopback:
+            from matchmaking_tpu.net.link import SocketReplicationHub
+
+            return SocketReplicationHub(lease_s=lease_s, chaos=chaos,
+                                        seed=int(args.failover_seed))
+        return ReplicationHub(lease_s=lease_s, chaos=chaos,
+                              seed=int(args.failover_seed))
 
     def cfg_for(jdir: str, owner: str) -> Config:
         return Config(
@@ -2081,8 +2110,12 @@ def bench_failover_soak(args) -> dict:
         chaos = ChaosConfig(seed=int(args.failover_seed), queues=(q,),
                             repl_drop_seqs=(1,), repl_dup_seqs=(2,),
                             repl_delay_seqs=((3, 2),))
-        hub = ReplicationHub(lease_s=lease_s, chaos=chaos,
-                             seed=int(args.failover_seed))
+        # The repl_* script above is the IN-PROC link's vocabulary; the
+        # loopback socket link ignores it (its faults are net_*, off
+        # here) — the in-proc faults heal to zero effect by the quiesce
+        # boundaries, which is exactly why the transcripts stay
+        # bit-identical across transports.
+        hub = make_hub(chaos)
         lost = 0
         lost_bound = 0
         over_bound = 0
@@ -2096,6 +2129,12 @@ def bench_failover_soak(args) -> dict:
         owner = "host0"
         try:
             for cycle in range(n_cycles):
+                if hasattr(hub, "cycle_reset"):
+                    # Socket fabric: retire the previous host
+                    # generation's link + standby listener so the fresh
+                    # journal's restarted seqs aren't shadowed by the
+                    # old cumulative ack watermark.
+                    hub.cycle_reset(q)
                 app = MatchmakingApp(
                     cfg_for(f"{base_dir}/host{cycle}", owner),
                     replication_hub=hub)
@@ -2193,6 +2232,8 @@ def bench_failover_soak(args) -> dict:
                 owner = standby.owner
             # Final successor: the last takeover must adopt too, then
             # stop cleanly (CLEAN record + lease release).
+            if hasattr(hub, "cycle_reset"):
+                hub.cycle_reset(q)
             app = MatchmakingApp(
                 cfg_for(f"{base_dir}/host{n_cycles}", owner),
                 replication_hub=hub)
@@ -2214,6 +2255,8 @@ def bench_failover_soak(args) -> dict:
                 transcripts.append(rt.last_recovery["transcript"])
             await app.stop()
         finally:
+            if hasattr(hub, "close"):
+                hub.close()
             if not args.failover_keep_dirs:
                 shutil.rmtree(base_dir, ignore_errors=True)
         dup = sum(1 for ids in match_of.values() if len(ids) > 1)
@@ -2239,7 +2282,14 @@ def bench_failover_soak(args) -> dict:
             for r in runs[1:])
     rtos = [x for r in runs for x in r["rtos"]]
     lags = [x for r in runs for x in r["lag_p99s"]]
+    digest = hashlib.sha256(
+        json.dumps(first["transcripts"], sort_keys=True).encode()
+    ).hexdigest()
     return {
+        "failover_transport": transport,
+        # The equivalence pin: an inproc run and a socket-loopback run
+        # on the same seed must emit the SAME digest (check.sh compares).
+        "failover_transcript_digest": digest,
         "failover_cycles": n_cycles,
         "failover_runs": len(runs),
         "failover_lost": sum(r["lost"] for r in runs),
@@ -2253,6 +2303,335 @@ def bench_failover_soak(args) -> dict:
         "failover_matched_players": first["matched_players"],
         "failover_transcript_identical": identical,
         "replication_lag_ms_p99": (round(max(lags), 3) if lags else None),
+    }
+
+
+def bench_failover_soak_proc(args) -> dict:
+    """CROSS-PROCESS failover soak (ISSUE 20, ``--failover-soak
+    --transport=socket``): the PR 17 invariants gated over real process
+    and socket boundaries. The driver spawns a lease-service subprocess
+    (the part of the deployment that outlives every host) and a chain of
+    host subprocesses (``net/failover_proc.py``); each host attaches as
+    the warm standby of the current primary over a UDS replication
+    stream + remote lease RPCs, then the driver SIGKILLs the primary
+    mid-tenure and the standby takes over after REAL lease expiry.
+
+    Nemesis schedule: cycle 0's primary runs a scripted net fault script
+    (drop + dup + delay + one MID-STREAM CONNECTION RESET on the fwd
+    flow — the link must reconnect and converge by retransmission); the
+    middle cycles are clean (any ``liveness_lost`` there is a heartbeat
+    FALSE POSITIVE — zero-gated); the LAST cycle arms an ASYMMETRIC
+    partition before the kill (the primary keeps streaming but goes deaf
+    to acks and lease responses), so the driver can prove the primary
+    SELF-FENCES within the lease budget — both seams probed refused —
+    while the standby still catches up on the working direction.
+
+    Gates (all emitted, zero-baseline in scripts/bench_diff.py): zero
+    double matches across the merged per-host reply logs, losses <= the
+    unacked-tail bound at each kill, fenced probes refused at both
+    seams, >= 1 link reconnect after the scripted reset, zero heartbeat
+    false positives in clean tenures, and two seeded runs bit-identical
+    by takeover-transcript digest."""
+    import hashlib
+    import os
+    import queue as queue_mod
+    import shutil
+    import subprocess
+    import tempfile
+    import threading
+
+    q = "failover.soak"
+    pairs = int(args.failover_pairs)
+    singles = int(args.failover_singles)
+    late_singles = int(args.failover_late_singles)
+    n_cycles = max(2, int(args.failover_cycles))
+    seed = int(args.failover_seed)
+    rate = float(args.failover_rate)
+    # Real clocks across processes: floor the lease above the worst XLA
+    # warm-up stall so a compiling primary can't lapse it spuriously.
+    lease_s = max(float(args.failover_lease_s), 2.0)
+
+    def cycle_load(cycle: int) -> "list[list[Any]]":
+        rows: "list[list[Any]]" = []
+        for i in range(pairs):
+            base = 1000.0 + i * 200.0
+            rows.append([f"f{cycle}p{2 * i}", base])
+            rows.append([f"f{cycle}p{2 * i + 1}", base + 1.0])
+        for i in range(singles):
+            rows.append([f"f{cycle}s{i}", 50_000.0 + cycle * 10_000.0
+                         + i * 1_000.0])
+        rng = np.random.default_rng(seed + cycle)
+        rng.shuffle(rows)
+        return rows
+
+    class Child:
+        """One subprocess + its JSON-line protocol (stdin commands,
+        stdout events; a reader thread feeds a local queue)."""
+
+        def __init__(self, name: str, argv: "list[str]"):
+            self.name = name
+            self.proc = subprocess.Popen(
+                [sys.executable, "-m", "matchmaking_tpu.net.failover_proc",
+                 *argv],
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True,
+                env={**os.environ, "JAX_PLATFORMS": "cpu"})
+            self.events: "queue_mod.Queue" = queue_mod.Queue()
+            self._rid = 0
+            threading.Thread(target=self._read, daemon=True).start()
+
+        def _read(self) -> None:
+            assert self.proc.stdout is not None
+            for line in self.proc.stdout:
+                line = line.strip()
+                if line:
+                    try:
+                        self.events.put(json.loads(line))
+                    except ValueError:
+                        pass
+            self.events.put(None)
+
+        def _next(self, deadline: float) -> "dict | None":
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(f"{self.name}: protocol timeout")
+            try:
+                return self.events.get(timeout=remaining)
+            except queue_mod.Empty:
+                raise TimeoutError(f"{self.name}: protocol timeout")
+
+        def wait_ev(self, ev: str, timeout: float = 120.0) -> dict:
+            deadline = time.monotonic() + timeout
+            while True:
+                got = self._next(deadline)
+                if got is None:
+                    raise RuntimeError(
+                        f"{self.name}: exited before {ev!r}")
+                if got.get("ev") == ev:
+                    return got
+
+        def rpc(self, cmd: str, timeout: float = 120.0, **kw) -> dict:
+            self._rid += 1
+            assert self.proc.stdin is not None
+            self.proc.stdin.write(
+                json.dumps({"cmd": cmd, "id": self._rid, **kw}) + "\n")
+            self.proc.stdin.flush()
+            deadline = time.monotonic() + timeout
+            while True:
+                got = self._next(deadline)
+                if got is None:
+                    raise RuntimeError(f"{self.name}: died during {cmd!r}")
+                if got.get("id") != self._rid:
+                    continue
+                if got.get("ev") == "error":
+                    raise RuntimeError(
+                        f"{self.name}: {cmd} failed: {got.get('error')}")
+                return got
+
+        def kill(self) -> None:
+            self.proc.kill()  # SIGKILL — the crash under test
+
+        def reap(self) -> None:
+            try:
+                self.proc.kill()
+            except OSError:
+                pass
+            try:
+                self.proc.wait(timeout=10)
+            except Exception:
+                pass
+
+    def merge_match_of(into: "dict[str, set]", rep: dict) -> None:
+        for pid, mids in (rep.get("match_of") or {}).items():
+            into.setdefault(pid, set()).update(mids)
+
+    def one_run(run_idx: int) -> dict:
+        base = tempfile.mkdtemp(prefix=f"mm_netfo_r{run_idx}_")
+        lease_addr = f"unix:{base}/lease.sock"
+        fwd = f"repl:{q}:fwd"
+        # Cycle 0's nemesis script: first-tx drop/dup/delay on early
+        # record seqs plus a MID-STREAM reset — heals by reconnect +
+        # retransmission, gated by link_reconnects >= 1 and zero loss.
+        c0_chaos = json.dumps({
+            "seed": seed, "queues": [q],
+            "net_drop_frames": [[fwd, 2]], "net_dup_frames": [[fwd, 3]],
+            "net_delay_frames": [[fwd, 4, 2]],
+            "net_reset_frames": [[fwd, 6]]})
+
+        def spawn_host(idx: int, chaos: "str | None" = None) -> Child:
+            argv = ["host", "--name", f"host{idx}", "--queue", q,
+                    "--lease-addr", lease_addr, "--lease-s", str(lease_s),
+                    "--seed", str(seed)]
+            if chaos:
+                argv += ["--chaos", chaos]
+            c = Child(f"host{idx}", argv)
+            c.wait_ev("ready", timeout=180.0)
+            return c
+
+        children: "list[Child]" = []
+        lease = Child("lease", ["lease", "--lease-addr", lease_addr,
+                                "--lease-s", str(lease_s)])
+        res = {"lost": 0, "lost_bound": 0, "over_bound": 0,
+               "reconnects": 0, "hb_false_positives": 0,
+               "fenced_probe_failures": 0, "rtos": [], "transcripts": []}
+        match_of: "dict[str, set]" = {}
+        try:
+            lease.wait_ev("ready", timeout=180.0)
+            primary = spawn_host(0, chaos=c0_chaos)
+            children.append(primary)
+            standby = spawn_host(1)
+            children.append(standby)
+            standby.rpc("standby", listen=f"unix:{base}/repl1.sock")
+            primary.rpc("serve", target=f"unix:{base}/repl1.sock",
+                        jdir=f"{base}/host0", timeout=300.0)
+            prev_rows: "list[list[Any]]" = []
+            for cycle in range(n_cycles):
+                if prev_rows:
+                    # At-least-once redelivery storm: matched players
+                    # must replay their cached match on the NEW host.
+                    primary.rpc("publish", rows=prev_rows, rate=rate)
+                rows = cycle_load(cycle)
+                primary.rpc("publish", rows=rows, rate=rate)
+                qq = primary.rpc("quiesce", matched_at_least=2 * pairs,
+                                 replication=True, timeout_s=60.0,
+                                 timeout=90.0)
+                if not qq.get("ok"):
+                    log(f"[netfo r{run_idx} c{cycle}] WARNING: quiesce "
+                        f"timed out")
+                asym = cycle == n_cycles - 1 and late_singles > 0
+                if asym:
+                    # Asymmetric partition: the primary keeps streaming
+                    # but goes DEAF to acks and lease responses.
+                    primary.rpc("deafen", pattern=f"repl:{q}:ack")
+                    primary.rpc("deafen", pattern="lease:")
+                    late_rows = [[f"f{cycle}L{i}", 90_000.0 + i * 1_000.0]
+                                 for i in range(late_singles)]
+                    primary.rpc("publish", rows=late_rows, rate=rate)
+                    primary.rpc("quiesce", matched_at_least=2 * pairs,
+                                replication=False, timeout_s=30.0,
+                                timeout=60.0)
+                    # The fwd direction still works: the standby must
+                    # catch up even while the primary sees no acks.
+                    prep = primary.rpc("report")
+                    deadline = time.monotonic() + 30.0
+                    while True:
+                        srep = standby.rpc("report")
+                        if (srep.get("applied_seq", 0)
+                                >= prep.get("sent_seq", 0)):
+                            break
+                        if time.monotonic() > deadline:
+                            log(f"[netfo r{run_idx}] WARNING: standby "
+                                f"never caught up under asym partition")
+                            break
+                        time.sleep(0.05)
+                    # Fencing-over-RTT: with renewals unconfirmable the
+                    # primary must fence ITSELF within the lease budget
+                    # — both seams probed, refusal required.
+                    probe = primary.rpc("probe",
+                                        timeout_s=4 * lease_s + 10.0,
+                                        timeout=4 * lease_s + 30.0)
+                    if not (probe.get("publish_refused")
+                            and probe.get("append_fenced")
+                            and not probe.get("publish_leaked")):
+                        res["fenced_probe_failures"] += 1
+                        log(f"[netfo r{run_idx}] GATE: fenced probe "
+                            f"leaked: {probe}")
+                rep = primary.rpc("report")
+                kill_bound = int(rep.get("kill_bound", 0))
+                pre_waiting = set(rep.get("waiting", ()))
+                merge_match_of(match_of, rep)
+                link = rep.get("link", {})
+                res["reconnects"] += int(link.get("reconnects", 0))
+                if cycle != 0 and not asym:
+                    # Clean tenure: any liveness_lost is a heartbeat
+                    # FALSE POSITIVE (zero-gated).
+                    srep = standby.rpc("report")
+                    res["hb_false_positives"] += (
+                        int(link.get("liveness_lost", 0))
+                        + int(srep.get("standby_link", {})
+                              .get("liveness_lost", 0)))
+                log(f"[netfo r{run_idx} c{cycle}] matched="
+                    f"{rep.get('matched')} waiting={len(pre_waiting)} "
+                    f"bound={kill_bound} epoch={rep.get('epoch')} "
+                    f"reconnects={link.get('reconnects', 0)}")
+                primary.kill()
+                to = standby.rpc("takeover", timeout_s=4 * lease_s + 30.0,
+                                 timeout=4 * lease_s + 60.0)
+                if cycle < n_cycles - 1:
+                    nxt = spawn_host(cycle + 2)
+                    children.append(nxt)
+                    nxt.rpc("standby",
+                            listen=f"unix:{base}/repl{cycle + 2}.sock")
+                    target = f"unix:{base}/repl{cycle + 2}.sock"
+                else:
+                    # Last successor streams to a dead-end address (no
+                    # listener will ever bind it) and must still stop
+                    # cleanly: a missing standby degrades, never wedges.
+                    target = f"unix:{base}/deadend.sock"
+                sv = standby.rpc("serve", target=target,
+                                 jdir=f"{base}/host{cycle + 1}",
+                                 timeout=300.0)
+                recovered = set(sv.get("recovered", ()))
+                cycle_lost = len(pre_waiting - recovered)
+                res["lost"] += cycle_lost
+                res["lost_bound"] += kill_bound
+                res["over_bound"] += max(0, cycle_lost - kill_bound)
+                if cycle_lost > kill_bound:
+                    log(f"[netfo r{run_idx} c{cycle}] GATE: lost "
+                        f"{cycle_lost} > unacked-tail bound {kill_bound}")
+                if sv.get("rto_ms") is not None:
+                    res["rtos"].append(float(sv["rto_ms"]))
+                if sv.get("transcript") is not None:
+                    res["transcripts"].append(sv["transcript"])
+                log(f"[netfo r{run_idx} c{cycle}] takeover epoch="
+                    f"{to.get('epoch')} lost={cycle_lost} "
+                    f"rto_ms={sv.get('rto_ms')}")
+                primary, standby = standby, None
+                if cycle < n_cycles - 1:
+                    standby = children[-1]
+                prev_rows = rows
+            frep = primary.rpc("report")
+            merge_match_of(match_of, frep)
+            primary.rpc("stop", timeout=120.0)
+        finally:
+            for c in children:
+                c.reap()
+            lease.reap()
+            if not args.failover_keep_dirs:
+                shutil.rmtree(base, ignore_errors=True)
+        res["dup"] = sum(1 for ids in match_of.values() if len(ids) > 1)
+        res["matched_players"] = len(match_of)
+        res["digest"] = hashlib.sha256(
+            json.dumps(res["transcripts"], sort_keys=True).encode()
+        ).hexdigest()
+        return res
+
+    runs = [one_run(i) for i in range(max(1, int(args.failover_runs)))]
+    first = runs[0]
+    identical = None
+    if len(runs) >= 2:
+        identical = all(r["digest"] == first["digest"] for r in runs[1:])
+    rtos = [x for r in runs for x in r["rtos"]]
+    return {
+        "failover_transport": "socket",
+        "socket_failover_cycles": n_cycles,
+        "socket_failover_runs": len(runs),
+        "socket_failover_lost": sum(r["lost"] for r in runs),
+        "socket_failover_lost_bound": sum(r["lost_bound"] for r in runs),
+        "socket_failover_lost_over_bound": sum(
+            r["over_bound"] for r in runs),
+        "socket_failover_dup": sum(r["dup"] for r in runs),
+        "socket_failover_rto_ms": round(max(rtos), 3) if rtos else None,
+        "socket_failover_rto_ms_mean": (round(sum(rtos) / len(rtos), 3)
+                                        if rtos else None),
+        "socket_failover_recoveries": len(rtos),
+        "socket_failover_matched_players": first["matched_players"],
+        "socket_link_reconnects": sum(r["reconnects"] for r in runs),
+        "heartbeat_false_positive_count": sum(
+            r["hb_false_positives"] for r in runs),
+        "socket_fenced_probe_failures": sum(
+            r["fenced_probe_failures"] for r in runs),
+        "socket_failover_transcript_identical": identical,
+        "failover_transcript_digest": first["digest"],
     }
 
 
@@ -3062,6 +3441,19 @@ def main() -> None:
     p.add_argument("--failover-keep-dirs", action="store_true",
                    help="keep the per-host journal directories for "
                         "inspection")
+    p.add_argument("--transport", default="inproc",
+                   choices=("inproc", "socket", "socket-loopback"),
+                   help="--failover-soak replication fabric (ISSUE 20): "
+                        "'inproc' = the PR 17 in-process link; "
+                        "'socket-loopback' = the SAME soak script over "
+                        "real UDS sockets + a remote lease client in one "
+                        "process (nemesis off — the in-proc ≡ socket "
+                        "equivalence pin: transcripts must be "
+                        "bit-identical to inproc on the same seed); "
+                        "'socket' = CROSS-PROCESS soak: lease service + "
+                        "host chain as subprocesses, SIGKILL mid-load "
+                        "under the scripted network nemesis (incl. one "
+                        "asymmetric partition and one mid-stream reset)")
     p.add_argument("--incident-soak", action="store_true",
                    help="incident-forensics soak (ISSUE 18): seeded flash "
                         "crowd + scripted lease-expiry failover + hard "

@@ -130,7 +130,23 @@ _CLOCKLIKE_TOKENS = ("deadline", "next_snapshot", "snapshot_due",
                      # (monotonic, for gap annotation) and wall (display
                      # only) — neither may feed the seq.
                      "spine_seq", "event_seq", "incident_seq", "trigger_seq",
-                     "mono_ns", "capture_due", "next_capture")
+                     "mono_ns", "capture_due", "next_capture",
+                     # Retry/backoff/heartbeat arithmetic (ISSUE 20): the
+                     # socket transport's reconnect schedule, heartbeat
+                     # liveness verdict, and RTT-budgeted lease validity
+                     # decide WHEN a peer is declared dead and WHEN a
+                     # primary must fence — born from time.time() they
+                     # would make failover timing (and the soak's
+                     # bit-identical transcript) a function of wall-clock
+                     # jitter, and unseeded reconnect jitter would make
+                     # two seeded runs dial on different schedules. The
+                     # sanctioned shapes: seeded jitter via
+                     # hash01(seed, "backoff", conn, attempt) and
+                     # caller-passed time.monotonic() values.
+                     # (retry_deadline / heartbeat_deadline are already
+                     # caught by the "deadline" token above.)
+                     "backoff", "next_heartbeat", "rtt_ms", "valid_until",
+                     "retry_at", "next_dial")
 
 
 def _clocklike(text: str) -> bool:

@@ -409,6 +409,41 @@ class ChaosConfig:
     #: primary's lease lapse so a standby can legally take over (the
     #: split-brain fencing regression rides this).
     repl_fail_renewals: tuple[int, ...] = ()
+    # ---- scripted NETWORK faults (ISSUE 20; consumed by net/nemesis.py
+    # ---- at the socket transport's send/recv seams. Every entry names a
+    # ---- FLOW by substring match against the connection's flow id
+    # ---- ("repl:<queue>:fwd", "repl:<queue>:ack", "lease:<owner>") and a
+    # ---- data-frame seq — record seq on replication flows, a per-flow
+    # ---- frame counter elsewhere — so every decision is a pure function
+    # ---- of (seed, connection id, frame seq). Scripted faults fire on a
+    # ---- frame's FIRST transmission only, like the repl_* family:
+    # ---- retransmission by cumulative ack is how the stream heals) ----
+    #: (flow substring, frame seq): first transmission is dropped.
+    net_drop_frames: tuple[tuple[str, int], ...] = ()
+    #: (flow substring, frame seq): first transmission is sent twice.
+    net_dup_frames: tuple[tuple[str, int], ...] = ()
+    #: (flow, seq, hold_n): frame held until ``hold_n`` further first
+    #: transmissions pass, then sent LATE (reordering over the wire).
+    net_delay_frames: tuple[tuple[str, int, int], ...] = ()
+    #: (flow, seq): instead of sending the frame, the sender abruptly
+    #: closes the connection mid-stream (the torn-stream case — resume is
+    #: reconnect + cumulative-ack retransmission).
+    net_reset_frames: tuple[tuple[str, int], ...] = ()
+    #: (flow, pause_seq, resume_seq): sender-side partition window
+    #: [pause, resume) — frames buffer at the sender until any
+    #: transmission reaches the resume seq.
+    net_partitions: tuple[tuple[str, int, int], ...] = ()
+    #: Flows whose INBOUND frames this process drops from the start — the
+    #: scripted ASYMMETRIC partition (a primary that can send but cannot
+    #: hear acks or lease-renewal responses lists its ack + lease flows
+    #: here; heartbeats are dropped too, so the liveness verdict sees it).
+    net_deaf_flows: tuple[str, ...] = ()
+    #: Seeded frame-drop probability, hash-decided per
+    #: (seed, "net", flow, seq) — reproducible like every seeded fault.
+    net_drop_prob: float = 0.0
+    #: (flow, bytes_per_s): sender-side bandwidth cap — frames over the
+    #: budget wait (delivery delay, never corruption).
+    net_bandwidth_caps: tuple[tuple[str, int], ...] = ()
 
     def enabled(self) -> bool:
         return bool(
@@ -433,6 +468,17 @@ class ChaosConfig:
             self.repl_drop_seqs or self.repl_dup_seqs or self.repl_delay_seqs
             or self.repl_partitions or self.repl_drop_prob > 0
             or self.repl_fail_renewals
+        )
+
+    def net_faults(self) -> bool:
+        """Any socket-transport fault configured? (read by net/nemesis.py
+        when building per-flow fault scripts — the broker/engine/repl
+        gates above are untouched)."""
+        return bool(
+            self.net_drop_frames or self.net_dup_frames
+            or self.net_delay_frames or self.net_reset_frames
+            or self.net_partitions or self.net_deaf_flows
+            or self.net_drop_prob > 0 or self.net_bandwidth_caps
         )
 
 
@@ -639,6 +685,68 @@ class ReplicationConfig:
                 f"\"primary\"; the standby is a hub-side StandbyApplier, "
                 f"not an app role)")
         return bool(self.role)
+
+
+@dataclass(frozen=True)
+class NetConfig:
+    """Real-transport DCN seams (ISSUE 20, matchmaking_tpu/net/): the
+    framed socket transport under the replication link and the lease
+    service. ``transport="socket"`` makes the replication fabric run over
+    TCP/UDS — length-prefixed CRC-framed messages, application
+    heartbeats with a deadline-based peer-liveness verdict, seeded
+    exponential-backoff-with-jitter reconnect, and bounded send buffers
+    that surface backpressure (a dropped frame is healed by the
+    cumulative-ack retransmission the in-proc link already relies on).
+
+    Addresses are ``"unix:/path.sock"`` or ``"tcp:host:port"``. The
+    fencing-over-RTT rule lives here too: ``lease_rtt_budget_s`` is
+    subtracted from every lease grant the :class:`~matchmaking_tpu.net.
+    lease.RemoteLeaseAuthority` caches, so a renewal still in flight when
+    the budgeted deadline passes does NOT count — safety over liveness."""
+
+    #: "inproc" (default — the PR 17 in-process fabric, zero sockets) or
+    #: "socket" (the real transport; an app with replication enabled and
+    #: no hub passed builds a SocketReplicationHub from the addrs below).
+    transport: str = "inproc"
+    #: Lease service address (required for transport="socket").
+    lease_addr: str = ""
+    #: Where this primary streams replication records (the standby's
+    #: listen address). One queue per address; "" = stream to nowhere
+    #: (frames drop until a target is set on the hub).
+    repl_target: str = ""
+    #: Dial timeout per connect attempt (seconds).
+    connect_timeout_s: float = 1.0
+    #: Blocking lease-RPC timeout (acquire/takeover/expired/release and
+    #: the expired-validity renew re-confirm).
+    request_timeout_s: float = 1.0
+    #: Application heartbeat cadence per connection.
+    heartbeat_interval_s: float = 0.1
+    #: Peer-liveness deadline: no inbound frame for this long → the peer
+    #: is declared dead (counted; the connection closes and reconnects).
+    heartbeat_timeout_s: float = 0.6
+    #: Reconnect backoff: min(cap, base * 2^attempt) scaled by seeded
+    #: jitter in [0.5, 1.0] — hash01(seed, "backoff", conn, attempt).
+    reconnect_base_s: float = 0.02
+    reconnect_cap_s: float = 1.0
+    #: Hostile-length guard: a frame header announcing more than this is
+    #: a FrameError (connection dies; stream resumes by ack).
+    max_frame_bytes: int = 1 << 20
+    #: Bounded send buffer per link: once this many bytes are queued or
+    #: in the transport buffer, further sends DROP and count
+    #: (backpressure_dropped) instead of buffering unboundedly.
+    send_buffer_bytes: int = 4 << 20
+    #: Subtracted from every cached lease grant: the client treats a
+    #: lease granted at send-time t as valid until t + lease_s - budget,
+    #: under-approximating the authority's own deadline by the RTT the
+    #: request may have spent in flight.
+    lease_rtt_budget_s: float = 0.05
+
+    def enabled(self) -> bool:
+        if self.transport not in ("", "inproc", "socket"):
+            raise ValueError(
+                f"unknown net transport {self.transport!r} "
+                f"(\"inproc\" or \"socket\")")
+        return self.transport == "socket"
 
 
 @dataclass(frozen=True)
@@ -937,6 +1045,9 @@ class Config:
     #: Hot-standby journal replication + fenced failover (off by default
     #: — see ReplicationConfig.enabled(); requires durability).
     replication: ReplicationConfig = field(default_factory=ReplicationConfig)
+    #: Real-transport DCN seams: socket replication link + remote lease
+    #: service (ISSUE 20; "inproc" by default — zero sockets).
+    net: NetConfig = field(default_factory=NetConfig)
     #: Flight recorder / debug endpoints (tracing on by default).
     observability: ObservabilityConfig = field(
         default_factory=ObservabilityConfig)
@@ -982,6 +1093,7 @@ class Config:
             ("overload", OverloadConfig),
             ("durability", DurabilityConfig),
             ("replication", ReplicationConfig),
+            ("net", NetConfig),
             ("observability", ObservabilityConfig),
             ("forensics", ForensicsConfig),
             ("placement", PlacementConfig),

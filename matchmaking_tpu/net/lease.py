@@ -1,0 +1,404 @@
+"""Remote lease service + client (ISSUE 20).
+
+:class:`LeaseService` puts the PR 17 :class:`~matchmaking_tpu.service.
+replication.LeaseAuthority` behind the framed transport — the external
+coordination service a cross-host deployment runs. Every request is
+stamped with the SERVICE's own ``time.monotonic()`` (cross-process
+monotonic clocks are unrelated, so a caller's clock can never extend a
+lease), except in ``trust_caller_now`` mode — the same-process loopback
+fabric — where the caller's monotonic IS the service's clock and the
+scriptable fast-forward the in-proc soak relies on keeps working.
+
+:class:`RemoteLeaseAuthority` implements the exact LeaseAuthority call
+surface over the wire, with the fencing-over-RTT rule the ISSUE pins:
+the client caches each grant as valid until ``t_send + lease_s -
+lease_rtt_budget_s`` — anchored at SEND time, under-approximating the
+authority's own deadline by whatever the request spent in flight. A
+renewal still in flight when that budgeted deadline passes does NOT
+count: ``is_current`` (the journal-append and response-publish fence
+check) turns False at the deadline, and only a fresh CONFIRMED response
+can resume validity — fencing safety over liveness. A primary that
+cannot hear renewal responses (asymmetric partition) therefore fences
+itself within one lease budget, whether or not the authority ever
+expired it.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+from typing import Any
+
+from matchmaking_tpu.net.transport import (
+    MsgConn,
+    MsgServer,
+    ReconnectingConn,
+    io_loop,
+    run_io,
+)
+from matchmaking_tpu.service.replication import LeaseAuthority, LeaseHeldError
+
+__all__ = ["LeaseService", "RemoteLeaseAuthority"]
+
+log = logging.getLogger(__name__)
+
+
+class LeaseService:
+    """The lease/coordination service: one :class:`LeaseAuthority` behind
+    a framed-transport listener. Stateless per connection — any client
+    may send any op; replies route back on the connection that asked."""
+
+    def __init__(self, addr: str, *, lease_s: float = 0.5,
+                 net: Any = None, fail_renewals: "tuple[int, ...]" = (),
+                 trust_caller_now: bool = False):
+        from matchmaking_tpu.config import NetConfig
+
+        self.addr = addr
+        self.net = net or NetConfig(transport="socket")
+        self.lease_s = float(lease_s)
+        self.trust_caller_now = bool(trust_caller_now)
+        self.authority = LeaseAuthority(lease_s,
+                                        fail_renewals=fail_renewals)
+        self.counters: "collections.Counter" = collections.Counter()
+        self._clock = threading.Lock()
+        self._conns: "list[MsgConn]" = []
+        self._server = MsgServer(
+            addr, name=f"lease-svc", on_conn=self._on_conn,
+            conn_kwargs=dict(
+                on_msg=lambda msg: None,
+                counters=self.counters, counters_lock=self._clock,
+                heartbeat_interval_s=self.net.heartbeat_interval_s,
+                heartbeat_timeout_s=self.net.heartbeat_timeout_s,
+                max_frame=self.net.max_frame_bytes,
+                send_buffer_bytes=self.net.send_buffer_bytes))
+
+    def _on_conn(self, conn: MsgConn) -> None:
+        self._conns.append(conn)
+        conn._on_msg = lambda msg: self._handle(conn, msg)
+
+    def _handle(self, conn: MsgConn, msg: "dict[str, Any]") -> None:
+        if msg.get("t") != "lr":
+            return
+        # The service's clock is the lease truth. trust_caller_now is
+        # the same-process loopback mode: caller monotonic == service
+        # monotonic, so the scriptable fast-forward (takeover at
+        # ``now + lease_s + eps`` with no wall-clock sleep) still works.
+        now = time.monotonic()
+        if self.trust_caller_now and "now" in msg:
+            now = max(now, float(msg["now"]))
+        op = str(msg.get("op", ""))
+        q = str(msg.get("q", ""))
+        owner = str(msg.get("owner", ""))
+        epoch = int(msg.get("epoch", 0))
+        auth = self.authority
+        resp: "dict[str, Any]" = {"t": "lr.r", "rid": msg.get("rid"),
+                                  "ok": True, "lease_s": self.lease_s}
+        with self._clock:
+            self.counters[f"op_{op}"] += 1
+        try:
+            if op == "acquire":
+                resp["epoch"] = auth.acquire(q, owner, now)
+            elif op == "renew":
+                resp["ok"] = auth.renew(q, owner, epoch, now)
+                resp["cur_epoch"] = auth.epoch_of(q)
+            elif op == "expired":
+                resp["expired"] = auth.expired(q, now)
+            elif op == "takeover":
+                try:
+                    resp["epoch"] = auth.takeover(
+                        q, owner, now, force=bool(msg.get("force", False)))
+                except LeaseHeldError:
+                    # Idempotent retry: a takeover whose RESPONSE was
+                    # lost leaves the requester holding the lease — a
+                    # same-owner acquire renews in place and returns the
+                    # epoch; a genuinely foreign holder re-raises.
+                    resp["epoch"] = auth.acquire(q, owner, now)
+            elif op == "release":
+                auth.release(q, owner, epoch, now)
+            elif op == "epoch_of":
+                resp["epoch"] = auth.epoch_of(q)
+            else:
+                resp["ok"] = False
+                resp["error"] = f"unknown lease op {op!r}"
+        except LeaseHeldError as e:
+            resp["ok"] = False
+            resp["held"] = True
+            resp["error"] = str(e)
+        except Exception as e:  # defensive: a reply always goes back
+            resp["ok"] = False
+            resp["error"] = f"{type(e).__name__}: {e}"
+        resp["cur_epoch"] = resp.get("cur_epoch", auth.epoch_of(q))
+        conn.send_msg(resp)
+
+    def start(self) -> None:
+        run_io(self._server.start(), timeout=5.0)
+
+    def close(self) -> None:
+        async def _close() -> None:
+            await self._server.close()
+            for c in list(self._conns):
+                await c.close("service closed")
+        try:
+            run_io(_close(), timeout=5.0)
+        except Exception:
+            pass
+
+
+class _QState:
+    __slots__ = ("owner", "epoch", "valid_until", "stale", "cur_epoch")
+
+    def __init__(self, owner: str, epoch: int, valid_until: float):
+        self.owner = owner
+        self.epoch = epoch
+        #: Budgeted validity deadline: t_send + lease_s - rtt_budget of
+        #: the last CONFIRMED grant. Monotone under max().
+        self.valid_until = valid_until
+        #: The authority refuted this (owner, epoch) — permanently.
+        self.stale = False
+        self.cur_epoch = epoch
+
+
+class RemoteLeaseAuthority:
+    """LeaseAuthority call surface over the framed transport.
+
+    Blocking ops (acquire / takeover / expired / release, and the
+    expired-validity renew re-confirm) round-trip with
+    ``request_timeout_s``; :meth:`renew` on a still-valid lease fires a
+    background renewal (at most one in flight per queue) and answers
+    from the cached budgeted deadline; :meth:`is_current` — the fence
+    check called from journal-append worker threads — is purely local:
+    cached (owner, epoch) match AND ``time.monotonic()`` before the
+    budgeted deadline. No response, no validity: safety over liveness.
+    """
+
+    def __init__(self, addr: str, *, net: Any = None, seed: int = 0,
+                 client: str = "client", nemesis: Any = None):
+        from matchmaking_tpu.config import NetConfig
+
+        self.addr = addr
+        self.net = net or NetConfig(transport="socket")
+        self.client = client
+        self.counters: "collections.Counter" = collections.Counter()
+        self._clock = threading.Lock()
+        self._lock = threading.Lock()
+        self._state: "dict[str, _QState]" = {}
+        self._pending: "dict[int, dict[str, Any]]" = {}
+        self._pending_evt: "dict[int, threading.Event]" = {}
+        self._renew_inflight: "dict[str, tuple[int, float]]" = {}
+        self._rid = 0
+        self._lease_s = 0.0  # learned from responses; 0 = unknown yet
+        flow = f"lease:{client}"
+        rx_deaf = nemesis.rx_deaf(flow) if nemesis is not None else None
+        self._conn = ReconnectingConn(
+            addr, name=flow, seed=seed, on_msg=self._on_msg,
+            counters=self.counters, counters_lock=self._clock,
+            connect_timeout_s=self.net.connect_timeout_s,
+            reconnect_base_s=self.net.reconnect_base_s,
+            reconnect_cap_s=self.net.reconnect_cap_s,
+            conn_kwargs=dict(
+                heartbeat_interval_s=self.net.heartbeat_interval_s,
+                heartbeat_timeout_s=self.net.heartbeat_timeout_s,
+                max_frame=self.net.max_frame_bytes,
+                send_buffer_bytes=self.net.send_buffer_bytes,
+                rx_deaf=rx_deaf))
+        self._conn.start()
+
+    # -- wire plumbing --
+
+    def _on_msg(self, msg: "dict[str, Any]") -> None:
+        if msg.get("t") != "lr.r":
+            return
+        rid = msg.get("rid")
+        with self._lock:
+            if rid in self._pending_evt:
+                self._pending[rid] = msg
+                self._pending_evt[rid].set()
+            else:
+                self._fold_async(rid, msg)
+
+    def _next_rid(self) -> int:
+        with self._lock:
+            self._rid += 1
+            return self._rid
+
+    def _grant_s(self, resp: "dict[str, Any]") -> float:
+        lease_s = float(resp.get("lease_s", self._lease_s) or 0.0)
+        if lease_s > 0:
+            self._lease_s = lease_s
+        return max(0.0, lease_s - self.net.lease_rtt_budget_s)
+
+    def _rpc(self, msg: "dict[str, Any]",
+             timeout: "float | None" = None) -> "dict[str, Any] | None":
+        """Blocking request/response. Re-sends on reconnect (ops are
+        idempotent at the service); None on deadline (no response is NOT
+        a grant — the caller must fail safe)."""
+        rid = self._next_rid()
+        msg = dict(msg, t="lr", rid=rid)
+        evt = threading.Event()
+        with self._lock:
+            self._pending_evt[rid] = evt
+        deadline = time.monotonic() + (
+            self.net.request_timeout_s if timeout is None else timeout)
+        loop = io_loop()
+        sent_on: "Any" = None
+        try:
+            while True:
+                c = self._conn.conn
+                if c is not None and c is not sent_on:
+                    loop.call_soon_threadsafe(c.send_msg, msg)
+                    sent_on = c
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    with self._clock:
+                        self.counters["rpc_timeouts"] += 1
+                    return None
+                if evt.wait(min(0.02, remaining)):
+                    with self._lock:
+                        return self._pending.pop(rid, None)
+        finally:
+            with self._lock:
+                self._pending_evt.pop(rid, None)
+                self._pending.pop(rid, None)
+
+    # -- LeaseAuthority surface --
+
+    def acquire(self, queue: str, owner: str, now: float) -> int:
+        resp = self._rpc({"op": "acquire", "q": queue, "owner": owner,
+                          "now": now})
+        if resp is None:
+            raise TimeoutError(
+                f"lease acquire for {queue!r} timed out (no response is "
+                f"not a grant)")
+        if not resp.get("ok"):
+            raise LeaseHeldError(resp.get("error", "lease held"))
+        epoch = int(resp["epoch"])
+        with self._lock:
+            self._state[queue] = _QState(
+                owner, epoch, now + self._grant_s(resp))
+        return epoch
+
+    def renew(self, queue: str, owner: str, epoch: int, now: float) -> bool:
+        with self._lock:
+            st = self._state.get(queue)
+        if (st is None or st.owner != owner or st.epoch != epoch
+                or st.stale):
+            return False
+        if now < st.valid_until:
+            # Still inside the budgeted deadline: answer from the cache
+            # and keep (at most) one background renewal in flight. The
+            # in-flight request contributes NOTHING until its response
+            # lands — if the deadline passes first, is_current goes
+            # False regardless (the renewal-in-flight-at-expiry rule).
+            self._fire_renew(queue, owner, epoch, now)
+            return True
+        # Budgeted deadline passed: only a fresh CONFIRMED response may
+        # resume validity. (Stricter than the in-proc authority, where a
+        # live primary keeps serving on a lapsed-but-untaken lease: a
+        # REMOTE primary cannot see the authority's truth, so lapse
+        # means fence unless the authority answers in time.)
+        resp = self._rpc({"op": "renew", "q": queue, "owner": owner,
+                          "epoch": epoch, "now": now})
+        if resp is None:
+            return False
+        self._note_cur_epoch(st, resp)
+        if not resp.get("ok"):
+            if int(resp.get("cur_epoch", epoch)) != epoch:
+                st.stale = True
+            return False
+        st.valid_until = max(st.valid_until, now + self._grant_s(resp))
+        return True
+
+    def _fire_renew(self, queue: str, owner: str, epoch: int,
+                    now: float) -> None:
+        with self._lock:
+            if queue in self._renew_inflight:
+                return
+            rid = self._rid = self._rid + 1
+            self._renew_inflight[queue] = (rid, now)
+        c = self._conn.conn
+        if c is None:
+            with self._lock:
+                self._renew_inflight.pop(queue, None)
+            return
+        io_loop().call_soon_threadsafe(
+            c.send_msg, {"t": "lr", "rid": rid, "op": "renew", "q": queue,
+                         "owner": owner, "epoch": epoch, "now": now})
+
+    def _fold_async(self, rid: Any, resp: "dict[str, Any]") -> None:
+        """Fold a background renewal's response in (called under _lock).
+        The grant anchors at the renewal's SEND time — the response may
+        have spent any amount of RTT in flight, and the authority's own
+        deadline can only be LATER than t_send + lease_s."""
+        for queue, (r, t_send) in list(self._renew_inflight.items()):
+            if r != rid:
+                continue
+            del self._renew_inflight[queue]
+            st = self._state.get(queue)
+            if st is None:
+                return
+            self._note_cur_epoch(st, resp)
+            if resp.get("ok"):
+                st.valid_until = max(st.valid_until,
+                                     t_send + self._grant_s(resp))
+            elif int(resp.get("cur_epoch", st.epoch)) != st.epoch:
+                st.stale = True
+            return
+
+    def _note_cur_epoch(self, st: _QState, resp: "dict[str, Any]") -> None:
+        try:
+            st.cur_epoch = int(resp.get("cur_epoch", st.cur_epoch))
+        except (TypeError, ValueError):
+            pass
+
+    def expired(self, queue: str, now: float) -> bool:
+        resp = self._rpc({"op": "expired", "q": queue, "now": now})
+        # No response is not proof of expiry: a standby must NOT take
+        # over on a timeout.
+        return bool(resp is not None and resp.get("expired"))
+
+    def takeover(self, queue: str, owner: str, now: float,
+                 force: bool = False) -> int:
+        resp = self._rpc({"op": "takeover", "q": queue, "owner": owner,
+                          "now": now, "force": force})
+        if resp is None:
+            raise TimeoutError(f"lease takeover for {queue!r} timed out")
+        if not resp.get("ok"):
+            raise LeaseHeldError(resp.get("error", "lease held"))
+        epoch = int(resp["epoch"])
+        with self._lock:
+            self._state[queue] = _QState(
+                owner, epoch, now + self._grant_s(resp))
+        return epoch
+
+    def release(self, queue: str, owner: str, epoch: int,
+                now: float) -> None:
+        self._rpc({"op": "release", "q": queue, "owner": owner,
+                   "epoch": epoch, "now": now})
+        with self._lock:
+            st = self._state.get(queue)
+            if st is not None and st.owner == owner and st.epoch == epoch:
+                st.valid_until = now
+
+    def is_current(self, queue: str, owner: str, epoch: int) -> bool:
+        """THE fence check (journal-append + response-publish seams):
+        purely local — cached (owner, epoch) match, not refuted, and the
+        budgeted deadline not passed. A renewal in flight counts for
+        nothing until its response lands."""
+        with self._lock:
+            st = self._state.get(queue)
+            return (st is not None and st.owner == owner
+                    and st.epoch == epoch and not st.stale
+                    and time.monotonic() < st.valid_until)
+
+    def epoch_of(self, queue: str) -> int:
+        with self._lock:
+            st = self._state.get(queue)
+            return 0 if st is None else st.cur_epoch
+
+    def close(self) -> None:
+        try:
+            run_io(self._conn.close(), timeout=5.0)
+        except Exception:
+            pass

@@ -1,0 +1,459 @@
+"""Socket replication link + hub (ISSUE 20).
+
+``SocketReplicationLink`` (primary half) and ``SocketStandbyLink``
+(standby half) implement the in-proc link's send/recv/ack/acked surface
+over the framed transport, so ``QueueReplication`` and ``StandbyApplier``
+run unchanged — the at-least-once semantics stay exactly where PR 17 put
+them (the sender's unacked tail retains, the pump's stall retransmission
+re-sends, the applier's seq dedup + gap buffer absorb), which is why a
+torn frame, a dropped frame, a reset connection, or a whole reconnect
+never needs transport-level recovery: resume is by cumulative ack,
+reusing the WAL seq watermark.
+
+Flow ids (the nemesis vocabulary): ``repl:<queue>:fwd`` — records,
+primary→standby; ``repl:<queue>:ack`` — cumulative acks,
+standby→primary; ``lease:<owner>`` — lease RPCs. Scripted fault seqs on
+replication flows are RECORD seqs (retransmissions are never re-faulted:
+first-transmission-only, like the in-proc link).
+
+``SocketReplicationHub`` is the drop-in fabric: the same
+``authority`` / ``link()`` / ``standby()`` / ``adopted`` surface as
+``ReplicationHub``, built over real sockets. With no explicit addresses
+it runs LOOPBACK mode — an embedded ``LeaseService`` (caller-clock
+trusted, so scripted lease fast-forward keeps working) plus per-queue
+UDS rendezvous paths — which is what the in-proc ≡ socket equivalence
+pin runs on. Cross-process mode points ``lease_addr`` at a real service
+and wires explicit listen/target addresses per side.
+"""
+
+from __future__ import annotations
+
+import base64
+import collections
+import logging
+import re
+import threading
+from typing import Any
+
+from matchmaking_tpu.net.lease import LeaseService, RemoteLeaseAuthority
+from matchmaking_tpu.net.nemesis import FlowNemesis, NetNemesis
+from matchmaking_tpu.net.transport import (
+    MsgConn,
+    MsgServer,
+    ReconnectingConn,
+    io_loop,
+    pack_msg,
+    run_io,
+)
+
+__all__ = ["SocketReplicationLink", "SocketStandbyLink",
+           "SocketReplicationHub"]
+
+log = logging.getLogger(__name__)
+
+
+def _slug(queue: str) -> str:
+    return re.sub(r"[^A-Za-z0-9_.-]", "_", queue)
+
+
+class SocketReplicationLink:
+    """PRIMARY half of the socket link: owns the outbound connection to
+    the standby's listener. Implements ``send`` / ``acked`` / ``queue`` /
+    ``counters`` / ``partition`` — the half of the in-proc surface
+    ``QueueReplication`` uses. (``recv``/``ack`` live on the standby
+    half, :class:`SocketStandbyLink`.)
+
+    ``send`` is called under the journal lock on append threads: it only
+    enqueues (bounded — over ``send_buffer_bytes`` of queued payload the
+    record DROPS and counts ``backpressure_dropped``; the pump's stall
+    retransmission heals) and wakes the IO loop, which runs the nemesis
+    pipeline, frames, and writes. On every (re)connect the link replays
+    the newest baseline it ever shipped — a standby that attaches late,
+    or re-attaches after a torn stream, always starts from re-baselined
+    truth plus the retransmitted tail."""
+
+    def __init__(self, queue: str, target: str, *, net: Any = None,
+                 nemesis: "NetNemesis | None" = None, seed: int = 0):
+        from matchmaking_tpu.config import NetConfig
+
+        self.queue = queue
+        self.target = target
+        self.net = net or NetConfig(transport="socket")
+        self._seed = int(seed)
+        self.counters: "collections.Counter" = collections.Counter()
+        self._clock = threading.Lock()
+        self._acked = 0
+        self.flow = f"repl:{queue}:fwd"
+        nem = (nemesis.flow(self.flow, self._count)
+               if nemesis is not None else None)
+        #: Always present: runtime ``partition()`` (the bench's
+        #: kill-under-lag cut) needs the pipeline even with no script.
+        self._nem = nem if nem is not None else FlowNemesis(
+            self.flow, None, seed, self._count)
+        self._out: "collections.deque[tuple[int, int, bytes]]" = (
+            collections.deque())
+        self._out_bytes = 0
+        self._last_baseline: "tuple[int, int, bytes] | None" = None
+        self._drain_scheduled = False
+        self._closed = False
+        rx_deaf = (nemesis.rx_deaf(f"repl:{queue}:ack")
+                   if nemesis is not None else None)
+        self._client = ReconnectingConn(
+            target, name=self.flow, seed=seed, on_msg=self._on_msg,
+            counters=self.counters, counters_lock=self._clock,
+            connect_timeout_s=self.net.connect_timeout_s,
+            reconnect_base_s=self.net.reconnect_base_s,
+            reconnect_cap_s=self.net.reconnect_cap_s,
+            conn_kwargs=dict(
+                heartbeat_interval_s=self.net.heartbeat_interval_s,
+                heartbeat_timeout_s=self.net.heartbeat_timeout_s,
+                max_frame=self.net.max_frame_bytes,
+                send_buffer_bytes=self.net.send_buffer_bytes,
+                rx_deaf=rx_deaf),
+            on_connect=self._on_connect)
+        self._client.start()
+
+    def _count(self, key: str, n: int = 1) -> None:
+        with self._clock:
+            self.counters[key] += n
+
+    # -- primary surface (any thread) --
+
+    def send(self, seq: int, rtype: int, payload: bytes) -> None:
+        from matchmaking_tpu.service.replication import RT_REPL_SNAPSHOT
+
+        with self._clock:
+            self.counters["sent"] += 1
+            if self._out_bytes + len(payload) > self.net.send_buffer_bytes:
+                # Bounded send buffer: surface backpressure (count +
+                # drop) instead of buffering unboundedly — the unacked
+                # tail upstream retains the record and the stall
+                # retransmission re-offers it when the buffer drains.
+                self.counters["backpressure_dropped"] += 1
+                return
+            self._out.append((int(seq), int(rtype), payload))
+            self._out_bytes += len(payload)
+        if rtype == RT_REPL_SNAPSHOT:
+            self._last_baseline = (int(seq), int(rtype), payload)
+        io_loop().call_soon_threadsafe(self._schedule_drain)
+
+    @property
+    def acked(self) -> int:
+        return self._acked
+
+    def partition(self, start: int, resume: "int | None" = None) -> None:
+        """Runtime-scripted partition, same contract as the in-proc
+        link: record seqs >= start hold at the sender until any
+        transmission reaches ``resume`` (default never)."""
+        self._nem.partition(start, resume)
+        self._count("partitions")
+
+    # -- IO loop side --
+
+    def _on_msg(self, msg: "dict[str, Any]") -> None:
+        if msg.get("t") == "ack":
+            seq = int(msg.get("seq", 0))
+            if seq > self._acked:
+                self._acked = seq
+
+    def _on_connect(self, conn: MsgConn) -> None:
+        # Re-baseline on every (re)connect: a late-attaching standby (or
+        # one behind a torn stream) rebases from this + the
+        # retransmitted unacked tail. A stale duplicate is absorbed by
+        # the applier's snapshot dedup.
+        lb = self._last_baseline
+        if lb is not None:
+            with self._clock:
+                self._out.appendleft(lb)
+                self._out_bytes += len(lb[2])
+        self._schedule_drain()
+
+    def _schedule_drain(self) -> None:
+        if not self._drain_scheduled and not self._closed:
+            self._drain_scheduled = True
+            io_loop().create_task(self._drain())
+
+    async def _drain(self) -> None:
+        import asyncio
+
+        try:
+            while True:
+                with self._clock:
+                    if not self._out:
+                        break
+                    seq, rtype, payload = self._out.popleft()
+                    self._out_bytes -= len(payload)
+                frame = pack_msg({
+                    "t": "rec", "q": self.queue, "seq": seq, "rt": rtype,
+                    "p": base64.b64encode(payload).decode("ascii")})
+                for action in self._nem.transmit(seq, frame):
+                    conn = self._client.conn
+                    if action[0] == "reset":
+                        if conn is not None:
+                            conn.reset()
+                        continue
+                    if conn is None:
+                        # Down link: the frame vanishes (the unacked
+                        # tail + stall retransmit heal, exactly like an
+                        # in-proc scripted drop).
+                        self._count("send_no_conn")
+                        continue
+                    bps = self._nem.bandwidth_bps
+                    if bps:
+                        await asyncio.sleep(len(action[1]) / float(bps))
+                    conn.send_payload(action[1])
+        finally:
+            self._drain_scheduled = False
+            with self._clock:
+                more = bool(self._out)
+            if more and not self._closed:
+                self._schedule_drain()
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            run_io(self._client.close(), timeout=5.0)
+        except Exception:
+            pass
+
+
+class SocketStandbyLink:
+    """STANDBY half: listens for the primary's stream and implements
+    ``recv`` / ``ack`` / ``max_delivered`` / ``queue`` / ``counters`` —
+    the half of the in-proc surface ``StandbyApplier`` uses. A new
+    connection replaces the old (latest primary wins); acks go out on
+    whichever connection is current, carrying the cumulative watermark
+    (losing any individual ack frame is harmless — a later one
+    supersedes it)."""
+
+    def __init__(self, queue: str, listen: str, *, net: Any = None,
+                 nemesis: "NetNemesis | None" = None, seed: int = 0):
+        from matchmaking_tpu.config import NetConfig
+
+        self.queue = queue
+        self.listen = listen
+        self.net = net or NetConfig(transport="socket")
+        self.counters: "collections.Counter" = collections.Counter()
+        self._clock = threading.Lock()
+        self.flow = f"repl:{queue}:ack"
+        nem = (nemesis.flow(self.flow, self._count)
+               if nemesis is not None else None)
+        self._nem = nem
+        self._ack_nseq = 0
+        self._rx: "collections.deque[tuple[int, int, bytes]]" = (
+            collections.deque())
+        #: Highest seq ever handed to recv() — the receive horizon the
+        #: ack watermark may never pass (sanitizer: ack-beyond-received).
+        self.max_delivered = 0
+        self._conn: "MsgConn | None" = None
+        rx_deaf = (nemesis.rx_deaf(f"repl:{queue}:fwd")
+                   if nemesis is not None else None)
+        self._server = MsgServer(
+            listen, name=self.flow, on_conn=self._on_conn,
+            conn_kwargs=dict(
+                on_msg=self._on_msg, counters=self.counters,
+                counters_lock=self._clock,
+                heartbeat_interval_s=self.net.heartbeat_interval_s,
+                heartbeat_timeout_s=self.net.heartbeat_timeout_s,
+                max_frame=self.net.max_frame_bytes,
+                send_buffer_bytes=self.net.send_buffer_bytes,
+                rx_deaf=rx_deaf))
+        run_io(self._server.start(), timeout=5.0)
+
+    def _count(self, key: str, n: int = 1) -> None:
+        with self._clock:
+            self.counters[key] += n
+
+    def _on_conn(self, conn: MsgConn) -> None:
+        prev, self._conn = self._conn, conn
+        self._count("accepts")
+        if prev is not None:
+            prev._schedule_close("replaced by newer connection")
+
+    def _on_msg(self, msg: "dict[str, Any]") -> None:
+        if msg.get("t") != "rec" or msg.get("q") != self.queue:
+            return
+        try:
+            rec = (int(msg["seq"]), int(msg["rt"]),
+                   base64.b64decode(msg["p"]))
+        except (KeyError, ValueError, TypeError):
+            self._count("bad_records")
+            return
+        self._rx.append(rec)
+
+    # -- standby surface (any thread) --
+
+    def recv(self) -> "list[tuple[int, int, bytes]]":
+        out: "list[tuple[int, int, bytes]]" = []
+        while True:
+            try:
+                out.append(self._rx.popleft())
+            except IndexError:
+                break
+        for rec in out:
+            if rec[0] > self.max_delivered:
+                self.max_delivered = rec[0]
+        if out:
+            self._count("delivered", len(out))
+        return out
+
+    def ack(self, seq: int) -> None:
+        """Cumulative replication watermark back to the primary."""
+        io_loop().call_soon_threadsafe(self._send_ack, int(seq))
+
+    def _send_ack(self, seq: int) -> None:
+        conn = self._conn
+        if conn is None:
+            return
+        frame = pack_msg({"t": "ack", "q": self.queue, "seq": seq})
+        if self._nem is None:
+            conn.send_payload(frame)
+            return
+        self._ack_nseq += 1
+        for action in self._nem.transmit(self._ack_nseq, frame):
+            if action[0] == "reset":
+                conn.reset()
+            else:
+                conn.send_payload(action[1])
+
+    def peer_alive(self) -> bool:
+        conn = self._conn
+        return conn is not None and conn.peer_alive()
+
+    def close(self) -> None:
+        async def _close() -> None:
+            await self._server.close()
+            if self._conn is not None:
+                await self._conn.close("standby closed")
+        try:
+            run_io(_close(), timeout=5.0)
+        except Exception:
+            pass
+
+
+class SocketReplicationHub:
+    """Drop-in fabric with the ``ReplicationHub`` surface — authority /
+    ``link()`` / ``standby()`` / ``adopted`` — over real sockets, so
+    ``MatchmakingApp(replication_hub=...)`` and the PR 17 soak script
+    run unchanged on either transport.
+
+    LOOPBACK mode (no ``lease_addr``): an embedded caller-clock-trusted
+    :class:`LeaseService` on a UDS path plus per-queue UDS rendezvous
+    paths under ``base_dir`` — the equivalence pin's fabric.
+    CROSS-PROCESS mode: ``net.lease_addr`` names the shared service;
+    the primary side streams to ``set_target``/``net.repl_target`` and
+    the standby side listens via ``standby(..., listen=...)``."""
+
+    def __init__(self, *, net: Any = None, lease_s: float = 0.5,
+                 chaos: Any = None, seed: int = 0,
+                 base_dir: "str | None" = None, owner: str = "hub"):
+        from matchmaking_tpu.config import NetConfig
+
+        self.net = net or NetConfig(transport="socket")
+        self.chaos = chaos
+        self.seed = int(seed)
+        self.nemesis = NetNemesis(chaos, seed)
+        self.adopted: "dict[str, dict[str, Any]]" = {}
+        self.lease_service: "LeaseService | None" = None
+        self._base_dir = base_dir
+        lease_addr = self.net.lease_addr
+        if not lease_addr:
+            import tempfile
+
+            if self._base_dir is None:
+                self._base_dir = tempfile.mkdtemp(prefix="mm_net_")
+            lease_addr = f"unix:{self._base_dir}/lease.sock"
+            self.lease_service = LeaseService(
+                lease_addr, lease_s=lease_s, net=self.net,
+                fail_renewals=getattr(chaos, "repl_fail_renewals", ()) or (),
+                trust_caller_now=True)
+            self.lease_service.start()
+        self.authority = RemoteLeaseAuthority(
+            lease_addr, net=self.net, seed=seed, client=owner,
+            nemesis=self.nemesis)
+        self._targets: "dict[str, str]" = {}
+        self._links: "dict[str, SocketReplicationLink]" = {}
+        self._standby_links: "dict[str, SocketStandbyLink]" = {}
+
+    def _rendezvous(self, queue: str) -> str:
+        if self._base_dir is None:
+            raise ValueError(
+                f"no replication target for queue {queue!r}: set "
+                f"net.repl_target, call set_target(), or use loopback "
+                f"mode (no lease_addr)")
+        return f"unix:{self._base_dir}/repl.{_slug(queue)}.sock"
+
+    def set_target(self, queue: str, addr: str) -> None:
+        """Point this primary's stream for ``queue`` at a (new) standby
+        listener — the cross-process driver calls this before each
+        serve, since every cycle's standby listens on a fresh address."""
+        self._targets[queue] = addr
+        lk = self._links.pop(queue, None)
+        if lk is not None:
+            lk.close()
+
+    def target_for(self, queue: str) -> str:
+        return (self._targets.get(queue) or self.net.repl_target
+                or self._rendezvous(queue))
+
+    def link(self, queue: str) -> SocketReplicationLink:
+        lk = self._links.get(queue)
+        if lk is None:
+            chaos = self.chaos
+            if chaos is not None:
+                qs = getattr(chaos, "queues", ()) or ()
+                if qs and queue not in qs:
+                    chaos = None
+            nem = self.nemesis if chaos is self.chaos else NetNemesis(
+                chaos, self.seed)
+            lk = SocketReplicationLink(
+                queue, self.target_for(queue), net=self.net, nemesis=nem,
+                seed=self.seed)
+            self._links[queue] = lk
+        return lk
+
+    def standby(self, queue: str, owner: str = "standby",
+                listen: "str | None" = None):
+        from matchmaking_tpu.service.replication import StandbyApplier
+
+        prev = self._standby_links.pop(queue, None)
+        if prev is not None:
+            # One listener per queue: the new standby takes over the
+            # rendezvous address; the primary's reconnect + baseline
+            # replay + unacked-tail retransmission re-sync it.
+            prev.close()
+        slink = SocketStandbyLink(
+            queue, listen or self._rendezvous(queue), net=self.net,
+            nemesis=self.nemesis, seed=self.seed)
+        self._standby_links[queue] = slink
+        return StandbyApplier(queue, slink, self.authority, owner=owner,
+                              hub=self)
+
+    def cycle_reset(self, queue: str) -> None:
+        """Host-generation boundary (the loopback failover soak calls
+        this before each app boot): retire the queue's primary link and
+        standby listener so the next generation starts from a fresh
+        acked watermark and a fresh stream. Without this, the cumulative
+        ack watermark of a PREVIOUS host generation (whose journal seqs
+        restart on a fresh dir) would mark the new generation's low seqs
+        pre-acked — silently disarming the unacked-tail retransmission
+        the socket transport leans on. The in-proc hub has no such hook:
+        its wire deque never loses records, so stale watermarks are
+        harmless there."""
+        lk = self._links.pop(queue, None)
+        if lk is not None:
+            lk.close()
+        sl = self._standby_links.pop(queue, None)
+        if sl is not None:
+            sl.close()
+
+    def close(self) -> None:
+        for lk in self._links.values():
+            lk.close()
+        self._links.clear()
+        for sl in self._standby_links.values():
+            sl.close()
+        self._standby_links.clear()
+        self.authority.close()
+        if self.lease_service is not None:
+            self.lease_service.close()

@@ -1,0 +1,184 @@
+"""Deterministic network nemesis (ISSUE 20).
+
+Rides the ChaosConfig ``net_*`` vocabulary the way the in-proc
+replication link rides ``repl_*``: every fault decision is a pure
+function of (seed, connection/flow id, frame seq) — scripted entries
+match flows by substring ("repl:<queue>:fwd", "repl:<queue>:ack",
+"lease:<owner>") and fire on a frame's FIRST transmission only, so
+retransmission of the unacked tail is how a faulted stream converges,
+and two seeded runs inject bit-identical faults.
+
+Sender-side verdicts (:class:`FlowNemesis.transmit`): drop, duplicate,
+delay-by-N-transmissions (reordering), partition windows, mid-stream
+connection RESET, and a bandwidth cap (pacing — frames wait, never
+corrupt). Receiver-side (:meth:`NetNemesis.rx_deaf`): ASYMMETRIC
+partitions — the case the in-proc link cannot express — where a process
+keeps sending but its INBOUND frames (acks, lease responses, heartbeats)
+vanish, either scripted from boot (``net_deaf_flows``) or armed at a
+deterministic point by the soak driver (:meth:`NetNemesis.deafen`, the
+runtime twin of ``InProcReplicationLink.partition``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable
+
+from matchmaking_tpu.utils.chaos import hash01
+
+__all__ = ["FlowNemesis", "NetNemesis"]
+
+
+class FlowNemesis:
+    """Sender-side fault pipeline for ONE flow. ``transmit(seq, frame)``
+    returns the ordered actions the transport must take: zero or more
+    ``("send", frame)`` and at most one ``("reset",)`` — delayed and
+    partitioned frames are held inside and released by later
+    transmissions, mirroring ``InProcReplicationLink.send`` exactly."""
+
+    def __init__(self, flow: str, chaos: Any, seed: int,
+                 count: "Callable[[str], None]"):
+        self.flow = flow
+        self._seed = int(seed)
+        self._count = count
+
+        def match2(entries) -> "dict[int, Any]":
+            return {int(s): True for f, s in (entries or ()) if f in flow}
+
+        self._drop = frozenset(match2(getattr(chaos, "net_drop_frames", ())))
+        self._dup = frozenset(match2(getattr(chaos, "net_dup_frames", ())))
+        self._reset = set(match2(getattr(chaos, "net_reset_frames", ())))
+        self._delay = {int(s): int(h)
+                       for f, s, h in (getattr(chaos, "net_delay_frames",
+                                               ()) or ()) if f in flow}
+        self._partitions = [(int(a), int(b))
+                            for f, a, b in (getattr(chaos, "net_partitions",
+                                                    ()) or ()) if f in flow]
+        self._drop_prob = float(getattr(chaos, "net_drop_prob", 0.0) or 0.0)
+        #: Bytes/second pacing cap, or None (the transport applies it).
+        self.bandwidth_bps: "int | None" = None
+        for f, bps in (getattr(chaos, "net_bandwidth_caps", ()) or ()):
+            if f in flow:
+                self.bandwidth_bps = int(bps)
+                break
+        self._seen: "set[int]" = set()
+        self._delayed: "list[list[Any]]" = []
+        self._partitioned = False
+        self._resume_at = 0
+        self._partition_buf: "list[bytes]" = []
+
+    def transmit(self, seq: int, frame: bytes) -> "list[tuple]":
+        """Fault-filter one frame transmission (first-tx-only scripting;
+        the caller's seq is the record seq on replication flows, a
+        per-flow data-frame counter elsewhere)."""
+        out: "list[tuple]" = []
+        first = seq not in self._seen
+        if first:
+            self._seen.add(seq)
+        if self._partitioned and seq >= self._resume_at:
+            self._partitioned = False
+            for held in self._partition_buf:
+                out.append(("send", held))
+            self._partition_buf.clear()
+        elif first and not self._partitioned:
+            for pause, resume in self._partitions:
+                if seq == pause:
+                    self._partitioned = True
+                    self._resume_at = resume
+                    self._count("nemesis_partitions")
+                    break
+        if first and self._delayed:
+            due = [d for d in self._delayed if d[0] <= 1]
+            self._delayed = [[h - 1, f] for h, f in self._delayed if h > 1]
+            for _h, held in due:
+                if self._partitioned:
+                    self._partition_buf.append(held)
+                else:
+                    out.append(("send", held))
+        if self._partitioned:
+            self._partition_buf.append(frame)
+            return out
+        if first:
+            if seq in self._drop:
+                self._count("nemesis_dropped")
+                return out
+            if self._drop_prob > 0 and hash01(
+                    self._seed, "net", self.flow, seq) < self._drop_prob:
+                self._count("nemesis_dropped")
+                return out
+            if seq in self._reset:
+                # The frame is CONSUMED by the reset (never sent): the
+                # connection tears mid-stream and the retransmitted tail
+                # carries it over the next connection.
+                self._count("nemesis_resets")
+                out.append(("reset",))
+                return out
+            hold = self._delay.get(seq)
+            if hold is not None:
+                self._count("nemesis_delayed")
+                self._delayed.append([hold, frame])
+                return out
+            if seq in self._dup:
+                self._count("nemesis_dup")
+                out.append(("send", frame))
+        out.append(("send", frame))
+        return out
+
+    def partition(self, start: int, resume: "int | None" = None) -> None:
+        """Runtime-scripted partition (the bench's kill-under-lag cut):
+        transmissions of seqs >= start hold until any transmission
+        reaches ``resume`` (default: never)."""
+        self._partitions.append(
+            (int(start), (1 << 62) if resume is None else int(resume)))
+
+
+class NetNemesis:
+    """Per-process fault registry: builds a :class:`FlowNemesis` per
+    sender flow from the ChaosConfig script and owns the receiver-side
+    deafness verdict (asymmetric partitions). Thread-safe — links live
+    on the IO loop while soak drivers arm deafness from control
+    threads."""
+
+    def __init__(self, chaos: Any = None, seed: int = 0):
+        self.chaos = chaos
+        self.seed = int(seed)
+        self._deaf_patterns: "list[str]" = list(
+            getattr(chaos, "net_deaf_flows", ()) or ())
+        self._lock = threading.Lock()
+
+    def flow(self, flow_id: str,
+             count: "Callable[[str], None]") -> "FlowNemesis | None":
+        """Sender-side pipeline for a flow, or None when no scripted or
+        seeded fault touches it (the zero-cost default path)."""
+        ch = self.chaos
+        if ch is None or not ch.net_faults():
+            return None
+        fn = FlowNemesis(flow_id, ch, self.seed, count)
+        if (fn._drop or fn._dup or fn._reset or fn._delay
+                or fn._partitions or fn._drop_prob > 0
+                or fn.bandwidth_bps is not None):
+            return fn
+        return None
+
+    # -- receiver side (asymmetric partitions) --
+
+    def rx_deaf(self, flow_id: str) -> "Callable[[], bool]":
+        """The verdict callable a connection consults per inbound read:
+        True while any deaf pattern matches this flow."""
+        def deaf() -> bool:
+            with self._lock:
+                return any(p in flow_id for p in self._deaf_patterns)
+        return deaf
+
+    def deafen(self, pattern: str) -> None:
+        """Arm an asymmetric partition at runtime: inbound frames on
+        every flow matching ``pattern`` drop from now on (the soak arms
+        this at a deterministic quiesced boundary, then proves the
+        primary self-fences within the lease budget)."""
+        with self._lock:
+            self._deaf_patterns.append(pattern)
+
+    def undeafen(self) -> None:
+        with self._lock:
+            self._deaf_patterns = list(
+                getattr(self.chaos, "net_deaf_flows", ()) or ())

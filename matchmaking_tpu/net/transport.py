@@ -1,0 +1,502 @@
+"""Framed message transport over TCP/UDS (ISSUE 20).
+
+The wire unit is a FRAME: a fixed header (magic, payload length, CRC32)
+followed by the payload — the same defense-in-depth the WAL's record
+framing uses (utils/journal.py): a torn or bit-flipped frame is DETECTED
+(:class:`FrameError`), the connection dies, and the stream resumes by
+cumulative ack over a reconnect. A frame error never yields a corrupt
+payload to the application.
+
+Messages are JSON dicts (binary payloads travel base64 in ``"p"``). The
+transport owns connection mechanics only — heartbeats, the peer-liveness
+deadline, seeded reconnect backoff, bounded send buffers — while fault
+DECISIONS live in :mod:`~matchmaking_tpu.net.nemesis` and replication
+retransmission stays where PR 17 put it (``QueueReplication``'s unacked
+tail + the applier's dedup), so at-least-once delivery semantics are
+identical across the in-proc and socket links.
+
+Threading model: ONE daemon IO thread per process runs a private asyncio
+loop; every connection object is confined to it. Callers on any thread
+(the journal-append worker shipping a record, the app loop, a bench
+driver) hand work over via ``call_soon_threadsafe`` — no asyncio locks,
+no cross-loop awaits. Every deadline here is ``time.monotonic()``
+arithmetic and every jitter draw is ``hash01``-seeded (the matchlint
+determinism rule checks exactly this).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import binascii
+import collections
+import json
+import logging
+import struct
+import threading
+import time
+from typing import Any, Awaitable, Callable
+
+from matchmaking_tpu.utils.chaos import hash01
+
+__all__ = [
+    "FrameError", "FrameDecoder", "encode_frame", "pack_msg", "unpack_msg",
+    "backoff_delay", "parse_addr", "io_loop", "run_io", "MsgConn",
+    "MsgServer", "ReconnectingConn",
+]
+
+log = logging.getLogger(__name__)
+
+#: Frame header: magic (torn-stream resync guard), payload length, CRC32
+#: over the payload. Little-endian like the journal's record header.
+_HEADER = struct.Struct("<HII")
+MAGIC = 0x4D4E  # "MN"
+HEADER_LEN = _HEADER.size
+
+
+class FrameError(ValueError):
+    """The stream is torn, hostile, or corrupt at this byte — the only
+    safe response is to kill the connection (resume is by ack)."""
+
+
+def encode_frame(payload: bytes, max_frame: int = 1 << 20) -> bytes:
+    if len(payload) > max_frame:
+        raise FrameError(
+            f"frame payload {len(payload)} bytes exceeds max_frame "
+            f"{max_frame}")
+    return _HEADER.pack(MAGIC, len(payload),
+                        binascii.crc32(payload) & 0xFFFFFFFF) + payload
+
+
+class FrameDecoder:
+    """Incremental frame parser. ``feed`` returns every COMPLETE payload
+    the buffered bytes contain; a partial tail is held for the next feed
+    (partial reads are normal TCP). Any malformed header or CRC mismatch
+    raises :class:`FrameError` — callers must treat the connection as
+    dead (no resync heuristics: a framing error means the byte stream
+    can no longer be trusted at all)."""
+
+    def __init__(self, max_frame: int = 1 << 20):
+        self.max_frame = int(max_frame)
+        self._buf = bytearray()
+
+    def feed(self, data: bytes) -> "list[bytes]":
+        self._buf.extend(data)
+        out: list[bytes] = []
+        while True:
+            if len(self._buf) < HEADER_LEN:
+                return out
+            magic, length, crc = _HEADER.unpack_from(self._buf, 0)
+            if magic != MAGIC:
+                raise FrameError(f"bad frame magic 0x{magic:04x}")
+            if length > self.max_frame:
+                raise FrameError(
+                    f"hostile frame length {length} > max_frame "
+                    f"{self.max_frame}")
+            if len(self._buf) < HEADER_LEN + length:
+                return out
+            payload = bytes(self._buf[HEADER_LEN:HEADER_LEN + length])
+            if (binascii.crc32(payload) & 0xFFFFFFFF) != crc:
+                raise FrameError(
+                    f"frame CRC mismatch (len {length})")
+            del self._buf[:HEADER_LEN + length]
+            out.append(payload)
+
+
+def pack_msg(msg: "dict[str, Any]") -> bytes:
+    return json.dumps(msg, separators=(",", ":")).encode("utf-8")
+
+
+def unpack_msg(payload: bytes) -> "dict[str, Any]":
+    d = json.loads(payload.decode("utf-8"))
+    if not isinstance(d, dict) or "t" not in d:
+        raise FrameError("frame payload is not a typed message")
+    return d
+
+
+def backoff_delay(seed: int, conn_id: str, attempt: int,
+                  base_s: float, cap_s: float) -> float:
+    """Seeded exponential backoff with jitter: min(cap, base * 2^n)
+    scaled into [0.5, 1.0] by ``hash01(seed, "backoff", conn, n)`` — a
+    pure function of (seed, connection id, attempt), so two seeded runs
+    reconnect on identical schedules (matchlint's determinism rule bans
+    the unseeded-jitter shape this replaces)."""
+    d = min(float(cap_s), float(base_s) * (2.0 ** min(int(attempt), 16)))
+    return d * (0.5 + 0.5 * hash01(seed, "backoff", conn_id, attempt))
+
+
+def parse_addr(addr: str) -> "tuple[str, ...]":
+    """``"unix:/path.sock"`` or ``"tcp:host:port"``."""
+    if addr.startswith("unix:"):
+        return ("unix", addr[5:])
+    if addr.startswith("tcp:"):
+        host, _, port = addr[4:].rpartition(":")
+        if not host or not port.isdigit():
+            raise ValueError(f"bad tcp addr {addr!r} (tcp:host:port)")
+        return ("tcp", host, int(port))
+    raise ValueError(f"bad addr {addr!r} (unix:/path or tcp:host:port)")
+
+
+# ---- the process-wide IO thread ---------------------------------------------
+
+_io_lock = threading.Lock()
+_io: "asyncio.AbstractEventLoop | None" = None
+
+
+def io_loop() -> asyncio.AbstractEventLoop:
+    """The process's shared network IO loop (daemon thread, started on
+    first use). Connection objects live here; other threads hand work
+    over via ``call_soon_threadsafe`` / :func:`run_io`."""
+    global _io
+    with _io_lock:
+        if _io is not None and not _io.is_closed():
+            return _io
+        loop = asyncio.new_event_loop()
+        ready = threading.Event()
+
+        def run() -> None:
+            asyncio.set_event_loop(loop)
+            loop.call_soon(ready.set)
+            loop.run_forever()
+
+        t = threading.Thread(target=run, name="mm-net-io", daemon=True)
+        t.start()
+        ready.wait(5.0)
+        _io = loop
+        return loop
+
+
+def run_io(coro: "Awaitable[Any]", timeout: "float | None" = None) -> Any:
+    """Run a coroutine on the IO loop from any OTHER thread and wait."""
+    return asyncio.run_coroutine_threadsafe(coro, io_loop()).result(timeout)
+
+
+# ---- connections ------------------------------------------------------------
+
+
+class MsgConn:
+    """One framed connection, confined to the IO loop.
+
+    Owns the read task (frame decode → ``on_msg``), the heartbeat task
+    (send ``{"t":"hb"}`` every ``heartbeat_interval_s``; declare the peer
+    dead — and close — when nothing arrives for ``heartbeat_timeout_s``),
+    and the bounded send buffer (a send that would push the transport's
+    write buffer past ``send_buffer_bytes`` is DROPPED and counted as
+    ``backpressure_dropped`` — the cumulative-ack retransmission upstream
+    is the healing mechanism, so surfacing beats unbounded buffering).
+
+    ``rx_deaf`` is the nemesis's receiver-side hook (asymmetric
+    partitions): when it returns True, inbound frames — heartbeats
+    included — are dropped BEFORE they can refresh the liveness deadline,
+    so a deafened peer looks exactly like a dead one.
+    """
+
+    def __init__(self, reader: asyncio.StreamReader,
+                 writer: asyncio.StreamWriter, *, name: str,
+                 on_msg: "Callable[[dict[str, Any]], None]",
+                 counters: "collections.Counter",
+                 counters_lock: threading.Lock,
+                 heartbeat_interval_s: float = 0.1,
+                 heartbeat_timeout_s: float = 0.6,
+                 max_frame: int = 1 << 20,
+                 send_buffer_bytes: int = 4 << 20,
+                 rx_deaf: "Callable[[], bool] | None" = None,
+                 on_close: "Callable[[MsgConn], None] | None" = None):
+        self.name = name
+        self._reader = reader
+        self._writer = writer
+        self._on_msg = on_msg
+        self._counters = counters
+        self._clock = counters_lock
+        self._hb_interval = float(heartbeat_interval_s)
+        self._hb_timeout = float(heartbeat_timeout_s)
+        self._max_frame = int(max_frame)
+        self._send_limit = int(send_buffer_bytes)
+        self._rx_deaf = rx_deaf
+        self._on_close = on_close
+        self._last_rx = time.monotonic()
+        self._closed = False
+        self.closed_evt: "asyncio.Event" = asyncio.Event()
+        self._tasks: "list[asyncio.Task]" = []
+
+    def _count(self, key: str, n: int = 1) -> None:
+        with self._clock:
+            self._counters[key] += n
+
+    def start(self) -> None:
+        self._tasks.append(asyncio.ensure_future(self._read_loop()))
+        self._tasks.append(asyncio.ensure_future(self._hb_loop()))
+
+    # -- send (loop-confined) --
+
+    def send_payload(self, payload: bytes) -> bool:
+        """Write one frame; False (dropped + counted) on backpressure or
+        a closed connection. Never blocks, never buffers unboundedly."""
+        if self._closed:
+            self._count("send_closed_dropped")
+            return False
+        transport = self._writer.transport
+        if (transport is not None
+                and transport.get_write_buffer_size() > self._send_limit):
+            self._count("backpressure_dropped")
+            return False
+        try:
+            self._writer.write(encode_frame(payload, self._max_frame))
+        except Exception:
+            self._count("send_errors")
+            self._schedule_close("write failed")
+            return False
+        self._count("frames_tx")
+        return True
+
+    def send_msg(self, msg: "dict[str, Any]") -> bool:
+        return self.send_payload(pack_msg(msg))
+
+    # -- liveness --
+
+    def peer_alive(self, now: "float | None" = None) -> bool:
+        t = time.monotonic() if now is None else now
+        return (t - self._last_rx) < self._hb_timeout
+
+    # -- internals --
+
+    async def _read_loop(self) -> None:
+        dec = FrameDecoder(self._max_frame)
+        try:
+            while True:
+                data = await self._reader.read(1 << 16)
+                if not data:
+                    self._schedule_close("peer closed")
+                    return
+                if self._rx_deaf is not None and self._rx_deaf():
+                    # Asymmetric partition: inbound bytes vanish before
+                    # the liveness deadline or the app can see them.
+                    self._count("rx_deaf_dropped")
+                    continue
+                for payload in dec.feed(data):
+                    self._last_rx = time.monotonic()
+                    self._count("frames_rx")
+                    try:
+                        msg = unpack_msg(payload)
+                    except FrameError:
+                        raise
+                    if msg.get("t") == "hb":
+                        continue
+                    try:
+                        self._on_msg(msg)
+                    except Exception:
+                        log.exception("%s: on_msg failed", self.name)
+        except FrameError as e:
+            # Torn/hostile/corrupt frame: the connection dies CLEANLY —
+            # nothing after the bad byte is delivered, and the stream
+            # resumes by cumulative ack over the next connection.
+            self._count("frame_errors")
+            log.warning("%s: frame error (%s) — closing", self.name, e)
+            self._schedule_close("frame error")
+        except (asyncio.CancelledError, GeneratorExit):
+            raise
+        except Exception:
+            self._count("read_errors")
+            self._schedule_close("read failed")
+
+    async def _hb_loop(self) -> None:
+        try:
+            while not self._closed:
+                await asyncio.sleep(self._hb_interval)
+                if not self.peer_alive():
+                    # Deadline-based peer-liveness verdict: no inbound
+                    # frame (heartbeats included) for heartbeat_timeout_s.
+                    self._count("liveness_lost")
+                    log.warning("%s: peer liveness lost — closing",
+                                self.name)
+                    self._schedule_close("liveness lost")
+                    return
+                self.send_msg({"t": "hb"})
+        except (asyncio.CancelledError, GeneratorExit):
+            raise
+        except Exception:
+            self._schedule_close("heartbeat failed")
+
+    def _schedule_close(self, reason: str) -> None:
+        if not self._closed:
+            asyncio.ensure_future(self.close(reason))
+
+    async def close(self, reason: str = "closed") -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for t in self._tasks:
+            if t is not asyncio.current_task():
+                t.cancel()
+        try:
+            self._writer.close()
+        except Exception:
+            pass
+        self.closed_evt.set()
+        if self._on_close is not None:
+            try:
+                self._on_close(self)
+            except Exception:
+                log.exception("%s: on_close failed", self.name)
+
+    def reset(self) -> None:
+        """Abrupt close (the nemesis's mid-stream connection reset): no
+        goodbye, no flush — the peer sees EOF/ECONNRESET mid-frame."""
+        try:
+            transport = self._writer.transport
+            if transport is not None:
+                transport.abort()
+        except Exception:
+            pass
+        self._schedule_close("reset")
+
+
+class MsgServer:
+    """Listener on a TCP/UDS address; hands every accepted connection —
+    as a started :class:`MsgConn` — to ``on_conn`` on the IO loop."""
+
+    def __init__(self, addr: str, *, name: str,
+                 on_conn: "Callable[[MsgConn], None]",
+                 conn_kwargs: "dict[str, Any]"):
+        self.addr = addr
+        self.name = name
+        self._on_conn = on_conn
+        self._conn_kwargs = conn_kwargs
+        self._server: "asyncio.base_events.Server | None" = None
+
+    async def start(self) -> None:
+        kind = parse_addr(self.addr)
+
+        async def accept(reader: asyncio.StreamReader,
+                         writer: asyncio.StreamWriter) -> None:
+            conn = MsgConn(reader, writer,
+                           name=f"{self.name}<-", **self._conn_kwargs)
+            # on_conn BEFORE start: the acceptor may rebind the message
+            # handler to a per-connection closure (reply routing) before
+            # any frame can be dispatched.
+            self._on_conn(conn)
+            conn.start()
+
+        if kind[0] == "unix":
+            import os
+
+            try:
+                # Stale socket file from a previous listener (closed or
+                # SIGKILLed host): bind would fail with EADDRINUSE. The
+                # rendezvous PATH is the identity, not the inode.
+                os.unlink(kind[1])
+            except OSError:
+                pass
+            self._server = await asyncio.start_unix_server(accept, kind[1])
+        else:
+            self._server = await asyncio.start_server(accept, kind[1],
+                                                      kind[2])
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            try:
+                await self._server.wait_closed()
+            except Exception:
+                pass
+            self._server = None
+
+
+class ReconnectingConn:
+    """Client half of a long-lived link: dial ``addr`` with a connect
+    timeout, run a :class:`MsgConn` until it dies, then redial after the
+    seeded backoff — forever, until :meth:`close`.
+
+    ``on_connect`` runs on the IO loop right after every successful dial
+    (the replication link replays its last baseline there, so a standby
+    that attaches late — or a connection that died mid-stream — always
+    restarts from re-baselined truth + the retransmitted unacked tail).
+    """
+
+    def __init__(self, addr: str, *, name: str, seed: int,
+                 on_msg: "Callable[[dict[str, Any]], None]",
+                 counters: "collections.Counter",
+                 counters_lock: threading.Lock,
+                 connect_timeout_s: float = 1.0,
+                 reconnect_base_s: float = 0.02,
+                 reconnect_cap_s: float = 1.0,
+                 conn_kwargs: "dict[str, Any] | None" = None,
+                 on_connect: "Callable[[MsgConn], None] | None" = None):
+        self.addr = addr
+        self.name = name
+        self._seed = int(seed)
+        self._on_msg = on_msg
+        self._counters = counters
+        self._clock = counters_lock
+        self._connect_timeout = float(connect_timeout_s)
+        self._base = float(reconnect_base_s)
+        self._cap = float(reconnect_cap_s)
+        self._conn_kwargs = dict(conn_kwargs or {})
+        self._on_connect = on_connect
+        self.conn: "MsgConn | None" = None
+        self._closed = False
+        self._task: "asyncio.Task | None" = None
+
+    def _count(self, key: str, n: int = 1) -> None:
+        with self._clock:
+            self._counters[key] += n
+
+    def start(self) -> None:
+        loop = io_loop()
+        loop.call_soon_threadsafe(self._start_on_loop)
+
+    def _start_on_loop(self) -> None:
+        if self._task is None:
+            self._task = asyncio.ensure_future(self._run())
+
+    async def _dial(self) -> "tuple[asyncio.StreamReader, asyncio.StreamWriter]":
+        kind = parse_addr(self.addr)
+        if kind[0] == "unix":
+            fut = asyncio.open_unix_connection(kind[1])
+        else:
+            fut = asyncio.open_connection(kind[1], kind[2])
+        return await asyncio.wait_for(fut, timeout=self._connect_timeout)
+
+    async def _run(self) -> None:
+        attempt = 0
+        connects = 0
+        while not self._closed:
+            try:
+                reader, writer = await self._dial()
+            except (asyncio.CancelledError, GeneratorExit):
+                raise
+            except Exception:
+                self._count("connect_failures")
+                attempt += 1
+                await asyncio.sleep(backoff_delay(
+                    self._seed, self.name, attempt, self._base, self._cap))
+                continue
+            attempt = 0
+            connects += 1
+            self._count("connects")
+            if connects > 1:
+                self._count("reconnects")
+            conn = MsgConn(reader, writer, name=f"{self.name}->",
+                           on_msg=self._on_msg, counters=self._counters,
+                           counters_lock=self._clock, **self._conn_kwargs)
+            conn.start()
+            self.conn = conn
+            if self._on_connect is not None:
+                try:
+                    self._on_connect(conn)
+                except Exception:
+                    log.exception("%s: on_connect failed", self.name)
+            await conn.closed_evt.wait()
+            self.conn = None
+            if not self._closed:
+                attempt += 1
+                await asyncio.sleep(backoff_delay(
+                    self._seed, self.name, attempt, self._base, self._cap))
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        if self.conn is not None:
+            await self.conn.close("client closed")
+            self.conn = None

@@ -3653,6 +3653,10 @@ class MatchmakingApp:
         #: SHARED between a primary app, its standby appliers, and a
         #: failover successor — in-process here, per-host over DCN later.
         self.replication_hub = replication_hub
+        #: True when start() auto-built a SocketReplicationHub from
+        #: cfg.net (owned: closed on stop/crash/drain). An injected hub
+        #: is never closed here — it outlives hosts by design.
+        self._owns_net_hub = False
         obs = self.cfg.observability
         #: Causal event spine (ISSUE 18, utils/forensics.py): ONE
         #: process-wide monotone sequence every lifecycle emission is
@@ -3776,6 +3780,18 @@ class MatchmakingApp:
             # the boot-time split-brain guard), stream from the WAL tap.
             # Runs AFTER journal recovery so the standby's baseline is
             # the recovered truth, BEFORE any control plane or traffic.
+            if self.replication_hub is None and self.cfg.net.enabled():
+                # Real-transport fabric (ISSUE 20): cfg.net names a lease
+                # service + replication target, so this app builds (and
+                # owns) its SocketReplicationHub — the cross-process
+                # deployment shape, where no in-process hub can be shared.
+                from matchmaking_tpu.net.link import SocketReplicationHub
+
+                self.replication_hub = SocketReplicationHub(
+                    net=self.cfg.net, chaos=self.cfg.chaos,
+                    seed=self.cfg.chaos.seed,
+                    owner=self.cfg.replication.owner or "primary")
+                self._owns_net_hub = True
             if self.replication_hub is None:
                 raise ValueError(
                     "cfg.replication.role is set but no ReplicationHub was "
@@ -3873,6 +3889,18 @@ class MatchmakingApp:
             return tuple(range(axis))
         return (index % n,)
 
+    def _close_owned_net_hub(self) -> None:
+        """Tear down an auto-built socket replication fabric (sockets +
+        IO tasks die with the host). Injected hubs are left alone."""
+        hub = self.replication_hub
+        if self._owns_net_hub and hub is not None:
+            try:
+                hub.close()
+            except Exception:
+                log.exception("socket replication hub close failed")
+            self.replication_hub = None
+            self._owns_net_hub = False
+
     async def crash(self) -> None:
         """Simulated HARD crash (bench --crash-soak / durability tests):
         tear the process state down with NO drain, NO checkpoints, and NO
@@ -3893,6 +3921,7 @@ class MatchmakingApp:
         for rt in self._runtimes.values():
             rt.abandon()
         self.broker.close()
+        self._close_owned_net_hub()
         self._started = False
 
     async def stop(self) -> None:
@@ -3908,6 +3937,7 @@ class MatchmakingApp:
         for rt in self._runtimes.values():
             await rt.close()
         self.broker.close()
+        self._close_owned_net_hub()
         self._started = False
 
     async def drain(self, checkpoint_dir: str | None = None) -> dict[str, int]:
@@ -3998,6 +4028,7 @@ class MatchmakingApp:
             await self._observability.stop()
             self._observability = None
         self.broker.close()
+        self._close_owned_net_hub()
         self._started = False
         return counts
 

@@ -8,8 +8,12 @@ stream to a warm standby so the pool can move hosts:
 
 - The **primary** streams every sealed journal record (already CRC-framed
   and seq-watermarked by the journal) per queue over a pluggable link —
-  :class:`InProcReplicationLink` now, the DCN transport later (same four
-  methods: ``send``/``recv``/``ack``/``acked``). The journal's ``tap``
+  :class:`InProcReplicationLink`, or the real socket transport
+  (ISSUE 20: ``matchmaking_tpu/net/link.py`` implements the same four
+  methods — ``send``/``recv``/``ack``/``acked`` — over framed TCP/UDS,
+  with ``net/lease.py`` filling the :class:`LeaseAuthority` seam, so
+  everything in THIS module runs unchanged on either fabric; likewise
+  for the lease stand-in below). The journal's ``tap``
   seam hands each record to :meth:`QueueReplication.on_record` at append
   time; the sender retains the unacked tail for retransmission, so the
   link is at-least-once with cumulative acks and the stream survives

@@ -30,6 +30,13 @@ def fully_drained(app: Any, rt: Any, queue: str,
     players"). At that point every duplicate/redelivery has been consumed
     and its replay response published — the state e2e assertions may read.
 
+    The replication clause is transport-agnostic by construction
+    (ISSUE 20): ``repl.quiescent`` compares the sender's OWN acked/sent
+    watermarks, so over the socket link it settles only once real ack
+    frames have crossed the wire — reconnect gaps, scripted nemesis
+    faults, and retransmissions all have to converge before a socket
+    soak's quiesce returns, exactly as the in-proc wire deque does.
+
     ``replication=False`` drops the quiescence clause — the knob for
     soaks that DELIBERATELY hold the stream open (a scripted link
     partition never acks, so the full conjunction would never settle;

@@ -475,6 +475,20 @@ class AsyncSanitizer:
         orig_rapply = sap._apply
         orig_rrecv = rl.recv
         orig_rack = rl.ack
+        # Socket twin (ISSUE 20): the standby half of the SOCKET link
+        # exposes the identical recv/ack/max_delivered surface, so the
+        # same closures mirror it — an ack past the delivered horizon is
+        # the same silent-loss bug whichever transport carried it.
+        # Guarded import: the sanitizer must stay usable if the net
+        # package is unavailable.
+        try:
+            from matchmaking_tpu.net.link import (
+                SocketStandbyLink as _slink_cls,
+            )
+        except ImportError:  # pragma: no cover - net package missing
+            _slink_cls = None
+        orig_srecv = _slink_cls.recv if _slink_cls is not None else None
+        orig_sack = _slink_cls.ack if _slink_cls is not None else None
         orig_pub_body = qrt._publish_body
         orig_pub_batch = qrt._publish_batch
 
@@ -559,6 +573,27 @@ class AsyncSanitizer:
                     f"failover into silent loss")
             orig_rack(link, seq)
 
+        def srecv(link):
+            out = orig_srecv(link)
+            _pin_repl(link)
+            san._repl_recv_site[id(link)] = _site()
+            return out
+
+        def sack(link, seq: int) -> None:
+            site = _site()
+            if seq > link.max_delivered:
+                rsite = san._repl_recv_site.get(
+                    (id(link)), "<no recv yet>")
+                san._report(
+                    "replication-ack-beyond-received",
+                    ("repl-ack", link.queue, seq, site),
+                    f"replication ack {seq} at {site} passes the delivered "
+                    f"horizon {link.max_delivered} (last recv at {rsite}) "
+                    f"for queue {link.queue!r} over the SOCKET link — the "
+                    f"primary would drop unacked-tail records the standby "
+                    f"never saw, turning failover into silent loss")
+            orig_sack(link, seq)
+
         @contextlib.contextmanager
         def _cm():
             self._orig_lock = asyncio.Lock
@@ -572,6 +607,8 @@ class AsyncSanitizer:
             te.spec_invalidate, te._pool_mutated = sinval, smutated
             la.takeover, sap._apply = rtakeover, rapply
             rl.recv, rl.ack = rrecv, rack
+            if _slink_cls is not None:
+                _slink_cls.recv, _slink_cls.ack = srecv, sack
             qrt._publish_body, qrt._publish_batch = pub_body, pub_batch
             try:
                 yield self
@@ -589,6 +626,9 @@ class AsyncSanitizer:
                 te._pool_mutated = orig_smutated
                 la.takeover, sap._apply = orig_takeover, orig_rapply
                 rl.recv, rl.ack = orig_rrecv, orig_rack
+                if _slink_cls is not None:
+                    _slink_cls.recv = orig_srecv
+                    _slink_cls.ack = orig_sack
                 qrt._publish_body = orig_pub_body
                 qrt._publish_batch = orig_pub_batch
 

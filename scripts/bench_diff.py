@@ -37,11 +37,18 @@ Compared metrics (direction-aware):
                        rows (ISSUE 17): failover_lost, failover_dup,
                        failover_lost_over_bound, failover_rto_ms(_mean),
                        replication_lag_ms_p99 (lost/dup/over-bound under
-                       the zero-baseline rule), and the model-checker
+                       the zero-baseline rule), the model-checker
                        rows (ISSUE 19): modelcheck_violations (zero
                        baseline — any counterexample regresses) with
                        modelcheck_states_explored higher-is-better
-                       (coverage at the committed scope)
+                       (coverage at the committed scope), and the
+                       cross-process socket failover rows (ISSUE 20):
+                       socket_failover_lost/dup/lost_over_bound,
+                       heartbeat_false_positive_count, and
+                       socket_fenced_probe_failures under the
+                       zero-baseline rule, with
+                       socket_failover_rto_ms(_mean) and
+                       socket_link_reconnects lower-is-better
 Frontier rows (``e2e_frontier``, ISSUE 8; the speculation-axis twin
 ``e2e_frontier_spec``, ISSUE 16) are matched by threshold.
 Scenario-matrix cells (``scenario_matrix``, ISSUE 13) are matched by
@@ -136,6 +143,27 @@ TOP_LEVEL_METRICS: dict[str, bool] = {
     # per-metric.
     "modelcheck_states_explored": True,
     "modelcheck_violations": False,
+    # Cross-process socket failover soak (ISSUE 20, bench.py
+    # --failover-soak --transport=socket): the PR 17 invariants gated
+    # OVER THE WIRE. lost/dup/over-bound keep the zero-baseline rule —
+    # so do heartbeat_false_positive_count (a liveness verdict that
+    # fired on a healthy link means the deadline model is wrong, not
+    # slow) and socket_fenced_probe_failures (a fence seam that leaked
+    # at the SIGKILLed-and-superseded ex-primary is split-brain, never a
+    # latency). The takeover RTO over real sockets is a lower-is-better
+    # latency; socket_link_reconnects is lower-is-better churn (the
+    # scripted reset accounts for the baseline's floor — MORE reconnects
+    # at the same script means the transport started tearing healthy
+    # connections). A run without the soak leaves the keys absent and
+    # they are skipped per-metric.
+    "socket_failover_lost": False,
+    "socket_failover_dup": False,
+    "socket_failover_lost_over_bound": False,
+    "socket_failover_rto_ms": False,
+    "socket_failover_rto_ms_mean": False,
+    "socket_link_reconnects": False,
+    "heartbeat_false_positive_count": False,
+    "socket_fenced_probe_failures": False,
 }
 
 #: Pool-scale sweep rows (ISSUE 14, ``bench.py --pool-scale``), matched

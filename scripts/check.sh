@@ -157,6 +157,85 @@ assert out["failover_rto_ms"] is not None and \
 print("failover-soak smoke: OK")
 EOF
 
+echo "== net =="
+# ISSUE 20 gate: real-transport DCN seams. The suite runs by marker
+# first — frame-codec fuzz (torn frames at every byte offset, hostile
+# length prefixes, CRC flips, interleaved heartbeats), the socket
+# replication link end-to-end over UDS with QueueReplication +
+# StandbyApplier unchanged, deterministic network-nemesis scripts, the
+# remote lease client's renewal-in-flight-at-expiry refusal, and the
+# sanitizer's ack-beyond-received twin over a real socket.
+JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'net and not slow' \
+    --continue-on-collection-errors -p no:cacheprovider
+# In-proc ≡ socket equivalence pin: the same seeded failover soak over
+# the in-proc link and over real loopback sockets (network nemesis off)
+# must emit BIT-IDENTICAL recovered-state transcripts — the socket
+# transport may change timing, never outcomes.
+python - <<'EOF'
+import json, subprocess, sys
+def run(transport):
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--failover-soak",
+         "--transport", transport, "--failover-cycles", "2",
+         "--failover-runs", "1", "--failover-pairs", "3",
+         "--failover-singles", "2"],
+        capture_output=True, text=True, timeout=600)
+    sys.stderr.write(proc.stderr)
+    if proc.returncode != 0:
+        sys.exit(f"failover-soak ({transport}) exited {proc.returncode}")
+    return json.loads(proc.stdout.splitlines()[-1])
+inproc, loop = run("inproc"), run("socket-loopback")
+print("equivalence pin digest:", inproc["failover_transcript_digest"])
+assert inproc["failover_transcript_digest"] == \
+    loop["failover_transcript_digest"], (
+    f"in-proc != socket-loopback transcript: "
+    f"{inproc['failover_transcript_digest']} vs "
+    f"{loop['failover_transcript_digest']}")
+print("equivalence pin: OK")
+EOF
+# Then the CROSS-PROCESS socket failover smoke through the REAL
+# bench.py --failover-soak --transport=socket path: primary / standby /
+# lease-service as separate OS processes over UDS, SIGKILL mid-load
+# under scripted nemesis (drop + dup + delay + a mid-stream connection
+# reset + an asymmetric ack/lease partition on the last cycle). Gates:
+# zero double matches, losses within the unacked-tail bound, both fence
+# seams refuse at the fenced ex-primary, zero heartbeat false
+# positives, bounded RTO, and a bit-identical transcript across two
+# seeded runs.
+python - <<'EOF'
+import json, subprocess, sys
+proc = subprocess.run(
+    [sys.executable, "bench.py", "--failover-soak", "--transport",
+     "socket", "--failover-cycles", "2", "--failover-runs", "2",
+     "--failover-pairs", "3", "--failover-singles", "2"],
+    capture_output=True, text=True, timeout=600)
+sys.stderr.write(proc.stderr)
+if proc.returncode != 0:
+    sys.exit(f"socket failover smoke exited {proc.returncode}")
+out = json.loads(proc.stdout.splitlines()[-1])
+print("socket failover smoke:", json.dumps(out))
+assert out["socket_failover_dup"] == 0, \
+    f"double matches over sockets: {out['socket_failover_dup']}"
+assert out["socket_failover_lost_over_bound"] == 0, \
+    f"lost beyond the unacked-tail bound: " \
+    f"{out['socket_failover_lost_over_bound']}"
+assert out["socket_failover_recoveries"] >= 2, \
+    out["socket_failover_recoveries"]
+assert out["socket_fenced_probe_failures"] == 0, \
+    f"a fence seam leaked at the ex-primary: " \
+    f"{out['socket_fenced_probe_failures']}"
+assert out["heartbeat_false_positive_count"] == 0, \
+    f"liveness false positives on a healthy link: " \
+    f"{out['heartbeat_false_positive_count']}"
+assert out["socket_link_reconnects"] >= 1, "scripted reset never healed"
+assert out["socket_failover_rto_ms"] is not None and \
+    out["socket_failover_rto_ms"] < 30_000, \
+    f"RTO unbounded: {out['socket_failover_rto_ms']}"
+assert out["socket_failover_transcript_identical"], \
+    "two seeded cross-process runs diverged"
+print("socket failover smoke: OK")
+EOF
+
 echo "== protocol =="
 # ISSUE 19 gate: protocol conformance. The suite runs by marker first —
 # the matchlint `protocol` rule's fixture positives/negatives (fence

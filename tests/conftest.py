@@ -112,6 +112,17 @@ def pytest_configure(config):
         "mutation gate — scripts/check.sh runs it by marker plus the "
         "committed-scope modelcheck smoke; the fast scopes are tier-1)")
     config.addinivalue_line(
+        "markers", "net: real-transport DCN suite (ISSUE 20: frame "
+        "codec fuzz — torn frames at every byte offset, hostile length "
+        "prefixes, CRC flips, interleaved heartbeats — the socket "
+        "replication link end-to-end over UDS with QueueReplication + "
+        "StandbyApplier unchanged, deterministic network nemesis "
+        "scripts, the remote lease client's renewal-in-flight-at-expiry "
+        "refusal, and the sanitizer's ack-beyond-received twin over a "
+        "real socket — scripts/check.sh runs it by marker plus a "
+        "2-cycle cross-process socket failover smoke and the in-proc ≡ "
+        "socket transcript-equivalence pin; part of tier-1)")
+    config.addinivalue_line(
         "markers", "forensics: incident-forensics suite (ISSUE 18: the "
         "causal event spine's monotone seq under threads, black-box "
         "trigger/rate-limit/reentrancy capture, bundle schema "

@@ -513,6 +513,51 @@ class Spine:
     assert clean == []
 
 
+@pytest.mark.net
+def test_determinism_covers_retry_backoff_heartbeat_arithmetic():
+    """ISSUE 20 satellite: the socket transport's reconnect schedule,
+    heartbeat liveness verdict, and RTT-budgeted lease validity decide
+    WHEN a peer is declared dead and WHEN a primary must fence — born
+    from time.time() they make failover timing (and the soak's
+    bit-identical transcript) a function of wall-clock jitter, and
+    unseeded reconnect jitter makes two seeded runs dial on different
+    schedules."""
+    findings = analyze_source('''
+import random
+import time
+
+class Conn:
+    def dial_plan(self, attempt, base, rtt_samples):
+        backoff = base * (2 ** attempt) * random.random()
+        self.next_dial = time.time() + backoff
+        self.next_heartbeat = time.time() + 0.05
+        rtt_ms = (time.time() - self.sent_at) * 1e3
+        valid_until = time.time() + self.lease_s
+        retry_at = time.time() + 0.2
+        return backoff
+''', path="matchmaking_tpu/net/fixture.py")
+    assert _rules(findings) == ["determinism"] * 6
+    # The sanctioned shapes (net/transport.py, net/lease.py): jitter via
+    # hash01(seed, "backoff", conn, attempt) — a pure function of the
+    # connection identity — and every deadline from a caller-passed
+    # time.monotonic() value.
+    clean = analyze_source('''
+from matchmaking_tpu.utils.chaos import hash01
+
+class Conn:
+    def dial_plan(self, now, attempt, base, cap, sent_at):
+        d = min(cap, base * (2 ** attempt))
+        backoff = d * (0.5 + 0.5 * hash01(self.seed, "backoff",
+                                          self.name, attempt))
+        self.next_dial = now + backoff
+        self.next_heartbeat = now + 0.05
+        rtt_ms = (now - sent_at) * 1e3
+        valid_until = now + self.lease_s
+        return backoff
+''', path="matchmaking_tpu/net/fixture.py")
+    assert clean == []
+
+
 # ---- perf (ISSUE 8: O(pool)/O(matches) scans on the hot path) --------------
 
 def test_perf_flags_pool_scan_in_hot_path_function():

@@ -1,0 +1,513 @@
+"""Real-transport DCN seam tests (ISSUE 20, marker ``net``).
+
+Four layers, bottom-up:
+
+- **Frame codec fuzz** (test_codec_fuzz.py corpus style): torn frames at
+  every byte offset, hostile length prefixes, CRC flips at every byte,
+  interleaved heartbeats — a corrupted frame must ERROR (killing the
+  connection), never decode to different bytes; a torn frame must be
+  held, never emitted early.
+- **Deterministic network nemesis**: scripted drop/dup/delay/reset/
+  partition verdicts are pure functions of (seed, flow, seq), fire on a
+  frame's first transmission only, and replay bit-identically.
+- **Socket replication link e2e over UDS**: ``QueueReplication`` +
+  ``StandbyApplier`` run UNCHANGED over the socket halves — scripted
+  mid-stream resets converge by reconnect + unacked-tail retransmission
+  with no gap and no duplicate apply; the sanitizer's ack-beyond-received
+  twin fires over a real socket; takeover fences the ex-primary's
+  publish check over the wire.
+- **Remote lease client**: RTT-budgeted validity — a renewal in flight
+  when the budgeted deadline passes must NOT count (fencing safety over
+  liveness); a CONFIRMED renewal anchored at its send time does; a
+  reachable authority lets a lapsed-but-unsuperseded holder re-confirm.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import time
+
+import pytest
+
+from matchmaking_tpu.config import ChaosConfig, NetConfig
+from matchmaking_tpu.net.link import SocketReplicationHub
+from matchmaking_tpu.net.nemesis import FlowNemesis, NetNemesis
+from matchmaking_tpu.net.transport import (
+    FrameDecoder,
+    FrameError,
+    backoff_delay,
+    encode_frame,
+    pack_msg,
+    unpack_msg,
+)
+from matchmaking_tpu.service.replication import (
+    LeaseHeldError,
+    QueueReplication,
+    StandbyApplier,
+)
+from matchmaking_tpu.utils import journal as jr
+
+pytestmark = pytest.mark.net
+
+Q = "net.test"
+
+
+def _row(pid: str, rating: float = 1500.0) -> list:
+    return [pid, rating, 0.0, "", "", None, 1.0, "r.q", pid, 0, 0.0]
+
+
+def _admit(*pids: str) -> bytes:
+    return json.dumps({"rows": [_row(p) for p in pids]}).encode()
+
+
+def _converge(deadline_s: float, step, done) -> bool:
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        step()
+        if done():
+            return True
+        time.sleep(0.01)
+    return done()
+
+
+# ---- frame codec fuzz -------------------------------------------------------
+
+
+def test_roundtrip_split_at_every_byte_offset():
+    """Torn frames at EVERY offset: any split of the byte stream decodes
+    to the identical frame sequence — partial tails are held, never
+    emitted early, never corrupted."""
+    payloads = [pack_msg({"t": "rec", "seq": i, "p": "x" * i})
+                for i in range(1, 4)]
+    stream = b"".join(encode_frame(p) for p in payloads)
+    for cut in range(len(stream) + 1):
+        dec = FrameDecoder()
+        got = dec.feed(stream[:cut]) + dec.feed(stream[cut:])
+        assert got == payloads, f"split at {cut} corrupted the stream"
+
+
+def test_torn_frame_prefix_yields_nothing():
+    frame = encode_frame(pack_msg({"t": "rec", "seq": 7}))
+    for cut in range(len(frame)):
+        dec = FrameDecoder()
+        assert dec.feed(frame[:cut]) == []
+
+
+def test_hostile_length_prefix_errors():
+    """A length prefix past max_frame must error immediately — a hostile
+    peer cannot make the decoder buffer unboundedly."""
+    good = pack_msg({"t": "hb"})
+    frame = bytearray(encode_frame(good, max_frame=1 << 20))
+    # Length field is bytes 2:6 of the <HII header (magic, length, crc).
+    frame[2:6] = (0xFFFFFFFF).to_bytes(4, "little")
+    with pytest.raises(FrameError):
+        FrameDecoder(max_frame=1 << 20).feed(bytes(frame))
+    with pytest.raises(FrameError):
+        FrameDecoder(max_frame=64).feed(encode_frame(b"z" * 65, max_frame=1 << 20))
+
+
+def test_corruption_at_every_byte_never_decodes_wrong():
+    """Flip every byte of a framed message: the decoder must either
+    raise FrameError (connection dies, stream resumes by ack) or keep
+    waiting for more bytes — it must NEVER hand back a payload that
+    differs from what was sent."""
+    payload = pack_msg({"t": "rec", "seq": 42, "p": "abcdef"})
+    frame = encode_frame(payload)
+    for i in range(len(frame)):
+        mutated = bytearray(frame)
+        mutated[i] ^= 0x5A
+        dec = FrameDecoder()
+        try:
+            got = dec.feed(bytes(mutated))
+        except FrameError:
+            continue  # clean kill — the resume path's job
+        assert payload not in got or bytes(mutated) == frame
+        for g in got:
+            assert g == payload or False, (
+                f"byte {i}: corrupted frame decoded to different bytes")
+
+
+def test_seeded_fuzz_corpus_random_cuts_and_noise():
+    """Corpus-style seeded fuzz: random frame batches, random split
+    points, random trailing garbage after a valid stream — valid
+    prefixes always decode intact; garbage errors or starves."""
+    rng = random.Random(0xC0FFEE)
+    for _ in range(50):
+        payloads = [pack_msg({"t": "rec", "seq": i,
+                              "p": "q" * rng.randrange(0, 200)})
+                    for i in range(rng.randrange(1, 5))]
+        stream = b"".join(encode_frame(p) for p in payloads)
+        dec = FrameDecoder()
+        got, pos = [], 0
+        while pos < len(stream):
+            cut = min(len(stream), pos + rng.randrange(1, 64))
+            got += dec.feed(stream[pos:cut])
+            pos = cut
+        assert got == payloads
+        noise = bytes(rng.randrange(256) for _ in range(32))
+        try:
+            extra = dec.feed(noise)
+            assert extra == []  # starving on a torn tail is fine
+        except FrameError:
+            pass  # erroring on garbage is fine — emitting it is not
+
+
+def test_interleaved_heartbeats_decode_clean():
+    """Heartbeat frames interleaved at every position between record
+    frames: both kinds decode, in order, whatever the interleaving."""
+    recs = [pack_msg({"t": "rec", "seq": i}) for i in range(3)]
+    hb = pack_msg({"t": "hb"})
+    for at in range(len(recs) + 1):
+        seq = recs[:at] + [hb] + recs[at:]
+        stream = b"".join(encode_frame(p) for p in seq)
+        dec = FrameDecoder()
+        out = []
+        for b in (stream[i:i + 7] for i in range(0, len(stream), 7)):
+            out += dec.feed(b)
+        assert out == seq
+        kinds = [unpack_msg(p)["t"] for p in out]
+        assert kinds.count("hb") == 1 and kinds.count("rec") == 3
+
+
+# ---- deterministic nemesis --------------------------------------------------
+
+
+def _chaos(**kw) -> ChaosConfig:
+    return ChaosConfig(seed=kw.pop("seed", 9), queues=(Q,), **kw)
+
+
+def _script(nem: FlowNemesis, seqs) -> list:
+    out = []
+    for s in seqs:
+        out.append((s, nem.transmit(s, b"f%d" % s)))
+    return out
+
+
+def test_nemesis_bit_identical_replay():
+    chaos = _chaos(net_drop_frames=(("fwd", 2),),
+                   net_dup_frames=(("fwd", 3),),
+                   net_delay_frames=(("fwd", 4, 2),),
+                   net_reset_frames=(("fwd", 6),))
+    mk = lambda: NetNemesis(chaos, 9).flow(f"repl:{Q}:fwd", lambda k, n=1: None)
+    seqs = [1, 2, 3, 4, 5, 6, 7, 2, 6]
+    assert _script(mk(), seqs) == _script(mk(), seqs)
+
+
+def test_nemesis_first_transmission_only():
+    chaos = _chaos(net_drop_frames=(("fwd", 2),))
+    nem = NetNemesis(chaos, 9).flow(f"repl:{Q}:fwd", lambda k, n=1: None)
+    assert nem.transmit(2, b"a") == []           # first tx: dropped
+    assert nem.transmit(2, b"a") == [("send", b"a")]  # retransmit passes
+
+
+def test_nemesis_reset_consumes_frame():
+    chaos = _chaos(net_reset_frames=(("fwd", 3),))
+    nem = NetNemesis(chaos, 9).flow(f"repl:{Q}:fwd", lambda k, n=1: None)
+    assert nem.transmit(3, b"a") == [("reset",)]
+    assert nem.transmit(3, b"a") == [("send", b"a")]
+
+
+def test_nemesis_partition_holds_then_flushes_in_order():
+    chaos = _chaos(net_partitions=(("fwd", 3, 5),))
+    nem = NetNemesis(chaos, 9).flow(f"repl:{Q}:fwd", lambda k, n=1: None)
+    assert nem.transmit(1, b"f1") == [("send", b"f1")]
+    assert nem.transmit(3, b"f3") == []
+    assert nem.transmit(4, b"f4") == []
+    assert nem.transmit(5, b"f5") == [
+        ("send", b"f3"), ("send", b"f4"), ("send", b"f5")]
+
+
+def test_nemesis_flow_substring_match_and_deafness():
+    chaos = _chaos(net_drop_frames=(("repl:other", 1),))
+    nn = NetNemesis(chaos, 9)
+    assert nn.flow(f"repl:{Q}:fwd", lambda k, n=1: None) is None
+    deaf = nn.rx_deaf(f"repl:{Q}:ack")
+    assert not deaf()
+    nn.deafen(f"repl:{Q}:ack")
+    assert deaf()
+    assert not nn.rx_deaf("lease:p1")()
+    nn.undeafen()
+    assert not deaf()
+
+
+def test_backoff_seeded_jitter_deterministic_and_capped():
+    a = backoff_delay(7, "conn", 3, 0.02, 1.0)
+    assert a == backoff_delay(7, "conn", 3, 0.02, 1.0)
+    assert a != backoff_delay(7, "conn", 4, 0.02, 1.0)
+    for attempt in range(40):
+        d = backoff_delay(7, "conn", attempt, 0.02, 1.0)
+        assert 0.0 < d <= 1.0
+
+
+# ---- socket link e2e over UDS ----------------------------------------------
+
+
+def test_socket_stream_converges_after_scripted_reset(tmp_path):
+    """QueueReplication + StandbyApplier UNCHANGED over the socket
+    halves: a scripted MID-STREAM reset tears the connection; reconnect
+    + unacked-tail retransmission must converge with no gap and no
+    duplicate apply — the torn frame is the transport's problem, the seq
+    watermark is the recovery."""
+    chaos = _chaos(net_reset_frames=((f"repl:{Q}:fwd", 3),))
+    hub = SocketReplicationHub(chaos=chaos, seed=9,
+                               base_dir=str(tmp_path), lease_s=60.0)
+    try:
+        ep = hub.authority.acquire(Q, "p1", time.monotonic())
+        sap = hub.standby(Q, owner="s1")
+        repl = QueueReplication(Q, "p1", ep, hub.authority, hub.link(Q))
+        pids = ["a", "b", "c", "d", "e"]
+        for seq, pid in enumerate(pids, start=1):
+            repl.on_record(seq, jr.RT_ADMIT, _admit(pid))
+
+        def step():
+            repl.pump(time.monotonic())
+            sap.pump()
+
+        assert _converge(10.0, step, lambda: repl.quiescent and
+                         sap.applied_seq == len(pids))
+        assert sorted(sap.shadow.waiting) == pids
+        assert hub.link(Q).counters["nemesis_resets"] == 1
+        # applied exactly once each: the applier's dup/gap discipline
+        # held over a real reconnect (dups counted, never re-applied).
+        assert sap.counters["applied"] == len(pids)
+        # Fencing over the wire: takeover bumps the epoch at the remote
+        # authority; the ex-primary's next check refuses both seams.
+        assert repl.may_publish()
+        sap.takeover(time.monotonic() + 61.0)
+        assert not repl.may_publish()
+        assert repl.role == "fenced"
+        assert not repl.may_write()
+    finally:
+        hub.close()
+
+
+def test_socket_baseline_replay_rebases_late_standby(tmp_path):
+    """A standby that attaches AFTER the baseline was sent still rebases:
+    the link replays its newest RT_REPL_SNAPSHOT on every (re)connect."""
+    hub = SocketReplicationHub(seed=9, base_dir=str(tmp_path), lease_s=60.0)
+    try:
+        ep = hub.authority.acquire(Q, "p1", time.monotonic())
+        repl = QueueReplication(Q, "p1", ep, hub.authority, hub.link(Q))
+        baseline = json.dumps({"rows": [_row("base")],
+                               "recent": []}).encode()
+        repl.send_baseline(1, baseline)  # nobody listening yet
+        repl.on_record(2, jr.RT_ADMIT, _admit("tail"))
+        sap = hub.standby(Q, owner="s1")  # late attach
+
+        def step():
+            repl.pump(time.monotonic())
+            sap.pump()
+
+        assert _converge(10.0, step, lambda: sap.applied_seq >= 2)
+        assert sorted(sap.shadow.waiting) == ["base", "tail"]
+    finally:
+        hub.close()
+
+
+def test_socket_backpressure_drops_and_counts(tmp_path):
+    """Over the send budget the link DROPS (bounded buffers surface
+    backpressure; the unacked tail + stall retransmit heal) — it must
+    never buffer unboundedly. A payload bigger than the whole budget can
+    never fit, so every offer drops deterministically."""
+    net = NetConfig(transport="socket", send_buffer_bytes=64)
+    hub = SocketReplicationHub(net=net, seed=9, base_dir=str(tmp_path),
+                               lease_s=60.0)
+    try:
+        lk = hub.link(Q)
+        big = b"z" * 200
+        for seq in range(1, 20):
+            lk.send(seq, jr.RT_ADMIT, big)
+        assert lk.counters["backpressure_dropped"] == 19
+        assert lk.counters["sent"] == 19
+    finally:
+        hub.close()
+
+
+def test_sanitizer_flags_ack_beyond_received_over_socket(tmp_path):
+    """Satellite (b): the sanitizer's replication twin covers the SOCKET
+    standby half — an ack past the delivered horizon over a real UDS
+    connection raises the same silent-loss finding as in-proc."""
+    from matchmaking_tpu.testing.sanitizer import AsyncSanitizer
+
+    san = AsyncSanitizer()
+    with san.installed():
+        hub = SocketReplicationHub(seed=9, base_dir=str(tmp_path),
+                                   lease_s=60.0)
+        try:
+            slink_applier = hub.standby(Q, owner="s1")
+            slink = slink_applier.link
+            lk = hub.link(Q)
+
+            def step():
+                lk.send(1, jr.RT_ADMIT, _admit("a"))
+                slink_applier.pump()
+
+            assert _converge(10.0, step,
+                             lambda: slink.max_delivered >= 1)
+            # Break the watermark seam on purpose, over the wire.
+            slink.ack(slink.max_delivered + 7)
+        finally:
+            hub.close()
+    finding = [f for f in san.findings
+               if f.kind == "replication-ack-beyond-received"]
+    assert finding, san.findings
+    assert "SOCKET" in str(finding[0])
+
+
+# ---- remote lease client ----------------------------------------------------
+
+
+def _lease_hub(tmp_path, lease_s: float) -> SocketReplicationHub:
+    return SocketReplicationHub(seed=9, base_dir=str(tmp_path),
+                                lease_s=lease_s)
+
+
+def test_remote_lease_acquire_renew_held_takeover(tmp_path):
+    hub = _lease_hub(tmp_path, 0.5)
+    try:
+        auth = hub.authority
+        t0 = time.monotonic()
+        ep = auth.acquire(Q, "p1", t0)
+        assert ep == 1
+        assert auth.renew(Q, "p1", ep, time.monotonic())
+        with pytest.raises(LeaseHeldError):
+            auth.acquire(Q, "p2", time.monotonic())
+        with pytest.raises(LeaseHeldError):
+            auth.takeover(Q, "p2", time.monotonic())
+        # The loopback service trusts the caller's clock: fast-forward
+        # past expiry (the soak's scriptable takeover, over the wire).
+        ep2 = auth.takeover(Q, "p2", time.monotonic() + 1.0)
+        assert ep2 == 2
+        assert not auth.is_current(Q, "p1", ep)
+        assert auth.is_current(Q, "p2", ep2)
+        assert auth.epoch_of(Q) == 2
+    finally:
+        hub.close()
+
+
+def test_renewal_in_flight_at_expiry_does_not_count(tmp_path):
+    """THE fencing-over-RTT pin (ISSUE 20 acceptance): validity extends
+    only when a renewal CONFIRMS, anchored at its send time minus the
+    RTT budget. A renewal still in flight when the budgeted deadline
+    passes must NOT count — the client goes stale and fences even though
+    the authority might have granted it."""
+    hub = _lease_hub(tmp_path, 0.6)
+    try:
+        auth = hub.authority
+        t0 = time.monotonic()
+        ep = auth.acquire(Q, "p1", t0)
+        assert auth.is_current(Q, "p1", ep)
+        # Scripted RTT = infinity from here on: responses never arrive.
+        hub.nemesis.deafen("lease:")
+        # Fire a renewal WELL before expiry — it stays in flight forever.
+        assert auth.renew(Q, "p1", ep, time.monotonic())
+        # Sleep past the budgeted validity (grant = lease_s - rtt_budget
+        # anchored at acquire): the in-flight renewal must not extend it.
+        time.sleep(0.7)
+        assert not auth.is_current(Q, "p1", ep), (
+            "a renewal in flight at expiry counted toward validity — "
+            "fencing safety must beat liveness")
+        # The blocking re-confirm path also refuses (response deaf).
+        assert not auth.renew(Q, "p1", ep, time.monotonic())
+        # Liveness recovery that stays SAFE: once the authority is
+        # reachable again and the epoch is unsuperseded, a blocking
+        # re-confirm restores validity.
+        hub.nemesis.undeafen()
+        assert auth.renew(Q, "p1", ep, time.monotonic())
+        assert auth.is_current(Q, "p1", ep)
+    finally:
+        hub.close()
+
+
+def test_confirmed_renewal_extends_validity(tmp_path):
+    """The sanctioned counterpart: a renewal that CONFIRMS extends
+    validity from its send time — the budgeted deadline moves, no fence."""
+    hub = _lease_hub(tmp_path, 0.6)
+    try:
+        auth = hub.authority
+        ep = auth.acquire(Q, "p1", time.monotonic())
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            assert auth.renew(Q, "p1", ep, time.monotonic())
+            time.sleep(0.05)
+        # Held across ~3x the lease duration by confirmed renewals.
+        assert auth.is_current(Q, "p1", ep)
+    finally:
+        hub.close()
+
+
+def test_scripted_renewal_fault_does_not_self_fence(tmp_path):
+    """A scripted renewal refusal at the SERVICE (ChaosConfig.
+    repl_fail_renewals, same vocabulary the in-proc authority scripts)
+    contributes nothing to validity — the lease lapses on the budgeted
+    deadline — but must NOT mark the client stale: the epoch is
+    unsuperseded, so the next CONFIRMED renewal recovers. Fencing stays
+    the authority's epoch verdict, never the client's pessimism."""
+    hub = SocketReplicationHub(
+        seed=9, base_dir=str(tmp_path), lease_s=0.6,
+        chaos=ChaosConfig(seed=9, queues=(Q,), repl_fail_renewals=(0,)))
+    try:
+        auth = hub.authority
+        ep = auth.acquire(Q, "p1", time.monotonic())
+        # Inside validity: answered from cache; the background renewal
+        # it fires is renewal #0 — the scripted refusal.
+        assert auth.renew(Q, "p1", ep, time.monotonic())
+        time.sleep(0.7)
+        # The refused renewal did not extend validity (it lapsed) ...
+        assert not auth.is_current(Q, "p1", ep)
+        # ... but did not poison the client either: the epoch was never
+        # superseded, so a blocking re-confirm (renewal #1) recovers.
+        assert auth.renew(Q, "p1", ep, time.monotonic())
+        assert auth.is_current(Q, "p1", ep)
+    finally:
+        hub.close()
+
+
+# ---- cfg.net auto-built hub -------------------------------------------------
+
+
+async def test_app_auto_builds_and_closes_socket_hub(tmp_path):
+    """cfg.net names the fabric → MatchmakingApp builds (and owns) its
+    SocketReplicationHub: replication streams to the configured target,
+    the lease rides the remote client, and stop() closes the sockets."""
+    from matchmaking_tpu.config import (
+        BatcherConfig,
+        Config,
+        DurabilityConfig,
+        EngineConfig,
+        QueueConfig,
+        ReplicationConfig,
+    )
+    from matchmaking_tpu.net.lease import LeaseService
+    from matchmaking_tpu.service.app import MatchmakingApp
+
+    lease_addr = f"unix:{tmp_path}/lease.sock"
+    svc = LeaseService(lease_addr, lease_s=60.0)
+    svc.start()
+    app = None
+    try:
+        cfg = Config(
+            queues=(QueueConfig(name=Q, rating_threshold=50.0),),
+            engine=EngineConfig(backend="tpu", pool_capacity=256,
+                                pool_block=64, batch_buckets=(8, 32),
+                                top_k=4),
+            batcher=BatcherConfig(max_batch=8, max_wait_ms=5.0),
+            durability=DurabilityConfig(journal_dir=str(tmp_path / "j"),
+                                        fsync="window"),
+            replication=ReplicationConfig(role="primary", owner="hostA"),
+            net=NetConfig(transport="socket", lease_addr=lease_addr,
+                          repl_target=f"unix:{tmp_path}/deadend.sock"))
+        app = MatchmakingApp(cfg)
+        await app.start()
+        hub = app.replication_hub
+        assert hub is not None and app._owns_net_hub
+        repl = app.runtime(Q).replication
+        assert repl is not None and repl.role == "primary"
+        assert repl.epoch == 1
+        await app.stop()
+        assert app.replication_hub is None  # owned hub closed with host
+    finally:
+        if app is not None and app._started:
+            await app.crash()
+        svc.close()
